@@ -1,0 +1,81 @@
+"""Write-only-from-workers accumulators.
+
+Reference parity: dpark/accumulator.py — Accumulator + AccumulatorParam
+(zero/addInPlace), per-task update registry shipped back with task results
+and merged on the driver (SURVEY.md section 2.1).
+"""
+
+import threading
+
+
+class AccumulatorParam:
+    def __init__(self, zero, add_in_place):
+        self.zero = zero
+        self.add_in_place = add_in_place
+
+
+numAcc = AccumulatorParam(0, lambda x, y: x + y)
+listAcc = AccumulatorParam([], lambda l, v: (l.append(v) or l)
+                           if not isinstance(v, list) else (l.extend(v) or l))
+setAcc = AccumulatorParam(set(), lambda s, v: (s.update(v) or s)
+                          if isinstance(v, (set, list)) else (s.add(v) or s))
+
+_registry = {}            # id -> driver-side Accumulator
+_local = threading.local()
+
+
+class Accumulator:
+    _next_id = [0]
+
+    def __init__(self, initial_value=0, param=numAcc):
+        Accumulator._next_id[0] += 1
+        self.id = Accumulator._next_id[0]
+        self.param = param
+        self.value = initial_value
+        _registry[self.id] = self
+
+    def add(self, v):
+        updates = getattr(_local, "updates", None)
+        if updates is not None:
+            # inside a task: record locally, merged on the driver later
+            if self.id in updates:
+                updates[self.id] = self.param.add_in_place(updates[self.id], v)
+            else:
+                zero = self.param.zero
+                zero = zero.copy() if hasattr(zero, "copy") else zero
+                updates[self.id] = self.param.add_in_place(zero, v)
+        else:
+            self.value = self.param.add_in_place(self.value, v)
+
+    def __iadd__(self, v):
+        self.add(v)
+        return self
+
+    def reset(self):
+        zero = self.param.zero
+        self.value = zero.copy() if hasattr(zero, "copy") else zero
+
+    def __getstate__(self):
+        # ships id + param only; worker-side adds go to the task registry
+        return (self.id, self.param)
+
+    def __setstate__(self, state):
+        self.id, self.param = state
+        self.value = None
+
+
+def start_task():
+    _local.updates = {}
+
+
+def finish_task():
+    updates = getattr(_local, "updates", {})
+    _local.updates = None
+    return updates
+
+
+def merge_on_driver(updates):
+    for acc_id, v in (updates or {}).items():
+        acc = _registry.get(acc_id)
+        if acc is not None:
+            acc.value = acc.param.add_in_place(acc.value, v)

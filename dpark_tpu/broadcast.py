@@ -1,0 +1,69 @@
+"""Broadcast: ship a read-only value to every worker once.
+
+Reference parity: dpark/broadcast.py — Broadcast.__getstate__ ships only the
+id; workers lazily fetch on first deref.  The reference distributes ~1MB
+compressed chunks P2P/tree-style over zmq (SURVEY.md section 2.1).
+
+Single-host design: the value is dumped once, compressed, to a file in the
+shared workdir; worker processes mmap-read it on first access.  On the TPU
+backend a broadcast value that is a jax.Array (or numpy) is realised as a
+replicated device array via jax.device_put with a fully-replicated sharding
+(backend/tpu/), which is the ICI equivalent of the reference's tree
+broadcast.
+"""
+
+import os
+import pickle
+import threading
+
+from dpark_tpu.utils import atomic_file, compress, decompress
+
+_local_values = {}          # bid -> value, populated in creating process
+_lock = threading.Lock()
+
+
+class Broadcast:
+    _next_id = [0]
+
+    def __init__(self, value):
+        Broadcast._next_id[0] += 1
+        self.bid = Broadcast._next_id[0]
+        self._value = value
+        _local_values[self.bid] = value
+        self._write_file(value)
+
+    def _path(self):
+        from dpark_tpu.env import env
+        d = os.path.join(env.workdir, "broadcast")
+        return os.path.join(d, "b%d" % self.bid)
+
+    def _write_file(self, value):
+        path = self._path()
+        with atomic_file(path) as f:
+            f.write(compress(pickle.dumps(value, -1)))
+
+    @property
+    def value(self):
+        if self._value is None:
+            with _lock:
+                if self.bid in _local_values:
+                    self._value = _local_values[self.bid]
+                else:
+                    with open(self._path(), "rb") as f:
+                        self._value = pickle.loads(decompress(f.read()))
+                    _local_values[self.bid] = self._value
+        return self._value
+
+    def __getstate__(self):
+        return (self.bid,)
+
+    def __setstate__(self, state):
+        (self.bid,) = state
+        self._value = _local_values.get(self.bid)
+
+    def clear(self):
+        _local_values.pop(self.bid, None)
+        try:
+            os.unlink(self._path())
+        except OSError:
+            pass

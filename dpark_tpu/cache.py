@@ -1,0 +1,76 @@
+"""Partition cache for rdd.cache() / persist().
+
+Reference parity: dpark/cache.py — per-process memory cache + disk cache of
+computed partitions, with a CacheTracker recording locations so the
+scheduler prefers cached hosts (SURVEY.md sections 2.1 and 3.5).
+
+Single-host design: memory dict in each process + a disk tier in the shared
+workdir, so a partition cached by one worker process is readable by all.
+The TPU backend keeps stage outputs HBM-resident instead (backend/tpu/).
+"""
+
+import os
+import pickle
+import threading
+
+from dpark_tpu.utils import atomic_file, compress, decompress
+
+
+class Cache:
+    def __init__(self, workdir):
+        self.memory = {}
+        self.disk_dir = os.path.join(workdir, "cache")
+        self.lock = threading.Lock()
+
+    def _disk_path(self, key):
+        rdd_id, split_index = key
+        return os.path.join(self.disk_dir, "%d_%d" % (rdd_id, split_index))
+
+    def get(self, key):
+        with self.lock:
+            if key in self.memory:
+                return self.memory[key]
+        path = self._disk_path(key)
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    items = pickle.loads(decompress(f.read()))
+            except (OSError, pickle.PickleError):
+                return None
+            with self.lock:
+                self.memory[key] = items
+            return items
+        return None
+
+    def put(self, key, items, disk=True):
+        items = list(items)
+        with self.lock:
+            self.memory[key] = items
+        if disk:
+            try:
+                with atomic_file(self._disk_path(key)) as f:
+                    f.write(compress(pickle.dumps(items, -1)))
+            except OSError:
+                pass
+        return items
+
+    def drop(self, rdd_id, n_splits):
+        for i in range(n_splits):
+            key = (rdd_id, i)
+            with self.lock:
+                self.memory.pop(key, None)
+            try:
+                os.unlink(self._disk_path(key))
+            except OSError:
+                pass
+
+
+def get_or_compute(rdd, split):
+    """iterator() hook: consult the cache before compute (SURVEY 3.5)."""
+    from dpark_tpu.env import env
+    key = (rdd.id, split.index)
+    cached = env.cache.get(key)
+    if cached is not None:
+        return iter(cached)
+    items = env.cache.put(key, rdd.compute(split))
+    return iter(items)

@@ -1,0 +1,70 @@
+"""Configuration for dpark_tpu.
+
+Reference parity: dpark/conf.py (module constants + optional user conf file
+via the DPARK_CONF env var).  Reference mount was empty at build time; survey
+cites are at file-granularity only (SURVEY.md section 2.1).
+
+TPU additions beyond the reference: mesh shape, HBM budget knobs, and the
+device-bucket padding policy used by the all_to_all shuffle.
+"""
+
+import os
+import importlib.util
+
+# ---------------------------------------------------------------------------
+# Reference-parity knobs (dpark/conf.py)
+# ---------------------------------------------------------------------------
+
+MEM_PER_TASK = 200.0          # MB per task (process/mesos masters)
+MAX_TASK_FAILURES = 4         # retries before a job aborts
+MAX_TASK_MEMORY = 15 << 10    # MB hard ceiling when escalating retries
+
+# shuffle behaviour (the reference's `rddconf`)
+SORT_SHUFFLE = False          # sort-based shuffle path instead of hash-dict
+SPILL_DIR_THRESHOLD = 0.8     # fraction of MEM_PER_TASK before disk spill
+SHUFFLE_CHUNK_RECORDS = 1 << 16
+
+# workdir candidates: first writable wins (dpark: DPARK_WORK_DIR)
+DPARK_WORK_DIR = os.environ.get("DPARK_WORK_DIR", "/tmp/dpark_tpu")
+
+# compression codec for shuffle files / broadcast blocks: zlib always
+# available; lz4 used when importable (reference prefers lz4).
+COMPRESS = "auto"
+
+# ---------------------------------------------------------------------------
+# TPU-native knobs (no reference analog)
+# ---------------------------------------------------------------------------
+
+# device mesh axis name used by shard_map programs
+MESH_AXIS = "parts"
+
+# per-device bucket padding granularity for the count-exchange all_to_all
+# shuffle; buckets are padded up to a multiple of this so recompilation only
+# happens when the padded size class changes (power-of-two size classes).
+BUCKET_PAD_GRANULARITY = 1024
+
+# max bytes of HBM a single shuffle round may use per device before the
+# chunked multi-round path kicks in (the "external merge" equivalent).
+SHUFFLE_HBM_BUDGET = 2 << 30
+
+# default dtype for device-side values
+DEFAULT_DTYPE = "int32"
+
+
+def load_conf(path):
+    """Execute a Python conf file and overlay module-level constants.
+
+    Reference parity: dpark/conf.py (load_conf).
+    """
+    spec = importlib.util.spec_from_file_location("dpark_user_conf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    g = globals()
+    for k in dir(mod):
+        if k.isupper() and k in g:
+            g[k] = getattr(mod, k)
+
+
+_user_conf = os.environ.get("DPARK_CONF")
+if _user_conf and os.path.exists(_user_conf):
+    load_conf(_user_conf)

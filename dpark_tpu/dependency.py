@@ -1,0 +1,165 @@
+"""Dependency lattice, partitioners, and the combineByKey aggregator.
+
+Reference parity: dpark/dependency.py — Dependency/NarrowDependency/
+OneToOneDependency/RangeDependency/ShuffleDependency, Partitioner/
+HashPartitioner/RangePartitioner, Aggregator(createCombiner, mergeValue,
+mergeCombiners) (SURVEY.md section 2.1; the DAG scheduler cuts stages on
+ShuffleDependency edges).
+"""
+
+import bisect
+
+from dpark_tpu.utils.phash import portable_hash
+
+
+class Dependency:
+    def __init__(self, rdd):
+        self.rdd = rdd
+
+    @property
+    def is_shuffle(self):
+        return isinstance(self, ShuffleDependency)
+
+
+class NarrowDependency(Dependency):
+    """Child partition depends on a statically known set of parent parts."""
+
+    def get_parents(self, partition_id):
+        raise NotImplementedError
+
+
+class OneToOneDependency(NarrowDependency):
+    def get_parents(self, pid):
+        return [pid]
+
+
+class RangeDependency(NarrowDependency):
+    """Used by UnionRDD: child partitions [out_start, out_start+length) map
+    1:1 onto parent partitions [in_start, in_start+length)."""
+
+    def __init__(self, rdd, in_start, out_start, length):
+        super().__init__(rdd)
+        self.in_start = in_start
+        self.out_start = out_start
+        self.length = length
+
+    def get_parents(self, pid):
+        if self.out_start <= pid < self.out_start + self.length:
+            return [pid - self.out_start + self.in_start]
+        return []
+
+
+class CartesianDependency(NarrowDependency):
+    def __init__(self, rdd, which, num_other):
+        super().__init__(rdd)
+        self.which = which          # 0 = row side, 1 = column side
+        self.num_other = num_other
+
+    def get_parents(self, pid):
+        if self.which == 0:
+            return [pid // self.num_other]
+        return [pid % self.num_other]
+
+
+_next_shuffle_id = [0]
+
+
+def new_shuffle_id():
+    _next_shuffle_id[0] += 1
+    return _next_shuffle_id[0]
+
+
+class ShuffleDependency(Dependency):
+    """A wide edge: child partitions need a repartitioning of all parent
+    partitions.  Stage boundary for the DAG scheduler; collective boundary
+    for the TPU backend."""
+
+    def __init__(self, rdd, aggregator, partitioner):
+        super().__init__(rdd)
+        self.shuffle_id = new_shuffle_id()
+        self.aggregator = aggregator
+        self.partitioner = partitioner
+
+
+class Aggregator:
+    """combineByKey triple (reference: dpark Aggregator)."""
+
+    def __init__(self, create_combiner, merge_value, merge_combiners):
+        self.create_combiner = create_combiner
+        self.merge_value = merge_value
+        self.merge_combiners = merge_combiners
+
+    # camelCase aliases for API parity
+    @property
+    def createCombiner(self):
+        return self.create_combiner
+
+    @property
+    def mergeValue(self):
+        return self.merge_value
+
+    @property
+    def mergeCombiners(self):
+        return self.merge_combiners
+
+
+class Partitioner:
+    @property
+    def num_partitions(self):
+        raise NotImplementedError
+
+    def get_partition(self, key):
+        raise NotImplementedError
+
+    # camelCase parity aliases — delegate so subclass overrides of the
+    # snake_case methods are honored
+    @property
+    def numPartitions(self):
+        return self.num_partitions
+
+    def getPartition(self, key):
+        return self.get_partition(key)
+
+
+class HashPartitioner(Partitioner):
+    def __init__(self, partitions):
+        self.partitions = max(1, int(partitions))
+
+    @property
+    def num_partitions(self):
+        return self.partitions
+
+    def get_partition(self, key):
+        return portable_hash(key) % self.partitions
+
+    def __eq__(self, other):
+        return (isinstance(other, HashPartitioner)
+                and other.partitions == self.partitions)
+
+    def __hash__(self):
+        return self.partitions
+
+
+class RangePartitioner(Partitioner):
+    """Sorted-sample range partitioner backing sortByKey (reference:
+    dpark RangePartitioner — bounds from a sample, bisect per key)."""
+
+    def __init__(self, bounds, ascending=True):
+        self.bounds = list(bounds)
+        self.ascending = ascending
+
+    @property
+    def num_partitions(self):
+        return len(self.bounds) + 1
+
+    def get_partition(self, key):
+        idx = bisect.bisect_left(self.bounds, key)
+        return idx if self.ascending else len(self.bounds) - idx
+
+    def __eq__(self, other):
+        return (isinstance(other, RangePartitioner)
+                and other.bounds == self.bounds
+                and other.ascending == self.ascending)
+
+    def __hash__(self):
+        return hash((tuple(self.bounds), self.ascending))

@@ -1,0 +1,103 @@
+"""Process-level runtime wiring for driver and workers.
+
+Reference parity: dpark/env.py (DparkEnv singleton + global `env`) — picks a
+writable workdir from DPARK_WORK_DIR candidates and wires the map-output
+tracker, cache tracker and shuffle fetcher into every process (SURVEY.md
+sections 1 and 2.1).
+
+Single-host simplification vs the reference: the zmq TrackerServer becomes an
+in-process dict on the driver; workers receive map-output *snapshots*
+embedded in task payloads (parent stages are always complete before a reduce
+task is serialized, so a snapshot is exact — see schedule.py).  A TCP tracker
+for multi-host DCN deployments lives in tracker.py.
+"""
+
+import os
+import socket
+import tempfile
+import uuid
+
+
+class MapOutputTracker:
+    """shuffle_id -> list of per-map-task output URIs (None = missing)."""
+
+    def __init__(self):
+        self.locs = {}
+
+    def register_outputs(self, shuffle_id, locs):
+        self.locs[shuffle_id] = list(locs)
+
+    def get_outputs(self, shuffle_id):
+        return self.locs.get(shuffle_id)
+
+    def invalidate_host(self, shuffle_id, host):
+        locs = self.locs.get(shuffle_id, [])
+        for i, uri in enumerate(locs):
+            if uri and host in uri:
+                locs[i] = None
+
+    def snapshot(self, shuffle_ids):
+        return {sid: self.locs[sid] for sid in shuffle_ids
+                if sid in self.locs}
+
+    def update(self, snap):
+        self.locs.update(snap)
+
+
+class DparkEnv:
+    def __init__(self):
+        self.started = False
+        self.is_master = False
+        self.workdir = None
+        self.map_output_tracker = MapOutputTracker()
+        self.cache = None                 # set by cache.py on start
+        self.shuffle_fetcher = None       # set by shuffle.py on start
+        self.session_id = None
+
+    def start(self, is_master=True, environ=None):
+        if self.started:
+            return
+        self.started = True
+        self.is_master = is_master
+        environ = environ or {}
+        self.session_id = environ.get(
+            "DPARK_SESSION", uuid.uuid4().hex[:12])
+        self.workdir = environ.get("DPARK_WORKDIR") or self._pick_workdir()
+        os.makedirs(self.workdir, exist_ok=True)
+
+        from dpark_tpu.shuffle import ParallelShuffleFetcher
+        from dpark_tpu.cache import Cache
+        self.shuffle_fetcher = ParallelShuffleFetcher()
+        self.cache = Cache(self.workdir)
+
+    def _pick_workdir(self):
+        from dpark_tpu import conf
+        for cand in conf.DPARK_WORK_DIR.split(","):
+            cand = cand.strip()
+            if not cand:
+                continue
+            try:
+                path = os.path.join(cand, "dpark-%s" % self.session_id)
+                os.makedirs(path, exist_ok=True)
+                return path
+            except OSError:
+                continue
+        return tempfile.mkdtemp(prefix="dpark-")
+
+    def environ_for_worker(self):
+        return {"DPARK_SESSION": self.session_id,
+                "DPARK_WORKDIR": self.workdir}
+
+    def stop(self):
+        if not self.started:
+            return
+        self.started = False
+        if self.shuffle_fetcher:
+            self.shuffle_fetcher.stop()
+
+    @property
+    def host(self):
+        return socket.gethostname()
+
+
+env = DparkEnv()

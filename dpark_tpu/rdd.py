@@ -1,0 +1,1425 @@
+"""RDD graph: lazy, partitioned datasets and their ~50 transformations.
+
+Reference parity: dpark/rdd.py — the RDD base class (six-method protocol:
+splits / dependencies / compute / iterator / preferred_locations /
+partitioner, SURVEY.md section 1), every narrow/wide/source/sink RDD type of
+SURVEY.md section 2.2, and the action surface (collect/count/reduce/take/
+saveAs*/...).
+
+Design note (TPU): every compute() below is a Python generator — the object
+path that the local/process masters run and the golden model for parity
+tests.  The TPU backend does not call these; it records narrow chains as a
+traceable op-IR and fuses them per stage into one jitted program
+(backend/tpu/fuse.py).  compute() remains the semantic definition.
+"""
+
+import bz2 as _bz2
+import csv as _csv
+import gzip as _gzip
+import heapq
+import itertools
+import os
+import pickle
+import random
+import struct
+import subprocess
+from collections import Counter
+
+from dpark_tpu import cache as _cache
+from dpark_tpu.dependency import (
+    Aggregator, CartesianDependency, HashPartitioner, OneToOneDependency,
+    RangeDependency, RangePartitioner, ShuffleDependency)
+from dpark_tpu.utils import atomic_file, user_call_site
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("rdd")
+
+
+class Split:
+    def __init__(self, index):
+        self.index = index
+
+
+# --------------------------------------------------------------------------
+# module-level helpers (picklable without closure shipping)
+# --------------------------------------------------------------------------
+
+def _fst(pair):
+    return pair[0]
+
+
+def _snd(pair):
+    return pair[1]
+
+
+def _identity(x):
+    return x
+
+
+def _mk_list(v):
+    return [v]
+
+
+def _append(l, v):
+    l.append(v)
+    return l
+
+
+def _extend(l1, l2):
+    l1.extend(l2)
+    return l1
+
+
+def _add(a, b):
+    return a + b
+
+
+def _keep_first(a, b):
+    return a
+
+
+class RDD:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.id = ctx.new_rdd_id()
+        self._splits = None
+        self.dependencies = []
+        self.partitioner = None
+        self.should_cache = False
+        self._checkpoint_rdd = None
+        self.scope_name = "%s@%s" % (type(self).__name__, user_call_site())
+
+    # -- the six-method protocol ----------------------------------------
+    @property
+    def splits(self):
+        if self._checkpoint_rdd is not None:
+            return self._checkpoint_rdd.splits
+        if self._splits is None:
+            self._splits = self._make_splits()
+        return self._splits
+
+    def _make_splits(self):
+        raise NotImplementedError(
+            "%s: splits unavailable (worker-side access?)" % type(self))
+
+    def compute(self, split):
+        raise NotImplementedError
+
+    def iterator(self, split):
+        if self._checkpoint_rdd is not None:
+            return self._checkpoint_rdd.iterator(split)
+        if self.should_cache:
+            return _cache.get_or_compute(self, split)
+        return self.compute(split)
+
+    def preferred_locations(self, split):
+        return []
+
+    # -- serialization: splits stay driver-side; tasks carry their own
+    #    split object (reference: dpark RDD.__getstate__)
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_splits"] = None
+        d["ctx"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+
+    def __repr__(self):
+        return "<%s %d>" % (type(self).__name__, self.id)
+
+    def __len__(self):
+        return len(self.splits)
+
+    # ===================================================================
+    # transformations (narrow)
+    # ===================================================================
+    def map(self, f):
+        return MappedRDD(self, f)
+
+    def flatMap(self, f):
+        return FlatMappedRDD(self, f)
+
+    def filter(self, f):
+        return FilteredRDD(self, f)
+
+    def glom(self):
+        return GlommedRDD(self)
+
+    def mapPartitions(self, f):
+        return MapPartitionsRDD(self, f)
+
+    mapPartition = mapPartitions
+
+    def mapPartitionsWithIndex(self, f):
+        return MapPartitionsRDD(self, f, with_index=True)
+
+    mapPartitionWithIndex = mapPartitionsWithIndex
+
+    def mapValue(self, f):
+        return MappedValuesRDD(self, f)
+
+    mapValues = mapValue
+
+    def flatMapValue(self, f):
+        return FlatMappedValuesRDD(self, f)
+
+    flatMapValues = flatMapValue
+
+    def keyBy(self, f):
+        return KeyedRDD(self, f)
+
+    def pipe(self, command, quiet=True):
+        return PipedRDD(self, command, quiet)
+
+    def sample(self, withReplacement=False, fraction=0.1, seed=12345):
+        return SampleRDD(self, withReplacement, fraction, seed)
+
+    def union(self, *others):
+        rdds = [self]
+        for o in others:
+            rdds.extend(o.rdds if isinstance(o, UnionRDD) else [o])
+        return UnionRDD(self.ctx, rdds)
+
+    def __add__(self, other):
+        return self.union(other)
+
+    def zip(self, other):
+        return ZippedRDD(self.ctx, [self, other])
+
+    def zipWithIndex(self):
+        counts = list(self.ctx.runJob(self, _count_iter))
+        offsets = [0]
+        for c in counts[:-1]:
+            offsets.append(offsets[-1] + c)
+        return MapPartitionsRDD(self, _ZipWithIndexFn(offsets),
+                                with_index=True)
+
+    def cartesian(self, other):
+        return CartesianRDD(self, other)
+
+    def mergeSplit(self, splitSize=None, numSplits=None):
+        """N:1 partition coalescing (reference: MergedRDD / mergeSplit)."""
+        n = len(self.splits)
+        if splitSize is None:
+            splitSize = max(1, (n + (numSplits or 1) - 1) // (numSplits or 1))
+        return MergedRDD(self, splitSize)
+
+    coalesce = mergeSplit
+
+    def distinct(self, numSplits=None):
+        return (self.map(_pair_none)
+                .reduceByKey(_keep_first, numSplits)
+                .map(_fst))
+
+    uniq = distinct
+
+    def groupBy(self, f, numSplits=None):
+        return self.keyBy(f).groupByKey(numSplits)
+
+    # ===================================================================
+    # transformations (wide — key/value)
+    # ===================================================================
+    def combineByKey(self, createCombiner, mergeValue, mergeCombiners,
+                     numSplits=None):
+        numSplits = numSplits or self.ctx.default_parallelism
+        agg = Aggregator(createCombiner, mergeValue, mergeCombiners)
+        return ShuffledRDD(self, agg, HashPartitioner(numSplits))
+
+    def reduceByKey(self, func, numSplits=None):
+        return self.combineByKey(_identity, func, func, numSplits)
+
+    def groupByKey(self, numSplits=None):
+        return self.combineByKey(_mk_list, _append, _extend, numSplits)
+
+    def partitionBy(self, partitioner):
+        """Repartition preserving duplicate keys; output partitioner is
+        retained so later cogroups are narrow."""
+        if isinstance(partitioner, int):
+            partitioner = HashPartitioner(partitioner)
+        if self.partitioner == partitioner:
+            return self
+        agg = Aggregator(_mk_list, _append, _extend)
+        shuffled = ShuffledRDD(self, agg, partitioner)
+        return FlatMappedValuesRDD(shuffled, _identity)
+
+    def sort(self, key=None, reverse=False, numSplits=None):
+        """Sort arbitrary records by key function (reference: rdd.sort)."""
+        keyed = self.keyBy(key) if key else self.map(_pair_self)
+        s = keyed.sortByKey(ascending=not reverse, numSplits=numSplits)
+        return s.map(_snd)
+
+    def sortByKey(self, ascending=True, numSplits=None, sampleSize=2000):
+        numSplits = numSplits or len(self.splits)
+        if len(self.splits) <= 1:
+            return self.mapPartitions(
+                _SortPartFn(ascending))
+        per_part = max(20, sampleSize // max(1, len(self.splits)))
+        sampled = []
+        for part in self.ctx.runJob(
+                self, _TakeSampleKeys(per_part)):
+            sampled.extend(part)
+        sampled.sort()
+        bounds = [sampled[len(sampled) * (i + 1) // numSplits]
+                  for i in range(numSplits - 1)] if sampled else []
+        # dedup bounds (heavy skew collapses ranges)
+        bounds = sorted(set(bounds))
+        part = RangePartitioner(bounds, ascending=ascending)
+        repartitioned = self.partitionBy(part)
+        return repartitioned.mapPartitions(_SortPartFn(ascending))
+
+    def cogroup(self, *others, **kw):
+        numSplits = kw.get("numSplits") or self.ctx.default_parallelism
+        rdds = [self] + list(others)
+        for p in [r.partitioner for r in rdds]:
+            if p is not None and p.num_partitions >= numSplits:
+                partitioner = p
+                break
+        else:
+            partitioner = HashPartitioner(numSplits)
+        return CoGroupedRDD(rdds, partitioner)
+
+    groupWith = cogroup
+
+    def join(self, other, numSplits=None):
+        return self.cogroup(other, numSplits=numSplits).flatMapValue(
+            _join_values)
+
+    def leftOuterJoin(self, other, numSplits=None):
+        return self.cogroup(other, numSplits=numSplits).flatMapValue(
+            _left_join_values)
+
+    def rightOuterJoin(self, other, numSplits=None):
+        return self.cogroup(other, numSplits=numSplits).flatMapValue(
+            _right_join_values)
+
+    def outerJoin(self, other, numSplits=None):
+        return self.cogroup(other, numSplits=numSplits).flatMapValue(
+            _outer_join_values)
+
+    innerJoin = join
+
+    # ===================================================================
+    # caching / checkpoint
+    # ===================================================================
+    def cache(self):
+        self.should_cache = True
+        return self
+
+    persist = cache
+
+    def unpersist(self):
+        self.should_cache = False
+        from dpark_tpu.env import env
+        if env.cache is not None and self._splits is not None:
+            env.cache.drop(self.id, len(self._splits))
+        return self
+
+    def checkpoint(self, path=None):
+        """Materialize to `path` (or ctx checkpoint dir) and truncate
+        lineage.  The reference defers materialization to the first
+        computation; here it runs immediately (both truncate lineage before
+        any later job — semantics differ only for never-computed RDDs)."""
+        if self._checkpoint_rdd is not None:
+            return self
+        if path is None:
+            base = self.ctx.checkpoint_dir
+            if base is None:
+                raise ValueError("no checkpoint dir: pass path or call "
+                                 "ctx.setCheckpointDir")
+            path = os.path.join(base, "rdd-%d" % self.id)
+        os.makedirs(path, exist_ok=True)
+        writer = MapPartitionsRDD(self, _CheckpointWriteFn(path),
+                                  with_index=True)
+        for _ in self.ctx.runJob(writer, _listify):
+            pass
+        self._checkpoint_rdd = CheckpointRDD(self.ctx, path)
+        self.dependencies = []          # lineage truncation
+        return self
+
+    # ===================================================================
+    # actions
+    # ===================================================================
+    def collect(self):
+        return list(itertools.chain.from_iterable(
+            self.ctx.runJob(self, _listify)))
+
+    def collectAsMap(self):
+        return dict(itertools.chain.from_iterable(
+            self.ctx.runJob(self, _listify)))
+
+    def iterate(self):
+        """Stream results partition-by-partition without materializing all
+        (generator action)."""
+        for part in self.ctx.runJob(self, _listify):
+            yield from part
+
+    def count(self):
+        return sum(self.ctx.runJob(self, _count_iter))
+
+    def reduce(self, f):
+        parts = [r for r in self.ctx.runJob(self, _PartReduce(f))
+                 if r is not _EMPTY]
+        if not parts:
+            raise ValueError("reduce of empty RDD")
+        out = parts[0]
+        for p in parts[1:]:
+            out = f(out, p)
+        return out
+
+    def fold(self, zero, f):
+        out = zero
+        for p in self.ctx.runJob(self, _PartFold(zero, f)):
+            out = f(out, p)
+        return out
+
+    def aggregate(self, zero, seqOp, combOp):
+        out = zero
+        for p in self.ctx.runJob(self, _PartAggregate(zero, seqOp)):
+            out = combOp(out, p)
+        return out
+
+    def sum(self):
+        return sum(self.ctx.runJob(self, _sum_iter))
+
+    def take(self, n):
+        if n <= 0:
+            return []
+        out = []
+        nsplits = len(self.splits)
+        p = 0
+        while len(out) < n and p < nsplits:
+            # geometric ramp-up of partitions per round (reference: take)
+            batch = list(range(p, min(nsplits, p + max(1, p))))
+            need = n - len(out)
+            for part in self.ctx.runJob(self, _TakeN(need), batch,
+                                        allow_local=(p == 0)):
+                out.extend(part[:n - len(out)])
+                if len(out) >= n:
+                    break
+            p = batch[-1] + 1
+        return out
+
+    def first(self):
+        items = self.take(1)
+        if not items:
+            raise ValueError("empty RDD")
+        return items[0]
+
+    def top(self, n=10, key=None, reverse=False):
+        parts = list(self.ctx.runJob(
+            self, _TopN(n, key, smallest=reverse)))
+        allv = list(itertools.chain.from_iterable(parts))
+        if reverse:
+            return heapq.nsmallest(n, allv, key)
+        return heapq.nlargest(n, allv, key)
+
+    def hot(self, n=10, numSplits=None):
+        """Top-n (value, count) pairs (reference: rdd.hot via HotCounter)."""
+        return (self.map(_pair_one)
+                .reduceByKey(_add, numSplits)
+                .top(n, key=_snd))
+
+    def countByValue(self):
+        out = Counter()
+        for c in self.ctx.runJob(self, _count_by_value):
+            out.update(c)
+        return dict(out)
+
+    def countByKey(self):
+        return self.map(_fst).countByValue()
+
+    def lookup(self, key):
+        if self.partitioner is not None:
+            pid = self.partitioner.get_partition(key)
+            results = list(self.ctx.runJob(
+                self, _LookupKey(key), [pid], allow_local=True))
+            return results[0] if results else []
+        return self.filter(_KeyEquals(key)).map(_snd).collect()
+
+    def foreach(self, f):
+        for _ in self.ctx.runJob(self, _ForeachFn(f)):
+            pass
+
+    def foreachPartition(self, f):
+        for _ in self.ctx.runJob(self, f):
+            pass
+
+    def enumeratePartition(self):
+        return self.mapPartitionsWithIndex(_enum_partition)
+
+    # -- output sinks ----------------------------------------------------
+    def saveAsTextFile(self, path, ext="", overwrite=True, compress=False):
+        return OutputTextFileRDD(self, path, ext, overwrite,
+                                 compress).collect()
+
+    def saveAsTextFileByKey(self, path, ext="", overwrite=True):
+        """Records are (key, line); each key gets its own subdirectory
+        (reference: MultiOutputTextFileRDD)."""
+        return MultiOutputTextFileRDD(self, path, overwrite, ext).collect()
+
+    def saveAsCSVFile(self, path, overwrite=True, dialect="excel"):
+        return OutputCSVFileRDD(self, path, overwrite, dialect).collect()
+
+    def saveAsBinaryFile(self, path, fmt, overwrite=True):
+        return OutputBinaryFileRDD(self, path, fmt, overwrite).collect()
+
+    def saveAsPickleFile(self, path, overwrite=True):
+        return OutputPickleFileRDD(self, path, overwrite).collect()
+
+    def saveAsTableFile(self, path, overwrite=True):
+        return OutputPickleFileRDD(self, path, overwrite).collect()
+
+
+_EMPTY = object()
+
+
+# --------------------------------------------------------------------------
+# picklable per-partition functors used by actions
+# --------------------------------------------------------------------------
+
+def _listify(it):
+    return list(it)
+
+
+def _count_iter(it):
+    n = 0
+    for _ in it:
+        n += 1
+    return n
+
+
+def _sum_iter(it):
+    return sum(it)
+
+
+def _count_by_value(it):
+    return Counter(it)
+
+
+def _pair_none(x):
+    return (x, None)
+
+
+def _pair_one(x):
+    return (x, 1)
+
+
+def _pair_self(x):
+    return (x, x)
+
+
+def _enum_partition(i, it):
+    for x in it:
+        yield (i, x)
+
+
+def _join_values(groups):
+    a, b = groups
+    return [(x, y) for x in a for y in b]
+
+
+def _left_join_values(groups):
+    a, b = groups
+    return [(x, y) for x in a for y in (b or [None])]
+
+
+def _right_join_values(groups):
+    a, b = groups
+    return [(x, y) for x in (a or [None]) for y in b]
+
+
+def _outer_join_values(groups):
+    a, b = groups
+    return [(x, y) for x in (a or [None]) for y in (b or [None])]
+
+
+class _PartReduce:
+    def __init__(self, f):
+        self.f = f
+
+    def __call__(self, it):
+        out = _EMPTY
+        for x in it:
+            out = x if out is _EMPTY else self.f(out, x)
+        return out
+
+
+class _PartFold:
+    def __init__(self, zero, f):
+        self.zero = zero
+        self.f = f
+
+    def __call__(self, it):
+        out = pickle.loads(pickle.dumps(self.zero, -1))
+        for x in it:
+            out = self.f(out, x)
+        return out
+
+
+class _PartAggregate(_PartFold):
+    pass
+
+
+class _TakeN:
+    def __init__(self, n):
+        self.n = n
+
+    def __call__(self, it):
+        return list(itertools.islice(it, self.n))
+
+
+class _TopN:
+    def __init__(self, n, key, smallest=False):
+        self.n = n
+        self.key = key
+        self.smallest = smallest
+
+    def __call__(self, it):
+        if self.smallest:
+            return heapq.nsmallest(self.n, it, self.key)
+        return heapq.nlargest(self.n, it, self.key)
+
+
+class _LookupKey:
+    def __init__(self, key):
+        self.key = key
+
+    def __call__(self, it):
+        return [v for k, v in it if k == self.key]
+
+
+class _KeyEquals:
+    def __init__(self, key):
+        self.key = key
+
+    def __call__(self, kv):
+        return kv[0] == self.key
+
+
+class _ForeachFn:
+    def __init__(self, f):
+        self.f = f
+
+    def __call__(self, it):
+        for x in it:
+            self.f(x)
+
+
+class _SortPartFn:
+    def __init__(self, ascending):
+        self.ascending = ascending
+
+    def __call__(self, it):
+        return iter(sorted(it, key=_fst, reverse=not self.ascending))
+
+
+class _TakeSampleKeys:
+    def __init__(self, n):
+        self.n = n
+
+    def __call__(self, it):
+        return [k for k, _ in itertools.islice(it, self.n)]
+
+
+class _ZipWithIndexFn:
+    def __init__(self, offsets):
+        self.offsets = offsets
+
+    def __call__(self, i, it):
+        return ((x, j) for j, x in enumerate(it, self.offsets[i]))
+
+
+class _CheckpointWriteFn:
+    def __init__(self, path):
+        self.path = path
+
+    def __call__(self, i, it):
+        target = os.path.join(self.path, "part-%05d" % i)
+        with atomic_file(target) as f:
+            pickle.dump(list(it), f, -1)
+        yield target
+
+
+# --------------------------------------------------------------------------
+# narrow RDDs
+# --------------------------------------------------------------------------
+
+class DerivedRDD(RDD):
+    """One-parent narrow RDD; shares the parent's splits."""
+
+    def __init__(self, prev):
+        super().__init__(prev.ctx)
+        self.prev = prev
+        self.dependencies = [OneToOneDependency(prev)]
+
+    def _make_splits(self):
+        return self.prev.splits
+
+    def preferred_locations(self, split):
+        return self.prev.preferred_locations(split)
+
+
+class MappedRDD(DerivedRDD):
+    def __init__(self, prev, f):
+        super().__init__(prev)
+        self.f = f
+
+    def compute(self, split):
+        return map(self.f, self.prev.iterator(split))
+
+
+class FlatMappedRDD(DerivedRDD):
+    def __init__(self, prev, f):
+        super().__init__(prev)
+        self.f = f
+
+    def compute(self, split):
+        for x in self.prev.iterator(split):
+            yield from self.f(x)
+
+
+class FilteredRDD(DerivedRDD):
+    def __init__(self, prev, f):
+        super().__init__(prev)
+        self.f = f
+
+    def compute(self, split):
+        return filter(self.f, self.prev.iterator(split))
+
+
+class GlommedRDD(DerivedRDD):
+    def compute(self, split):
+        yield list(self.prev.iterator(split))
+
+
+class MapPartitionsRDD(DerivedRDD):
+    def __init__(self, prev, f, with_index=False):
+        super().__init__(prev)
+        self.f = f
+        self.with_index = with_index
+
+    def compute(self, split):
+        if self.with_index:
+            return self.f(split.index, self.prev.iterator(split))
+        return self.f(self.prev.iterator(split))
+
+
+class MappedValuesRDD(DerivedRDD):
+    def __init__(self, prev, f):
+        super().__init__(prev)
+        self.f = f
+        self.partitioner = prev.partitioner
+
+    def compute(self, split):
+        f = self.f
+        return ((k, f(v)) for k, v in self.prev.iterator(split))
+
+
+class FlatMappedValuesRDD(DerivedRDD):
+    def __init__(self, prev, f):
+        super().__init__(prev)
+        self.f = f
+        self.partitioner = prev.partitioner
+
+    def compute(self, split):
+        for k, v in self.prev.iterator(split):
+            for vv in self.f(v):
+                yield (k, vv)
+
+
+class KeyedRDD(DerivedRDD):
+    def __init__(self, prev, f):
+        super().__init__(prev)
+        self.f = f
+
+    def compute(self, split):
+        f = self.f
+        return ((f(x), x) for x in self.prev.iterator(split))
+
+
+class PipedRDD(DerivedRDD):
+    """Bridge each partition through a shell command's stdin/stdout
+    (reference: PipedRDD)."""
+
+    def __init__(self, prev, command, quiet=True):
+        super().__init__(prev)
+        self.command = command
+        self.quiet = quiet
+
+    def compute(self, split):
+        cmd = self.command
+        shell = isinstance(cmd, str)
+        proc = subprocess.Popen(
+            cmd, shell=shell, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL if self.quiet else None)
+
+        import threading
+
+        def feed():
+            try:
+                for line in self.prev.iterator(split):
+                    if not isinstance(line, (bytes, bytearray)):
+                        line = str(line).encode()
+                    if not line.endswith(b"\n"):
+                        line += b"\n"
+                    proc.stdin.write(line)
+                proc.stdin.close()
+            except (BrokenPipeError, ValueError):
+                pass
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        try:
+            for line in proc.stdout:
+                yield line.rstrip(b"\n").decode("utf-8", "replace")
+            rc = proc.wait()
+            if rc != 0:
+                raise RuntimeError("piped command %r exited with %d"
+                                   % (cmd, rc))
+            t.join()
+        finally:
+            # abandoned generator (e.g. take): reap the child and unblock
+            # the feeder regardless of how far the consumer read
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            try:
+                proc.stdin.close()
+            except OSError:
+                pass
+            proc.stdout.close()
+
+
+class SampleRDD(DerivedRDD):
+    def __init__(self, prev, withReplacement, fraction, seed):
+        super().__init__(prev)
+        self.withReplacement = withReplacement
+        self.fraction = fraction
+        self.seed = seed
+
+    def compute(self, split):
+        rng = random.Random(self.seed ^ split.index)
+        if self.withReplacement:
+            items = list(self.prev.iterator(split))
+            n = int(len(items) * self.fraction + 0.5)
+            for _ in range(n):
+                yield rng.choice(items) if items else None
+        else:
+            frac = self.fraction
+            for x in self.prev.iterator(split):
+                if rng.random() < frac:
+                    yield x
+
+
+class UnionSplit(Split):
+    def __init__(self, index, rdd_index, parent_split):
+        super().__init__(index)
+        self.rdd_index = rdd_index
+        self.parent_split = parent_split
+
+
+class UnionRDD(RDD):
+    def __init__(self, ctx, rdds):
+        super().__init__(ctx)
+        self.rdds = rdds
+        pos = 0
+        for r in rdds:
+            self.dependencies.append(
+                RangeDependency(r, 0, pos, len(r.splits)))
+            pos += len(r.splits)
+
+    def _make_splits(self):
+        out = []
+        for ri, r in enumerate(self.rdds):
+            for sp in r.splits:
+                out.append(UnionSplit(len(out), ri, sp))
+        return out
+
+    def compute(self, split):
+        return self.rdds[split.rdd_index].iterator(split.parent_split)
+
+    def preferred_locations(self, split):
+        return self.rdds[split.rdd_index].preferred_locations(
+            split.parent_split)
+
+
+class SliceSplit(Split):
+    def __init__(self, index, parent_split):
+        super().__init__(index)
+        self.parent_split = parent_split
+
+
+class SliceRDD(RDD):
+    """A contiguous subset of the parent's partitions (backs take)."""
+
+    def __init__(self, prev, start, end):
+        super().__init__(prev.ctx)
+        self.prev = prev
+        self.start = start
+        self.end = end
+        self.dependencies = [RangeDependency(prev, start, 0, end - start)]
+
+    def _make_splits(self):
+        return [SliceSplit(i, sp) for i, sp in
+                enumerate(self.prev.splits[self.start:self.end])]
+
+    def compute(self, split):
+        return self.prev.iterator(split.parent_split)
+
+
+class MergedSplit(Split):
+    def __init__(self, index, parent_splits):
+        super().__init__(index)
+        self.parent_splits = parent_splits
+
+
+class MergedRDD(RDD):
+    """Coalesce `split_size` parent partitions into one (no shuffle)."""
+
+    def __init__(self, prev, split_size):
+        super().__init__(prev.ctx)
+        self.prev = prev
+        self.split_size = split_size
+        n = len(prev.splits)
+        self._n_out = (n + split_size - 1) // split_size
+        self.dependencies = [_MergedDependency(prev, split_size, n)]
+
+    def _make_splits(self):
+        ss = self.split_size
+        ps = self.prev.splits
+        return [MergedSplit(i, ps[i * ss:(i + 1) * ss])
+                for i in range(self._n_out)]
+
+    def compute(self, split):
+        for sp in split.parent_splits:
+            yield from self.prev.iterator(sp)
+
+
+class _MergedDependency(RangeDependency):
+    def __init__(self, rdd, split_size, n_parent):
+        super().__init__(rdd, 0, 0, n_parent)
+        self.split_size = split_size
+
+    def get_parents(self, pid):
+        return list(range(pid * self.split_size,
+                          min((pid + 1) * self.split_size, self.length)))
+
+
+class ZippedSplit(Split):
+    def __init__(self, index, parent_splits):
+        super().__init__(index)
+        self.parent_splits = parent_splits
+
+
+class ZippedRDD(RDD):
+    def __init__(self, ctx, rdds):
+        if len({len(r.splits) for r in rdds}) != 1:
+            raise ValueError("zip: all RDDs must have the same number of "
+                             "splits")
+        super().__init__(ctx)
+        self.rdds = rdds
+        self.dependencies = [OneToOneDependency(r) for r in rdds]
+
+    def _make_splits(self):
+        return [ZippedSplit(i, [r.splits[i] for r in self.rdds])
+                for i in range(len(self.rdds[0].splits))]
+
+    def compute(self, split):
+        return zip(*[r.iterator(sp)
+                     for r, sp in zip(self.rdds, split.parent_splits)])
+
+
+class CartesianSplit(Split):
+    def __init__(self, index, s1, s2):
+        super().__init__(index)
+        self.s1 = s1
+        self.s2 = s2
+
+
+class CartesianRDD(RDD):
+    def __init__(self, rdd1, rdd2):
+        super().__init__(rdd1.ctx)
+        self.rdd1 = rdd1
+        self.rdd2 = rdd2
+        self.n2 = len(rdd2.splits)
+        self.dependencies = [CartesianDependency(rdd1, 0, self.n2),
+                             CartesianDependency(rdd2, 1, self.n2)]
+
+    def _make_splits(self):
+        out = []
+        for s1 in self.rdd1.splits:
+            for s2 in self.rdd2.splits:
+                out.append(CartesianSplit(len(out), s1, s2))
+        return out
+
+    def compute(self, split):
+        right = list(self.rdd2.iterator(split.s2))
+        for x in self.rdd1.iterator(split.s1):
+            for y in right:
+                yield (x, y)
+
+
+# --------------------------------------------------------------------------
+# wide RDDs
+# --------------------------------------------------------------------------
+
+class ShuffledSplit(Split):
+    pass
+
+
+class ShuffledRDD(RDD):
+    """Reduce side of a hash shuffle (reference: ShuffledRDD).  compute()
+    fetches every map output bucket for its partition and merges combiners;
+    the TPU backend replaces this with all_to_all + segment-reduce."""
+
+    def __init__(self, parent, aggregator, partitioner):
+        super().__init__(parent.ctx)
+        self.parent = parent
+        self.aggregator = aggregator
+        self.partitioner = partitioner
+        self.dep = ShuffleDependency(parent, aggregator, partitioner)
+        self.dependencies = [self.dep]
+
+    def _make_splits(self):
+        return [ShuffledSplit(i)
+                for i in range(self.partitioner.num_partitions)]
+
+    def compute(self, split):
+        from dpark_tpu import conf
+        from dpark_tpu.env import env
+        from dpark_tpu.shuffle import DiskSpillMerger, SortMerger
+        if conf.SORT_SHUFFLE:
+            merger = SortMerger(self.aggregator)
+        else:
+            merger = DiskSpillMerger(self.aggregator)
+        env.shuffle_fetcher.fetch(self.dep.shuffle_id, split.index,
+                                  merger.merge)
+        return iter(merger)
+
+
+class CoGroupSplit(Split):
+    def __init__(self, index, narrow_splits):
+        super().__init__(index)
+        # narrow_splits: list of (src_index, parent_split) for co-partitioned
+        # parents; shuffled parents are identified by dep order
+        self.narrow_splits = narrow_splits
+
+
+class CoGroupedRDD(RDD):
+    """key -> tuple of value-lists, one per parent (reference:
+    CoGroupedRDD + CoGroupSplit; backs cogroup/join/groupWith)."""
+
+    def __init__(self, rdds, partitioner):
+        super().__init__(rdds[0].ctx)
+        self.rdds = rdds
+        self.partitioner = partitioner
+        self._dep_kinds = []        # ("narrow", rdd) | ("shuffle", dep)
+        agg = Aggregator(_mk_list, _append, _extend)
+        for r in rdds:
+            if r.partitioner == partitioner:
+                self.dependencies.append(OneToOneDependency(r))
+                self._dep_kinds.append(("narrow", r))
+            else:
+                dep = ShuffleDependency(r, agg, partitioner)
+                self.dependencies.append(dep)
+                self._dep_kinds.append(("shuffle", dep))
+
+    def _make_splits(self):
+        out = []
+        for i in range(self.partitioner.num_partitions):
+            narrow = []
+            for si, (kind, obj) in enumerate(self._dep_kinds):
+                if kind == "narrow":
+                    narrow.append((si, obj.splits[i]))
+            out.append(CoGroupSplit(i, narrow))
+        return out
+
+    def compute(self, split):
+        from dpark_tpu.env import env
+        from dpark_tpu.shuffle import CoGroupMerger
+        merger = CoGroupMerger(len(self.rdds))
+        narrow = dict((si, sp) for si, sp in split.narrow_splits)
+        for si, (kind, obj) in enumerate(self._dep_kinds):
+            if kind == "narrow":
+                merger.append(si, self.rdds[si].iterator(narrow[si]))
+            else:
+                env.shuffle_fetcher.fetch(
+                    obj.shuffle_id, split.index,
+                    _CoGroupExtend(merger, si))
+        return iter(merger)
+
+
+class _CoGroupExtend:
+    def __init__(self, merger, si):
+        self.merger = merger
+        self.si = si
+
+    def __call__(self, items):
+        self.merger.extend(self.si, items)
+
+
+# --------------------------------------------------------------------------
+# source RDDs
+# --------------------------------------------------------------------------
+
+class ParallelSplit(Split):
+    def __init__(self, index, values):
+        super().__init__(index)
+        self.values = values
+
+
+class ParallelCollection(RDD):
+    """In-memory sequence split into `num_slices` (reference:
+    ParallelCollection from ctx.parallelize)."""
+
+    def __init__(self, ctx, seq, num_slices=None):
+        super().__init__(ctx)
+        seq = list(seq)
+        n = num_slices or ctx.default_parallelism
+        n = max(1, min(n, len(seq)) if seq else 1)
+        self._slices = [seq[len(seq) * i // n: len(seq) * (i + 1) // n]
+                        for i in range(n)]
+
+    def _make_splits(self):
+        return [ParallelSplit(i, s) for i, s in enumerate(self._slices)]
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["_slices"] = None         # data rides in each task's split
+        return d
+
+    def compute(self, split):
+        return iter(split.values)
+
+
+class TextSplit(Split):
+    def __init__(self, index, path, begin, end):
+        super().__init__(index)
+        self.path = path
+        self.begin = begin
+        self.end = end
+
+
+DEFAULT_BLOCK = 64 << 20
+
+
+class TextFileRDD(RDD):
+    """Newline-aligned byte-range splits of one file or a directory tree
+    (reference: TextFileRDD, 64MB blocks)."""
+
+    def __init__(self, ctx, path, numSplits=None, splitSize=None):
+        super().__init__(ctx)
+        self.path = path
+        files = self._expand(path)
+        total = sum(sz for _, sz in files)
+        if splitSize is None:
+            if numSplits:
+                splitSize = max(1, total // numSplits) or 1
+            else:
+                splitSize = DEFAULT_BLOCK
+        self._file_splits = []
+        for p, sz in files:
+            off = 0
+            while off < sz or (sz == 0 and off == 0):
+                end = min(off + splitSize, sz)
+                self._file_splits.append((p, off, end))
+                off = end
+                if sz == 0:
+                    break
+
+    @staticmethod
+    def _expand(path):
+        if os.path.isdir(path):
+            out = []
+            for root, _, names in os.walk(path):
+                for n in sorted(names):
+                    if n.startswith("."):
+                        continue
+                    p = os.path.join(root, n)
+                    out.append((p, os.path.getsize(p)))
+            return out
+        return [(path, os.path.getsize(path))]
+
+    def _make_splits(self):
+        return [TextSplit(i, p, b, e)
+                for i, (p, b, e) in enumerate(self._file_splits)]
+
+    def compute(self, split):
+        with open(split.path, "rb") as f:
+            if split.begin > 0:
+                f.seek(split.begin - 1)
+                byte = f.read(1)
+                if byte != b"\n":
+                    f.readline()        # skip the partial first line
+            while f.tell() <= split.end:
+                line = f.readline()
+                if not line:
+                    break
+                start = f.tell() - len(line)
+                if start >= split.end:
+                    break
+                yield line.rstrip(b"\r\n").decode("utf-8", "replace")
+
+
+class PartialTextFileRDD(TextFileRDD):
+    """Byte-range restricted text file (reference: partialTextFile)."""
+
+    def __init__(self, ctx, path, begin, end, splitSize=None):
+        RDD.__init__(self, ctx)
+        self.path = path
+        splitSize = splitSize or DEFAULT_BLOCK
+        self._file_splits = []
+        off = begin
+        while off < end:
+            e = min(off + splitSize, end)
+            self._file_splits.append((path, off, e))
+            off = e
+
+
+class WholeFileSplit(Split):
+    def __init__(self, index, path):
+        super().__init__(index)
+        self.path = path
+
+
+class GZipFileRDD(RDD):
+    """One split per .gz member file (gzip streams are not block-splittable
+    without an index; the reference scans deflate blocks [M] — here
+    correctness first, parallelism across files)."""
+
+    def __init__(self, ctx, path):
+        super().__init__(ctx)
+        self.paths = [p for p, _ in TextFileRDD._expand(path)]
+
+    def _make_splits(self):
+        return [WholeFileSplit(i, p) for i, p in enumerate(self.paths)]
+
+    def compute(self, split):
+        with _gzip.open(split.path, "rb") as f:
+            for line in f:
+                yield line.rstrip(b"\r\n").decode("utf-8", "replace")
+
+
+class BZip2FileRDD(GZipFileRDD):
+    def compute(self, split):
+        with _bz2.open(split.path, "rb") as f:
+            for line in f:
+                yield line.rstrip(b"\r\n").decode("utf-8", "replace")
+
+
+class CSVReaderRDD(RDD):
+    def __init__(self, text_rdd, dialect="excel"):
+        super().__init__(text_rdd.ctx)
+        self.prev = text_rdd
+        self.dialect = dialect
+        self.dependencies = [OneToOneDependency(text_rdd)]
+
+    def _make_splits(self):
+        return self.prev.splits
+
+    def compute(self, split):
+        return _csv.reader(self.prev.iterator(split), self.dialect)
+
+
+class BinarySplit(Split):
+    def __init__(self, index, path, begin, end):
+        super().__init__(index)
+        self.path = path
+        self.begin = begin
+        self.end = end
+
+
+class BinaryFileRDD(RDD):
+    """Fixed-size records via a struct format (reference: BinaryFileRDD)."""
+
+    def __init__(self, ctx, path, fmt="I", length=None, numSplits=None):
+        super().__init__(ctx)
+        self.path = path
+        self.fmt = fmt
+        self.record_size = length or struct.calcsize(fmt)
+        size = os.path.getsize(path)
+        nrec = size // self.record_size
+        n = numSplits or ctx.default_parallelism
+        n = max(1, min(n, nrec) if nrec else 1)
+        self._ranges = []
+        per = (nrec + n - 1) // n if nrec else 0
+        for i in range(n):
+            b = i * per * self.record_size
+            e = min((i + 1) * per, nrec) * self.record_size
+            if b < e or (i == 0 and nrec == 0):
+                self._ranges.append((b, e))
+
+    def _make_splits(self):
+        return [BinarySplit(i, self.path, b, e)
+                for i, (b, e) in enumerate(self._ranges)]
+
+    def compute(self, split):
+        rs = self.record_size
+        with open(split.path, "rb") as f:
+            f.seek(split.begin)
+            remaining = split.end - split.begin
+            while remaining > 0:
+                buf = f.read(min(remaining, rs * 4096))
+                if not buf:
+                    break
+                remaining -= len(buf)
+                for off in range(0, len(buf) - rs + 1, rs):
+                    if self.fmt:
+                        yield struct.unpack_from(self.fmt, buf, off)
+                    else:
+                        yield buf[off:off + rs]
+
+
+class CheckpointSplit(Split):
+    def __init__(self, index, path):
+        super().__init__(index)
+        self.path = path
+
+
+class CheckpointRDD(RDD):
+    """Reads materialized partitions; replaces lineage after checkpoint()
+    (reference: CheckpointRDD)."""
+
+    def __init__(self, ctx, path):
+        super().__init__(ctx)
+        self.path = path
+        self.files = sorted(
+            f for f in os.listdir(path) if f.startswith("part-"))
+
+    def _make_splits(self):
+        return [CheckpointSplit(i, os.path.join(self.path, f))
+                for i, f in enumerate(self.files)]
+
+    def compute(self, split):
+        with open(split.path, "rb") as f:
+            return iter(pickle.load(f))
+
+
+# --------------------------------------------------------------------------
+# sink RDDs (atomic tmp+rename part files; reference: OutputTextFileRDD etc.)
+# --------------------------------------------------------------------------
+
+class OutputRDDBase(DerivedRDD):
+    def __init__(self, prev, path, overwrite=True, ext=""):
+        super().__init__(prev)
+        path = os.path.abspath(path)
+        if os.path.exists(path) and not os.path.isdir(path):
+            raise ValueError("output path %s is a file" % path)
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.overwrite = overwrite
+        self.ext = ext
+
+    def _target(self, split):
+        return os.path.join(self.path,
+                            "part-%05d%s" % (split.index, self.ext))
+
+    def compute(self, split):
+        target = self._target(split)
+        if os.path.exists(target) and not self.overwrite:
+            yield target
+            return
+        have_data = False
+        with atomic_file(target, self._mode()) as f:
+            have_data = self._write(f, self.prev.iterator(split))
+        if have_data:
+            yield target
+        else:
+            os.unlink(target)
+
+    def _mode(self):
+        return "wb"
+
+    def _write(self, f, it):
+        raise NotImplementedError
+
+
+class OutputTextFileRDD(OutputRDDBase):
+    def __init__(self, prev, path, ext="", overwrite=True, compress=False):
+        if compress and not ext:
+            ext = ".gz"
+        super().__init__(prev, path, overwrite, ext)
+        self.compress = compress
+
+    def _write(self, f, it):
+        if self.compress:
+            f = _gzip.GzipFile(fileobj=f, mode="wb")
+        have = False
+        for line in it:
+            if not isinstance(line, (bytes, bytearray)):
+                line = str(line).encode("utf-8")
+            f.write(line)
+            if not line.endswith(b"\n"):
+                f.write(b"\n")
+            have = True
+        if self.compress:
+            f.close()
+        return have
+
+
+class OutputCSVFileRDD(OutputRDDBase):
+    def __init__(self, prev, path, overwrite=True, dialect="excel"):
+        super().__init__(prev, path, overwrite, ".csv")
+        self.dialect = dialect
+
+    def _mode(self):
+        return "w"
+
+    def _write(self, f, it):
+        w = _csv.writer(f, self.dialect)
+        have = False
+        for row in it:
+            w.writerow(row if isinstance(row, (list, tuple)) else [row])
+            have = True
+        return have
+
+
+class OutputBinaryFileRDD(OutputRDDBase):
+    def __init__(self, prev, path, fmt, overwrite=True):
+        super().__init__(prev, path, overwrite, ".bin")
+        self.fmt = fmt
+
+    def _write(self, f, it):
+        have = False
+        for rec in it:
+            if isinstance(rec, tuple):
+                f.write(struct.pack(self.fmt, *rec))
+            else:
+                f.write(struct.pack(self.fmt, rec))
+            have = True
+        return have
+
+
+class OutputPickleFileRDD(OutputRDDBase):
+    def _write(self, f, it):
+        items = list(it)
+        pickle.dump(items, f, -1)
+        return True
+
+
+class MultiOutputTextFileRDD(OutputRDDBase):
+    """saveAsTextFileByKey: records are (key, line); each key gets its own
+    subdirectory (reference: MultiOutputTextFileRDD [M])."""
+
+    def compute(self, split):
+        files = {}
+        try:
+            for k, line in self.prev.iterator(split):
+                f = files.get(k)
+                if f is None:
+                    d = os.path.join(self.path, str(k))
+                    os.makedirs(d, exist_ok=True)
+                    f = open(os.path.join(
+                        d, "part-%05d%s" % (split.index, self.ext)), "wb")
+                    files[k] = f
+                if not isinstance(line, (bytes, bytearray)):
+                    line = str(line).encode("utf-8")
+                f.write(line)
+                if not line.endswith(b"\n"):
+                    f.write(b"\n")
+        finally:
+            for f in files.values():
+                f.close()
+        yield from (f.name for f in files.values())

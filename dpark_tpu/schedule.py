@@ -1,0 +1,370 @@
+"""DAG scheduler + masters (local, process; tpu lives in backend/tpu).
+
+Reference parity: dpark/schedule.py — Stage (cut at ShuffleDependency
+edges), DAGScheduler.runJob as a generator yielding per-partition results,
+newStage/getParentStages/getMissingParentStages/submitStage/
+submitMissingTasks/taskEnded, FetchFailed -> parent stage resubmit;
+LocalScheduler and MultiProcessScheduler masters (SURVEY.md sections 2.1,
+3.1, 5.3).  The MesosScheduler has no TPU-era equivalent; multi-host
+dispatch belongs to the DCN layer (see backend/).
+"""
+
+import multiprocessing
+import pickle
+import queue
+import traceback
+
+import sys
+
+from dpark_tpu import conf, serialize
+
+
+def _submodule(name):
+    """Resolve a dpark_tpu submodule even when a convenience function in
+    dpark_tpu/__init__ shadows the package attribute of the same name."""
+    import importlib
+    return importlib.import_module("dpark_tpu." + name)
+
+
+accumulator = _submodule("accumulator")
+from dpark_tpu.dependency import ShuffleDependency
+from dpark_tpu.env import env
+from dpark_tpu.shuffle import FetchFailed
+from dpark_tpu.task import ResultTask, ShuffleMapTask
+from dpark_tpu.utils.log import Progress, get_logger
+
+logger = get_logger("schedule")
+
+
+class Stage:
+    _next_id = [0]
+
+    def __init__(self, rdd, shuffle_dep, parents):
+        Stage._next_id[0] += 1
+        self.id = Stage._next_id[0]
+        self.rdd = rdd
+        self.shuffle_dep = shuffle_dep          # None for a result stage
+        self.parents = parents
+        self.num_partitions = len(rdd.splits)
+        # per-map-partition output URI when this is a shuffle map stage
+        self.output_locs = [None] * self.num_partitions
+
+    @property
+    def is_shuffle_map(self):
+        return self.shuffle_dep is not None
+
+    @property
+    def is_available(self):
+        if not self.is_shuffle_map:
+            return False
+        return all(loc is not None for loc in self.output_locs)
+
+    def add_output_loc(self, partition, uri):
+        self.output_locs[partition] = uri
+
+    def remove_outputs_by_uri(self, uri):
+        for i, loc in enumerate(self.output_locs):
+            if loc == uri:
+                self.output_locs[i] = None
+
+    def __repr__(self):
+        return "<Stage %d on %r>" % (self.id, self.rdd)
+
+
+class DAGScheduler:
+    """Walks the RDD dependency graph bottom-up, running stages whose
+    parents are available; master-specific subclasses implement
+    submit_tasks()."""
+
+    def __init__(self):
+        self.shuffle_to_stage = {}
+        self.started = False
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        self.started = True
+
+    def stop(self):
+        self.started = False
+
+    # -- stage graph -----------------------------------------------------
+    def new_stage(self, rdd, shuffle_dep):
+        return Stage(rdd, shuffle_dep, self.get_parent_stages(rdd))
+
+    def get_shuffle_map_stage(self, dep):
+        stage = self.shuffle_to_stage.get(dep.shuffle_id)
+        if stage is None:
+            stage = self.new_stage(dep.rdd, dep)
+            self.shuffle_to_stage[dep.shuffle_id] = stage
+        return stage
+
+    def get_parent_stages(self, rdd):
+        parents = []
+        visited = set()
+
+        def visit(r):
+            if r.id in visited:
+                return
+            visited.add(r.id)
+            for dep in r.dependencies:
+                if isinstance(dep, ShuffleDependency):
+                    stage = self.get_shuffle_map_stage(dep)
+                    if stage not in parents:
+                        parents.append(stage)
+                else:
+                    visit(dep.rdd)
+        visit(rdd)
+        return parents
+
+    def get_missing_parent_stages(self, stage):
+        return [p for p in stage.parents if not p.is_available]
+
+    # -- the job loop ----------------------------------------------------
+    def run_job(self, final_rdd, func, partitions=None, allow_local=False):
+        """Generator yielding per-partition results IN PARTITION ORDER
+        (buffering completions that arrive early)."""
+        if partitions is None:
+            partitions = list(range(len(final_rdd.splits)))
+        if not partitions:
+            return
+        # allowLocal fast path (reference: runJob allowLocal) — single
+        # partition, no shuffle parents: compute inline, no tasks.
+        final_stage = self.new_stage(final_rdd, None)
+        if (allow_local and len(partitions) == 1 and not final_stage.parents):
+            yield func(final_rdd.iterator(final_rdd.splits[partitions[0]]))
+            return
+
+        output_parts = list(partitions)
+        part_index = {p: i for i, p in enumerate(output_parts)}
+        finished = [False] * len(output_parts)
+        results = [None] * len(output_parts)
+        num_finished = 0
+        next_to_yield = 0
+
+        # job-scoped event queue: tasks submitted by THIS job report here,
+        # so a generator abandoned mid-iteration (take/iterate) can never
+        # leak its late completions into a subsequent job's loop
+        events = queue.Queue()
+
+        def report(task, status, payload):
+            events.put((task, status, payload))
+
+        waiting = set()         # stages blocked on parents
+        running = set()         # stages with submitted tasks
+        pending_tasks = {}      # stage -> set of partition ids not yet done
+        failures = {}           # task partition retry counters per stage
+        progress = Progress(final_rdd.scope_name, len(output_parts))
+
+        stage_of = {}
+
+        def submit_stage(stage):
+            stage_of[stage.id] = stage
+            if stage in waiting or stage in running:
+                return
+            missing = self.get_missing_parent_stages(stage)
+            if not missing:
+                submit_missing_tasks(stage)
+                running.add(stage)
+            else:
+                waiting.add(stage)
+                for p in missing:
+                    submit_stage(p)
+
+        def submit_missing_tasks(stage):
+            tasks = []
+            if stage.is_shuffle_map:
+                for p in range(stage.num_partitions):
+                    if stage.output_locs[p] is None:
+                        tasks.append(ShuffleMapTask(
+                            stage.id, stage.rdd, stage.shuffle_dep, p))
+            else:
+                for p in output_parts:
+                    if not finished[part_index[p]]:
+                        tasks.append(ResultTask(
+                            stage.id, final_rdd, func, p, part_index[p]))
+            pending_tasks.setdefault(stage, set()).update(
+                t.partition for t in tasks)
+            logger.debug("submit stage %s with %d tasks", stage, len(tasks))
+            self.submit_tasks(stage, tasks, report)
+
+        submit_stage(final_stage)
+
+        while num_finished < len(output_parts):
+            task, status, payload = events.get()
+            stage = stage_of.get(task.stage_id)
+            if status == "success":
+                result, acc_updates = payload
+                accumulator.merge_on_driver(acc_updates)
+                if isinstance(task, ResultTask):
+                    idx = task.output_id
+                    if not finished[idx]:
+                        finished[idx] = True
+                        results[idx] = result
+                        num_finished += 1
+                        progress.tick()
+                    while (next_to_yield < len(output_parts)
+                           and finished[next_to_yield]):
+                        yield results[next_to_yield]
+                        results[next_to_yield] = None
+                        next_to_yield += 1
+                else:
+                    stage.add_output_loc(task.partition, result)
+                    pend = pending_tasks.get(stage)
+                    if pend is not None:
+                        pend.discard(task.partition)
+                    if stage.is_available:
+                        env.map_output_tracker.register_outputs(
+                            stage.shuffle_dep.shuffle_id, stage.output_locs)
+                        running.discard(stage)
+                        # wake children whose parents are now all ready
+                        for child in list(waiting):
+                            if not self.get_missing_parent_stages(child):
+                                waiting.discard(child)
+                                submit_missing_tasks(child)
+                                running.add(child)
+            elif status == "fetch_failed":
+                e = payload
+                parent = self.shuffle_to_stage.get(e.shuffle_id)
+                logger.warning("fetch failed on %s; resubmitting parent %s",
+                               stage, parent)
+                if parent is not None:
+                    if e.map_id >= 0:
+                        parent.output_locs[e.map_id] = None
+                    elif e.uri:
+                        parent.remove_outputs_by_uri(e.uri)
+                    env.map_output_tracker.register_outputs(
+                        e.shuffle_id,
+                        [None] * len(parent.output_locs))
+                    running.discard(stage)
+                    waiting.add(stage)
+                    submit_stage(parent)
+            else:       # failure
+                key = (task.stage_id, task.partition)
+                failures[key] = failures.get(key, 0) + 1
+                if failures[key] >= conf.MAX_TASK_FAILURES:
+                    raise RuntimeError(
+                        "task for partition %d of stage %d failed %d times; "
+                        "last error:\n%s" % (task.partition, task.stage_id,
+                                             failures[key], payload))
+                logger.warning("task %r failed (try %d): %s",
+                               task, failures[key], str(payload)[:200])
+                task.tried += 1
+                self.submit_tasks(stage, [task], report)
+
+    # -- master-specific -------------------------------------------------
+    def submit_tasks(self, stage, tasks, report):
+        """Run tasks and call report(task, status, payload) for each."""
+        raise NotImplementedError
+
+    def default_parallelism(self):
+        return 2
+
+
+def _run_task_inline(task):
+    accumulator.start_task()
+    try:
+        result = task.run(task.tried)
+        updates = accumulator.finish_task()
+        return "success", (result, updates)
+    except FetchFailed as e:
+        accumulator.finish_task()
+        return "fetch_failed", e
+    except Exception:
+        accumulator.finish_task()
+        return "failed", traceback.format_exc()
+
+
+class LocalScheduler(DAGScheduler):
+    """Single-threaded in-process master — the golden model every other
+    backend is tested against (SURVEY.md section 4)."""
+
+    def __init__(self, threads=1):
+        super().__init__()
+
+    def submit_tasks(self, stage, tasks, report):
+        for task in tasks:
+            status, payload = _run_task_inline(task)
+            report(task, status, payload)
+
+    def default_parallelism(self):
+        return 2
+
+
+def _process_worker(task_bytes, snapshot, environ):
+    """Runs in a forked pool worker; returns result bytes (our serializer,
+    so arbitrary user values survive the trip back)."""
+    env.start(is_master=False, environ=environ)
+    env.map_output_tracker.update(snapshot)
+    try:
+        task = serialize.loads(task_bytes)
+    except Exception:
+        return pickle.dumps(("failed", traceback.format_exc()), -1)
+    status, payload = _run_task_inline(task)
+    try:
+        return serialize.dumps((status, payload))
+    except Exception:
+        if status == "success":
+            return pickle.dumps(
+                ("failed", "unserializable task result:\n" +
+                 traceback.format_exc()), -1)
+        return pickle.dumps(("failed", repr(payload)), -1)
+
+
+class MultiProcessScheduler(DAGScheduler):
+    """Fork-pool master (reference: -m process).  Exercises the full
+    serialize/ship/track path and is the CPU baseline for benchmarks."""
+
+    def __init__(self, threads=None):
+        super().__init__()
+        self.num_workers = threads or multiprocessing.cpu_count()
+        self.pool = None
+
+    def start(self):
+        super().start()
+        if self.pool is None:
+            ctx = multiprocessing.get_context("fork")
+            self.pool = ctx.Pool(self.num_workers)
+
+    def stop(self):
+        super().stop()
+        if self.pool is not None:
+            self.pool.terminate()
+            self.pool.join()
+            self.pool = None
+
+    def _needed_shuffles(self, rdd, acc=None, visited=None):
+        acc = acc if acc is not None else set()
+        visited = visited if visited is not None else set()
+        if rdd.id in visited:
+            return acc
+        visited.add(rdd.id)
+        for dep in rdd.dependencies:
+            if isinstance(dep, ShuffleDependency):
+                acc.add(dep.shuffle_id)
+            else:
+                self._needed_shuffles(dep.rdd, acc, visited)
+        return acc
+
+    def submit_tasks(self, stage, tasks, report):
+        if self.pool is None:
+            self.start()
+        environ = env.environ_for_worker()
+        for task in tasks:
+            # exact snapshot: parent stages are complete before this point
+            snapshot = env.map_output_tracker.snapshot(
+                self._needed_shuffles(task.rdd))
+            task_bytes = serialize.dumps(task)
+
+            def on_done(result_bytes, task=task):
+                status, payload = serialize.loads(result_bytes)
+                report(task, status, payload)
+
+            def on_error(exc, task=task):
+                report(task, "failed", repr(exc))
+
+            self.pool.apply_async(
+                _process_worker, (task_bytes, snapshot, environ),
+                callback=on_done, error_callback=on_error)
+
+    def default_parallelism(self):
+        return self.num_workers

@@ -1,0 +1,150 @@
+"""Closure shipping: serialize arbitrary user functions to bytes.
+
+Reference parity: dpark/serialize.py (dump_func/load_func, dump_closure) — a
+homegrown cloudpickle that marshals code objects and recursively pickles
+closures, cells, globals and partials so any user lambda can be shipped to an
+executor (SURVEY.md section 2.1).
+
+Implementation here is an original Python-3.12 design built on
+`pickle.Pickler.reducer_override` plus the 6-tuple reduce protocol so that
+self-referential closures (f captured in f's own globals/cells) reconstruct
+correctly: the function object is created empty first, memoized, then its
+state (globals/defaults/cells) is applied by a state setter.
+"""
+
+import importlib
+import io
+import marshal
+import pickle
+import sys
+import types
+
+_BY_VALUE_MODULES = {"__main__", "__mp_main__", None}
+
+
+def _is_importable(obj, name=None):
+    """True if obj can be pickled by reference (module.qualname lookup)."""
+    mod = getattr(obj, "__module__", None)
+    if mod in _BY_VALUE_MODULES:
+        return False
+    qualname = name or getattr(obj, "__qualname__", None)
+    if qualname is None or "<locals>" in qualname:
+        return False
+    m = sys.modules.get(mod)
+    if m is None:
+        return False
+    try:
+        found = m
+        for part in qualname.split("."):
+            found = getattr(found, part)
+        return found is obj
+    except AttributeError:
+        return False
+
+
+def _iter_code_names(code):
+    """All global names referenced by a code object, including nested code."""
+    names = set(code.co_names)
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            names |= _iter_code_names(const)
+    return names
+
+
+def _make_function(code_bytes, name, qualname, module, ncells):
+    code = marshal.loads(code_bytes)
+    cells = tuple(types.CellType() for _ in range(ncells))
+    g = _shared_globals(module)
+    f = types.FunctionType(code, g, name, None, cells or None)
+    f.__qualname__ = qualname
+    f.__module__ = module
+    return f
+
+
+_globals_registry = {}
+
+
+def _shared_globals(module):
+    """One globals dict per source module name, shared by all functions we
+    reconstruct from it — mirrors normal module semantics (and the
+    reference's behaviour of rebinding into a live module dict)."""
+    if module in sys.modules and module not in _BY_VALUE_MODULES:
+        return sys.modules[module].__dict__
+    return _globals_registry.setdefault(module or "__dpark_anon__",
+                                        {"__builtins__": __builtins__})
+
+
+def _apply_function_state(f, state):
+    (glbs, defaults, kwdefaults, cellvals, fdict, annotations) = state
+    f.__globals__.update(glbs)
+    f.__defaults__ = defaults
+    f.__kwdefaults__ = kwdefaults
+    if cellvals is not None and f.__closure__ is not None:
+        for cell, (filled, v) in zip(f.__closure__, cellvals):
+            if filled:
+                cell.cell_contents = v
+    if fdict:
+        f.__dict__.update(fdict)
+    if annotations:
+        f.__annotations__ = annotations
+    return f
+
+
+def _import_module(name):
+    return importlib.import_module(name)
+
+
+class ClosurePickler(pickle.Pickler):
+    def reducer_override(self, obj):
+        if isinstance(obj, types.FunctionType) and not _is_importable(obj):
+            return self._reduce_function(obj)
+        if isinstance(obj, types.ModuleType):
+            return (_import_module, (obj.__name__,))
+        return NotImplemented
+
+    def _reduce_function(self, f):
+        code = f.__code__
+        ncells = len(f.__closure__ or ())
+        # globals subset actually referenced by the code (and nested code)
+        names = _iter_code_names(code)
+        glbs = {}
+        for n in names:
+            if n in f.__globals__:
+                glbs[n] = f.__globals__[n]
+        cellvals = None
+        if f.__closure__:
+            cellvals = []
+            for cell in f.__closure__:
+                try:
+                    cellvals.append((True, cell.cell_contents))
+                except ValueError:          # empty cell (recursive def)
+                    cellvals.append((False, None))
+        state = (glbs, f.__defaults__, f.__kwdefaults__, cellvals,
+                 dict(f.__dict__), dict(getattr(f, "__annotations__", {})))
+        args = (marshal.dumps(code), f.__name__, f.__qualname__,
+                f.__module__ or "__dpark_anon__", ncells)
+        return (_make_function, args, state, None, None,
+                _apply_function_state)
+
+
+def dumps(obj, protocol=pickle.HIGHEST_PROTOCOL):
+    buf = io.BytesIO()
+    ClosurePickler(buf, protocol).dump(obj)
+    return buf.getvalue()
+
+
+def loads(data):
+    return pickle.loads(data)
+
+
+# reference-parity aliases (dpark/serialize.py exports these names)
+dump_func = dumps
+load_func = loads
+
+
+def dump_closure(f):
+    return dumps(f)
+
+
+def load_closure(data):
+    return loads(data)

@@ -1,0 +1,276 @@
+"""Shuffle data plane: map-output bucket files, reduce-side fetch + merge.
+
+Reference parity: dpark/shuffle.py — LocalFileShuffle (bucket file layout
+under the workdir), SimpleShuffleFetcher / ParallelShuffleFetcher (per-map
+fetch + unpickle), and the Merger hierarchy (hash-dict combine, heap merge
+for the sorted path, disk-spilling external merge, CoGroupMerger)
+(SURVEY.md sections 2.1 and 3.1 hot loop #3).
+
+Single-host layout: all processes share env.workdir, so "fetch" is a local
+file read; a multi-host HTTP server can front the same layout later.  The
+TPU backend bypasses this module entirely — its shuffle is lax.all_to_all
+over ICI (backend/tpu/).
+"""
+
+import heapq
+import os
+import pickle
+import threading
+from queue import Queue
+
+from dpark_tpu import conf
+from dpark_tpu.utils import atomic_file, compress, decompress
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("shuffle")
+
+
+class LocalFileShuffle:
+    @staticmethod
+    def get_output_file(shuffle_id, map_id, reduce_id, workdir=None):
+        if workdir is None:
+            from dpark_tpu.env import env
+            workdir = env.workdir
+        d = os.path.join(workdir, "shuffle", str(shuffle_id), str(map_id))
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, str(reduce_id))
+
+    @staticmethod
+    def get_server_uri(workdir=None):
+        if workdir is None:
+            from dpark_tpu.env import env
+            workdir = env.workdir
+        return "file://" + workdir
+
+    @staticmethod
+    def write_buckets(shuffle_id, map_id, buckets):
+        """buckets: list (len = n_reduce) of dict or list of (k, combiner).
+
+        Returns the server URI advertising these outputs."""
+        for reduce_id, bucket in enumerate(buckets):
+            items = list(bucket.items()) if isinstance(bucket, dict) \
+                else list(bucket)
+            path = LocalFileShuffle.get_output_file(
+                shuffle_id, map_id, reduce_id)
+            with atomic_file(path) as f:
+                f.write(compress(pickle.dumps(items, -1)))
+        return LocalFileShuffle.get_server_uri()
+
+
+def read_bucket(uri, shuffle_id, map_id, reduce_id):
+    """Fetch one map output bucket, yielding (k, combiner) pairs."""
+    if uri.startswith("file://"):
+        workdir = uri[len("file://"):]
+        path = os.path.join(workdir, "shuffle", str(shuffle_id),
+                            str(map_id), str(reduce_id))
+        with open(path, "rb") as f:
+            return pickle.loads(decompress(f.read()))
+    raise ValueError("unsupported shuffle uri %r" % uri)
+
+
+class SimpleShuffleFetcher:
+    """Sequential fetch of every map output for one reduce partition."""
+
+    def fetch(self, shuffle_id, reduce_id, merge_func):
+        from dpark_tpu.env import env
+        locs = env.map_output_tracker.get_outputs(shuffle_id)
+        if locs is None:
+            raise FetchFailed(None, shuffle_id, -1, reduce_id)
+        for map_id, uri in enumerate(locs):
+            if uri is None:
+                raise FetchFailed(uri, shuffle_id, map_id, reduce_id)
+            try:
+                items = read_bucket(uri, shuffle_id, map_id, reduce_id)
+            except (OSError, pickle.PickleError) as e:
+                logger.warning("fetch failed %s: %s", uri, e)
+                raise FetchFailed(uri, shuffle_id, map_id, reduce_id)
+            merge_func(items)
+
+    def stop(self):
+        pass
+
+
+class ParallelShuffleFetcher(SimpleShuffleFetcher):
+    """Thread-pool fetch (reference: ParallelShuffleFetcher).  On a single
+    host file reads are fast; a small pool still overlaps decompression."""
+
+    def __init__(self, nthreads=4):
+        self.nthreads = nthreads
+
+    def fetch(self, shuffle_id, reduce_id, merge_func):
+        from dpark_tpu.env import env
+        locs = env.map_output_tracker.get_outputs(shuffle_id)
+        if locs is None:
+            raise FetchFailed(None, shuffle_id, -1, reduce_id)
+        results = Queue()
+        tasks = Queue()
+        for map_id, uri in enumerate(locs):
+            if uri is None:
+                raise FetchFailed(uri, shuffle_id, map_id, reduce_id)
+            tasks.put((map_id, uri))
+        nthreads = min(self.nthreads, tasks.qsize() or 1)
+
+        def worker():
+            while True:
+                try:
+                    map_id, uri = tasks.get_nowait()
+                except Exception:
+                    return
+                try:
+                    results.put((None,
+                                 read_bucket(uri, shuffle_id, map_id,
+                                             reduce_id)))
+                except (OSError, pickle.PickleError):
+                    results.put((FetchFailed(uri, shuffle_id, map_id,
+                                             reduce_id), None))
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(nthreads)]
+        for t in threads:
+            t.start()
+        for _ in range(len(locs)):
+            err, items = results.get()
+            if err is not None:
+                raise err
+            merge_func(items)
+
+
+class FetchFailed(Exception):
+    """Signals the DAG scheduler to resubmit the parent stage (lineage
+    recovery — SURVEY.md section 5.3)."""
+
+    def __init__(self, uri, shuffle_id, map_id, reduce_id):
+        super().__init__(uri, shuffle_id, map_id, reduce_id)
+        self.uri = uri
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.reduce_id = reduce_id
+
+
+# ---------------------------------------------------------------------------
+# Mergers (reduce side)
+# ---------------------------------------------------------------------------
+
+class Merger:
+    """Hash-dict combine of already-combined map outputs."""
+
+    def __init__(self, aggregator):
+        self.merge_combiners = aggregator.merge_combiners
+        self.combined = {}
+
+    def merge(self, items):
+        d = self.combined
+        mc = self.merge_combiners
+        for k, c in items:
+            if k in d:
+                d[k] = mc(d[k], c)
+            else:
+                d[k] = c
+
+    def __iter__(self):
+        return iter(self.combined.items())
+
+
+class SortMerger:
+    """Heap k-way merge of sorted bucket runs (reference: heap_merged)."""
+
+    def __init__(self, aggregator):
+        self.merge_combiners = aggregator.merge_combiners
+        self.runs = []
+
+    def merge(self, items):
+        self.runs.append(sorted(items, key=lambda kv: kv[0]))
+
+    def __iter__(self):
+        mc = self.merge_combiners
+        cur_key, cur_val, have = None, None, False
+        for k, v in heapq.merge(*self.runs, key=lambda kv: kv[0]):
+            if have and k == cur_key:
+                cur_val = mc(cur_val, v)
+            else:
+                if have:
+                    yield cur_key, cur_val
+                cur_key, cur_val, have = k, v, True
+        if have:
+            yield cur_key, cur_val
+
+
+class DiskSpillMerger(Merger):
+    """Memory-bounded merge: when the in-memory dict exceeds max_items the
+    sorted contents spill to a run file; final iteration heap-merges the
+    spills with the in-memory remainder (reference: external merger)."""
+
+    def __init__(self, aggregator, max_items=None, workdir=None):
+        super().__init__(aggregator)
+        self.max_items = max_items or conf.SHUFFLE_CHUNK_RECORDS * 4
+        self.workdir = workdir
+        self.spills = []
+
+    def merge(self, items):
+        super().merge(items)
+        if len(self.combined) >= self.max_items:
+            self._spill()
+
+    def _spill(self):
+        if self.workdir is None:
+            from dpark_tpu.env import env
+            self.workdir = os.path.join(env.workdir, "spill")
+        os.makedirs(self.workdir, exist_ok=True)
+        path = os.path.join(self.workdir, "run-%d-%d"
+                            % (id(self), len(self.spills)))
+        items = sorted(self.combined.items(), key=lambda kv: kv[0])
+        with atomic_file(path) as f:
+            f.write(compress(pickle.dumps(items, -1)))
+        self.spills.append(path)
+        self.combined = {}
+
+    def __iter__(self):
+        if not self.spills:
+            return iter(self.combined.items())
+        runs = [sorted(self.combined.items(), key=lambda kv: kv[0])]
+        for path in self.spills:
+            with open(path, "rb") as f:
+                runs.append(pickle.loads(decompress(f.read())))
+        mc = self.merge_combiners
+
+        def gen():
+            cur_key, cur_val, have = None, None, False
+            for k, v in heapq.merge(*runs, key=lambda kv: kv[0]):
+                if have and k == cur_key:
+                    cur_val = mc(cur_val, v)
+                else:
+                    if have:
+                        yield cur_key, cur_val
+                    cur_key, cur_val, have = k, v, True
+            if have:
+                yield cur_key, cur_val
+        return gen()
+
+
+class CoGroupMerger:
+    """Merge n sources into key -> tuple of n lists (reference:
+    CoGroupMerger backing CoGroupedRDD)."""
+
+    def __init__(self, n_sources):
+        self.n = n_sources
+        self.combined = {}
+
+    def _slot(self, key):
+        slot = self.combined.get(key)
+        if slot is None:
+            slot = tuple([] for _ in range(self.n))
+            self.combined[key] = slot
+        return slot
+
+    def append(self, src_index, items):
+        """items of (k, v) from a narrow (non-shuffled) source."""
+        for k, v in items:
+            self._slot(k)[src_index].append(v)
+
+    def extend(self, src_index, items):
+        """items of (k, list_of_v) from a shuffled source."""
+        for k, vs in items:
+            self._slot(k)[src_index].extend(vs)
+
+    def __iter__(self):
+        return iter(self.combined.items())

@@ -1,0 +1,79 @@
+"""Serializable task units shipped to executors.
+
+Reference parity: dpark/task.py — Task base, ResultTask (runs
+func(rdd.iterator(split)) and returns the value), ShuffleMapTask (partitions
+and pre-combines its input, writes one bucket per reducer, returns the map
+output location) (SURVEY.md sections 2.1 and 3.1).
+"""
+
+from dpark_tpu.shuffle import LocalFileShuffle
+
+
+class Task:
+    _next_id = [0]
+
+    def __init__(self, stage_id, partition):
+        Task._next_id[0] += 1
+        self.id = Task._next_id[0]
+        self.stage_id = stage_id
+        self.partition = partition
+        self.tried = 0
+
+    def run(self, attempt_id):
+        raise NotImplementedError
+
+    def preferred_locations(self):
+        return []
+
+
+class ResultTask(Task):
+    def __init__(self, stage_id, rdd, func, partition, output_id):
+        super().__init__(stage_id, partition)
+        self.rdd = rdd
+        self.func = func
+        self.split = rdd.splits[partition]
+        self.output_id = output_id
+
+    def run(self, attempt_id):
+        return self.func(self.rdd.iterator(self.split))
+
+    def preferred_locations(self):
+        return self.rdd.preferred_locations(self.split)
+
+    def __repr__(self):
+        return "<ResultTask(%d) of %r part%d>" % (
+            self.id, self.rdd, self.partition)
+
+
+class ShuffleMapTask(Task):
+    def __init__(self, stage_id, rdd, shuffle_dep, partition):
+        super().__init__(stage_id, partition)
+        self.rdd = rdd
+        self.shuffle_dep = shuffle_dep
+        self.split = rdd.splits[partition]
+
+    def run(self, attempt_id):
+        dep = self.shuffle_dep
+        agg = dep.aggregator
+        get_partition = dep.partitioner.get_partition
+        n = dep.partitioner.num_partitions
+        buckets = [{} for _ in range(n)]
+        create, merge = agg.create_combiner, agg.merge_value
+        # HOT LOOP (reference 3.1 #2): per-record hash + dict combine.  On
+        # the TPU backend this loop is replaced by device-side
+        # sort+segment_sum (backend/tpu/), this path serves local/process.
+        for k, v in self.rdd.iterator(self.split):
+            b = buckets[get_partition(k)]
+            if k in b:
+                b[k] = merge(b[k], v)
+            else:
+                b[k] = create(v)
+        return LocalFileShuffle.write_buckets(
+            dep.shuffle_id, self.partition, buckets)
+
+    def preferred_locations(self):
+        return self.rdd.preferred_locations(self.split)
+
+    def __repr__(self):
+        return "<ShuffleMapTask(%d) of %r part%d>" % (
+            self.id, self.rdd, self.partition)

@@ -1,0 +1,77 @@
+"""Shared helpers: compression codec selection, atomic file writes, call-site
+extraction for job naming.
+
+Reference parity: dpark/utils/__init__.py (codec selection lz4-else-zlib),
+dpark/utils/atomic_file.py (tmp+rename), dpark/utils/frame.py (call-site
+scope names).  SURVEY.md section 2.1.
+"""
+
+import os
+import sys
+import zlib
+import tempfile
+import contextlib
+
+try:
+    import lz4.frame as _lz4
+
+    def compress(data):
+        return _lz4.compress(data)
+
+    def decompress(data):
+        return _lz4.decompress(data)
+
+    CODEC = "lz4"
+except ImportError:
+    def compress(data):
+        return zlib.compress(data, 1)
+
+    def decompress(data):
+        return zlib.decompress(data)
+
+    CODEC = "zlib"
+
+
+@contextlib.contextmanager
+def atomic_file(path, mode="wb"):
+    """Write to a temp file in the same dir, fsync, rename over `path`.
+
+    Reference parity: dpark/utils/atomic_file.py.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-" + os.path.basename(path))
+    f = os.fdopen(fd, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.rename(tmp, path)
+    except BaseException:
+        f.close()
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def user_call_site(depth_limit=12):
+    """Return 'file:lineno' of the first stack frame outside dpark_tpu.
+
+    Used for job/stage naming so progress lines read like user code.
+    Reference parity: dpark/utils/frame.py.
+    """
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    frame = sys._getframe(1)
+    for _ in range(depth_limit):
+        if frame is None:
+            break
+        fn = frame.f_code.co_filename
+        if not os.path.abspath(fn).startswith(pkg_dir):
+            return "%s:%d" % (os.path.basename(fn), frame.f_lineno)
+        frame = frame.f_back
+    return "<unknown>"
+
+
+def izip(*its):
+    return zip(*its)

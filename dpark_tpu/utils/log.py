@@ -1,0 +1,74 @@
+"""Colored logging + per-stage progress reporting.
+
+Reference parity: dpark/utils/log.py (init_dpark_logger, tty progress bar).
+SURVEY.md section 2.1 / 5.5.
+"""
+
+import os
+import sys
+import logging
+
+_COLORS = {
+    logging.DEBUG: "\x1b[36m",
+    logging.INFO: "\x1b[32m",
+    logging.WARNING: "\x1b[33m",
+    logging.ERROR: "\x1b[31m",
+    logging.CRITICAL: "\x1b[35m",
+}
+_RESET = "\x1b[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record):
+        s = super().format(record)
+        if sys.stderr.isatty():
+            c = _COLORS.get(record.levelno, "")
+            return c + s + _RESET
+        return s
+
+
+_initialized = False
+
+
+def init_dpark_logger(level=None):
+    global _initialized
+    if _initialized:
+        return
+    _initialized = True
+    if level is None:
+        level = os.environ.get("DPARK_LOG_LEVEL", "WARNING")
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(_ColorFormatter(
+        "%(asctime)s [%(levelname)s] [%(name)s] %(message)s", "%H:%M:%S"))
+    root = logging.getLogger("dpark_tpu")
+    root.addHandler(h)
+    root.setLevel(level)
+
+
+def get_logger(name):
+    init_dpark_logger()
+    return logging.getLogger("dpark_tpu." + name)
+
+
+class Progress:
+    """One-line tty progress bar per stage (reference: dpark/utils/log.py)."""
+
+    def __init__(self, title, total):
+        self.title = title
+        self.total = max(total, 1)
+        self.done = 0
+        self.enabled = sys.stderr.isatty() and os.environ.get(
+            "DPARK_PROGRESS", "1") != "0"
+
+    def tick(self, n=1):
+        self.done += n
+        if not self.enabled:
+            return
+        width = 30
+        filled = int(width * self.done / self.total)
+        bar = "=" * filled + " " * (width - filled)
+        sys.stderr.write("\r%s [%s] %d/%d" %
+                         (self.title, bar, self.done, self.total))
+        if self.done >= self.total:
+            sys.stderr.write("\n")
+        sys.stderr.flush()

@@ -1,0 +1,24 @@
+"""Monte-Carlo pi (reference: examples/pi.py)."""
+
+import random
+import sys
+
+from dpark_tpu import DparkContext, parse_options
+
+
+def inside(_):
+    x, y = random.random(), random.random()
+    return x * x + y * y < 1
+
+
+def main():
+    options = parse_options()
+    ctx = DparkContext(options.master)
+    n = 100000
+    count = ctx.parallelize(range(n), 10).filter(inside).count()
+    print("Pi is roughly %f" % (4.0 * count / n))
+    ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

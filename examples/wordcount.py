@@ -1,0 +1,27 @@
+"""Wordcount — the canonical dpark example (reference: examples/wordcount).
+
+Usage: python examples/wordcount.py <path> [-m local|process|tpu]
+"""
+
+import sys
+
+from dpark_tpu import DparkContext, parse_options
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    path = args[0] if args else __file__
+    options = parse_options()
+    ctx = DparkContext(options.master)
+    counts = (ctx.textFile(path)
+              .flatMap(lambda line: line.split())
+              .map(lambda w: (w, 1))
+              .reduceByKey(lambda a, b: a + b))
+    top = counts.top(10, key=lambda kv: kv[1])
+    for word, n in top:
+        print("%8d  %s" % (n, word))
+    ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

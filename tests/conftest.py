@@ -1,0 +1,48 @@
+"""Test harness: force an 8-virtual-device CPU platform BEFORE jax import so
+TPU-backend tests exercise real Mesh sharding without TPU hardware
+(SURVEY.md section 4: the local master is the golden model; every backend
+test asserts backend output == local output)."""
+
+import os
+import shutil
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DPARK_PROGRESS", "0")
+
+import pytest
+
+
+@pytest.fixture()
+def ctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("local")
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def pctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("process:4")
+    yield c
+    c.stop()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_env(tmp_path_factory):
+    """Each test gets its own workdir; the env singleton is reset."""
+    from dpark_tpu.env import env
+    import dpark_tpu.context as context_mod
+    was = env.started
+    env.stop()
+    env.__init__()
+    env.start(is_master=True,
+              environ={"DPARK_WORKDIR":
+                       str(tmp_path_factory.mktemp("dpark-work"))})
+    yield
+    env.stop()
+    env.__init__()

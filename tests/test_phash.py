@@ -1,0 +1,36 @@
+"""portable_hash consistency: host Python vs device jnp (and later C++)."""
+
+import numpy as np
+
+from dpark_tpu.utils.phash import portable_hash, phash_device
+
+
+def test_basic_types_deterministic():
+    assert portable_hash(None) == portable_hash(None)
+    assert portable_hash(42) == portable_hash(42)
+    assert portable_hash("abc") == portable_hash("abc")
+    assert portable_hash(b"abc") == portable_hash("abc")
+    assert portable_hash((1, "a")) == portable_hash((1, "a"))
+    assert portable_hash(1.0) == portable_hash(1)
+    assert portable_hash(True) == portable_hash(1)
+
+
+def test_distribution():
+    n = 64
+    buckets = [0] * n
+    for i in range(10000):
+        buckets[portable_hash(i) % n] += 1
+    assert max(buckets) < 2.0 * 10000 / n
+
+
+def test_host_device_agree():
+    keys = np.array([0, 1, 2, -1, -2, 123456, -123456, 2**31 - 1,
+                     -(2**31)], dtype=np.int32)
+    dev = np.asarray(phash_device(keys))
+    host = np.array([portable_hash(int(k)) for k in keys], dtype=np.uint64)
+    assert (dev.astype(np.uint64) == host).all()
+
+
+def test_tuple_and_str_spread():
+    hs = {portable_hash(("word", i)) for i in range(1000)}
+    assert len(hs) == 1000

@@ -1,0 +1,72 @@
+"""Same RDD semantics through the fork-pool process master — exercises
+closure shipping, map-output snapshots and cross-process shuffle files
+(reference style: test bodies re-run with -m process, SURVEY.md section 4).
+"""
+
+
+def test_collect_map(pctx):
+    r = pctx.parallelize(range(100), 8)
+    assert r.map(lambda x: x * 3).collect() == [x * 3 for x in range(100)]
+
+
+def test_shuffle_reduce_by_key(pctx):
+    pairs = [(i % 7, i) for i in range(1000)]
+    got = dict(pctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 4).collect())
+    expect = {}
+    for k, v in pairs:
+        expect[k] = expect.get(k, 0) + v
+    assert got == expect
+
+
+def test_closure_capture_across_process(pctx):
+    base = 1000
+
+    def shift(x):
+        return x + base
+    assert pctx.parallelize([1, 2, 3], 3).map(shift).collect() == [
+        1001, 1002, 1003]
+
+
+def test_accumulator_across_process(pctx):
+    acc = pctx.accumulator(0)
+    pctx.parallelize(range(50), 5).foreach(lambda x: acc.add(1))
+    assert acc.value == 50
+
+
+def test_broadcast_across_process(pctx):
+    pctx.start()
+    b = pctx.broadcast(list(range(100)))
+    got = pctx.parallelize([0, 50, 99], 3).map(lambda i: b.value[i])
+    assert got.collect() == [0, 50, 99]
+
+
+def test_join_across_process(pctx):
+    a = pctx.parallelize([("x", 1), ("y", 2)], 2)
+    b = pctx.parallelize([("x", "u"), ("z", "w")], 2)
+    assert a.join(b, 2).collect() == [("x", (1, "u"))]
+
+
+def test_sort_across_process(pctx):
+    import random
+    rng = random.Random(3)
+    data = [(rng.randint(0, 100), i) for i in range(200)]
+    got = pctx.parallelize(data, 6).sortByKey(numSplits=3).collect()
+    assert [k for k, _ in got] == sorted(k for k, _ in data)
+
+
+def test_task_error_propagates(pctx):
+    import pytest
+    r = pctx.parallelize(range(4), 2).map(lambda x: 1 // (x - 2))
+    with pytest.raises(RuntimeError):
+        r.collect()
+
+
+def test_multi_stage_process(pctx):
+    got = dict(
+        pctx.parallelize([(i % 5, 1) for i in range(500)], 8)
+        .reduceByKey(lambda a, b: a + b, 4)
+        .map(lambda kv: (kv[0] % 2, kv[1]))
+        .reduceByKey(lambda a, b: a + b, 2)
+        .collect())
+    assert sum(got.values()) == 500

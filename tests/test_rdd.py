@@ -1,0 +1,307 @@
+"""RDD semantics through the local DAG scheduler — the anchor test file
+(reference: tests/test_rdd.py, SURVEY.md section 4)."""
+
+import os
+
+import pytest
+
+
+def test_parallelize_collect(ctx):
+    assert ctx.parallelize(range(10), 3).collect() == list(range(10))
+    assert ctx.makeRDD([1, 2, 3]).collect() == [1, 2, 3]
+    assert ctx.parallelize([], 3).collect() == []
+
+
+def test_map_filter_flatmap(ctx):
+    r = ctx.parallelize(range(10), 4)
+    assert r.map(lambda x: x * 2).collect() == [x * 2 for x in range(10)]
+    assert r.filter(lambda x: x % 2 == 0).collect() == [0, 2, 4, 6, 8]
+    assert r.flatMap(lambda x: [x, -x]).count() == 20
+
+
+def test_glom_mappartitions(ctx):
+    r = ctx.parallelize(range(8), 4)
+    assert [len(g) for g in r.glom().collect()] == [2, 2, 2, 2]
+    assert r.mapPartitions(lambda it: [sum(it)]).collect() == [1, 5, 9, 13]
+    got = r.mapPartitionsWithIndex(lambda i, it: [(i, sum(it))]).collect()
+    assert got == [(0, 1), (1, 5), (2, 9), (3, 13)]
+
+
+def test_reduce_fold_aggregate(ctx):
+    r = ctx.parallelize(range(1, 101), 7)
+    assert r.reduce(lambda a, b: a + b) == 5050
+    assert r.fold(0, lambda a, b: a + b) == 5050
+    assert r.aggregate(0, lambda a, x: a + 1, lambda a, b: a + b) == 100
+    assert r.sum() == 5050
+    assert r.count() == 100
+
+
+def test_take_first_top(ctx):
+    r = ctx.parallelize(range(100), 10)
+    assert r.take(5) == [0, 1, 2, 3, 4]
+    assert r.take(25) == list(range(25))
+    assert r.first() == 0
+    assert r.top(3) == [99, 98, 97]
+    assert r.top(3, reverse=True) == [0, 1, 2]
+    assert r.top(2, key=lambda x: -x) == [0, 1]
+
+
+def test_reduce_by_key(ctx):
+    pairs = [("a", 1), ("b", 2), ("a", 3), ("c", 4), ("b", 5)]
+    r = ctx.parallelize(pairs, 3)
+    got = dict(r.reduceByKey(lambda a, b: a + b).collect())
+    assert got == {"a": 4, "b": 7, "c": 4}
+
+
+def test_group_by_key(ctx):
+    pairs = [("a", 1), ("b", 2), ("a", 3)]
+    got = dict(ctx.parallelize(pairs, 2).groupByKey().collect())
+    assert sorted(got["a"]) == [1, 3]
+    assert got["b"] == [2]
+
+
+def test_combine_by_key_asymmetric(ctx):
+    pairs = [("a", 1), ("a", 2), ("b", 3)]
+    got = dict(ctx.parallelize(pairs, 2).combineByKey(
+        lambda v: [v], lambda c, v: c + [v], lambda c1, c2: c1 + c2,
+        2).collect())
+    assert sorted(got["a"]) == [1, 2]
+
+
+def test_distinct_groupby_keyby(ctx):
+    r = ctx.parallelize([1, 2, 2, 3, 3, 3], 3)
+    assert sorted(r.distinct().collect()) == [1, 2, 3]
+    g = dict(ctx.parallelize(range(10), 3).groupBy(lambda x: x % 2)
+             .collect())
+    assert sorted(g[0]) == [0, 2, 4, 6, 8]
+    kb = ctx.parallelize(["aa", "b"], 2).keyBy(len).collect()
+    assert kb == [(2, "aa"), (1, "b")]
+
+
+def test_union_zip(ctx):
+    a = ctx.parallelize([1, 2], 2)
+    b = ctx.parallelize([3, 4], 2)
+    assert (a + b).collect() == [1, 2, 3, 4]
+    assert ctx.parallelize(range(4), 2).zip(
+        ctx.parallelize("abcd", 2)).collect() == [
+            (0, "a"), (1, "b"), (2, "c"), (3, "d")]
+
+
+def test_zip_with_index(ctx):
+    r = ctx.parallelize("abcdef", 3)
+    assert r.zipWithIndex().collect() == [
+        ("a", 0), ("b", 1), ("c", 2), ("d", 3), ("e", 4), ("f", 5)]
+
+
+def test_cartesian(ctx):
+    got = ctx.parallelize([1, 2], 2).cartesian(
+        ctx.parallelize("ab", 2)).collect()
+    assert sorted(got) == [(1, "a"), (1, "b"), (2, "a"), (2, "b")]
+
+
+def test_merge_split(ctx):
+    r = ctx.parallelize(range(10), 5).mergeSplit(2)
+    assert len(r.splits) == 3
+    assert r.collect() == list(range(10))
+
+
+def test_sort_by_key(ctx):
+    import random
+    rng = random.Random(42)
+    pairs = [(rng.randint(0, 1000), i) for i in range(500)]
+    r = ctx.parallelize(pairs, 5)
+    got = r.sortByKey(numSplits=4).collect()
+    assert [k for k, _ in got] == sorted(k for k, _ in pairs)
+    got_desc = r.sortByKey(ascending=False, numSplits=3).collect()
+    assert [k for k, _ in got_desc] == sorted(
+        (k for k, _ in pairs), reverse=True)
+
+
+def test_sort_plain(ctx):
+    r = ctx.parallelize([5, 3, 1, 4, 2], 3)
+    assert r.sort().collect() == [1, 2, 3, 4, 5]
+    assert r.sort(reverse=True).collect() == [5, 4, 3, 2, 1]
+    assert r.sort(key=lambda x: -x).collect() == [5, 4, 3, 2, 1]
+
+
+def test_join_family(ctx):
+    a = ctx.parallelize([("a", 1), ("b", 2), ("c", 3)], 2)
+    b = ctx.parallelize([("a", "x"), ("a", "y"), ("d", "z")], 2)
+    assert sorted(a.join(b).collect()) == [("a", (1, "x")), ("a", (1, "y"))]
+    lo = dict(a.leftOuterJoin(b).collect())
+    assert lo["b"] == (2, None)
+    ro = sorted(a.rightOuterJoin(b).collect())
+    assert ("d", (None, "z")) in ro
+    oo = dict(a.outerJoin(b).collect())
+    assert oo["b"] == (2, None) and oo["d"] == (None, "z")
+
+
+def test_cogroup_copartitioned_narrow(ctx):
+    a = ctx.parallelize([(i, i) for i in range(10)], 2).partitionBy(4)
+    b = ctx.parallelize([(i, i * 2) for i in range(10)], 3).partitionBy(4)
+    got = dict(a.cogroup(b, numSplits=4).collect())
+    assert got[3] == ([3], [6])
+
+
+def test_partition_by_preserves_duplicates(ctx):
+    pairs = [("k", i) for i in range(10)]
+    r = ctx.parallelize(pairs, 3).partitionBy(4)
+    assert sorted(v for _, v in r.collect()) == list(range(10))
+
+
+def test_count_by_value_key(ctx):
+    r = ctx.parallelize(["a", "b", "a", "c", "a"], 3)
+    assert r.countByValue() == {"a": 3, "b": 1, "c": 1}
+    p = ctx.parallelize([("x", 1), ("y", 2), ("x", 3)], 2)
+    assert p.countByKey() == {"x": 2, "y": 1}
+
+
+def test_lookup(ctx):
+    r = ctx.parallelize([(i, i * i) for i in range(20)], 4).partitionBy(4)
+    assert r.lookup(7) == [49]
+    r2 = ctx.parallelize([("a", 1), ("a", 2)], 2)
+    assert sorted(r2.lookup("a")) == [1, 2]
+
+
+def test_sample(ctx):
+    r = ctx.parallelize(range(1000), 4)
+    s = r.sample(False, 0.1, seed=7).collect()
+    assert 40 < len(s) < 200
+    assert set(s) <= set(range(1000))
+
+
+def test_accumulator(ctx):
+    acc = ctx.accumulator(0)
+    ctx.parallelize(range(100), 5).foreach(lambda x: acc.add(x))
+    assert acc.value == 4950
+
+
+def test_broadcast(ctx):
+    ctx.start()
+    b = ctx.broadcast({"x": 42})
+    got = ctx.parallelize(range(3), 3).map(lambda i: b.value["x"] + i)
+    assert got.collect() == [42, 43, 44]
+
+
+def test_cache(ctx):
+    calls = ctx.accumulator(0)
+    r = ctx.parallelize(range(10), 2).map(
+        lambda x: (calls.add(1), x * 2)[1]).cache()
+    assert r.collect() == [x * 2 for x in range(10)]
+    first = calls.value
+    assert r.collect() == [x * 2 for x in range(10)]
+    assert calls.value == first          # second pass served from cache
+
+
+def test_checkpoint(ctx, tmp_path):
+    r = ctx.parallelize(range(20), 4).map(lambda x: x + 1)
+    r.checkpoint(str(tmp_path / "ckpt"))
+    assert r.dependencies == []
+    assert r.collect() == list(range(1, 21))
+    assert r.reduce(lambda a, b: a + b) == 210
+
+
+def test_text_file_roundtrip(ctx, tmp_path):
+    lines = ["hello world", "foo bar", "第三行 unicode", ""] * 50
+    src = tmp_path / "in.txt"
+    src.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    r = ctx.textFile(str(src), splitSize=256)
+    assert len(r.splits) > 1
+    assert r.collect() == lines
+
+    out = tmp_path / "out"
+    ctx.parallelize(lines, 3).saveAsTextFile(str(out))
+    back = ctx.textFile(str(out)).collect()
+    assert sorted(back) == sorted(l for l in lines)
+
+
+def test_wordcount(ctx, tmp_path):
+    text = "the quick brown fox jumps over the lazy dog the fox\n" * 20
+    src = tmp_path / "wc.txt"
+    src.write_text(text)
+    counts = dict(
+        ctx.textFile(str(src), splitSize=200)
+        .flatMap(lambda line: line.split())
+        .map(lambda w: (w, 1))
+        .reduceByKey(lambda a, b: a + b)
+        .collect())
+    assert counts["the"] == 60
+    assert counts["fox"] == 40
+    assert counts["dog"] == 20
+
+
+def test_csv_roundtrip(ctx, tmp_path):
+    rows = [["a", "1"], ["b", "2"], ["c", "3"]] * 10
+    ctx.parallelize(rows, 2).saveAsCSVFile(str(tmp_path / "csv"))
+    back = ctx.csvFile(str(tmp_path / "csv")).collect()
+    assert sorted(back) == sorted(rows)
+
+
+def test_binary_roundtrip(ctx, tmp_path):
+    recs = [(i,) for i in range(1000)]
+    ctx.parallelize(recs, 3).saveAsBinaryFile(str(tmp_path / "bin"), "I")
+    files = [os.path.join(str(tmp_path / "bin"), f)
+             for f in sorted(os.listdir(str(tmp_path / "bin")))]
+    got = []
+    for f in files:
+        got.extend(ctx.binaryFile(f, "I").collect())
+    assert sorted(got) == recs
+
+
+def test_pickle_table_roundtrip(ctx, tmp_path):
+    data = [{"a": i} for i in range(50)]
+    ctx.parallelize(data, 4).saveAsTableFile(str(tmp_path / "tbl"))
+    assert ctx.tableFile(str(tmp_path / "tbl")).collect() == data
+
+
+def test_gzip_file(ctx, tmp_path):
+    import gzip
+    p = tmp_path / "x.gz"
+    with gzip.open(p, "wt") as f:
+        f.write("l1\nl2\nl3\n")
+    assert ctx.textFile(str(p)).collect() == ["l1", "l2", "l3"]
+
+
+def test_pipe(ctx):
+    r = ctx.parallelize(["c", "a", "b"], 1).pipe("sort")
+    assert r.collect() == ["a", "b", "c"]
+
+
+def test_hot(ctx):
+    data = ["a"] * 5 + ["b"] * 3 + ["c"] * 1
+    got = ctx.parallelize(data, 3).hot(2)
+    assert got == [("a", 5), ("b", 3)]
+
+
+def test_foreach_partition_and_enumerate(ctx):
+    acc = ctx.accumulator(0)
+    ctx.parallelize(range(10), 5).foreachPartition(
+        lambda it: acc.add(sum(it)))
+    assert acc.value == 45
+    parts = ctx.parallelize(range(4), 2).enumeratePartition().collect()
+    assert parts == [(0, 0), (0, 1), (1, 2), (1, 3)]
+
+
+def test_multi_stage_chain(ctx):
+    # two consecutive shuffles share the DAG correctly
+    r = (ctx.parallelize([(i % 5, i) for i in range(100)], 8)
+         .reduceByKey(lambda a, b: a + b)
+         .map(lambda kv: (kv[1] % 3, kv[0]))
+         .groupByKey(2))
+    got = dict(r.collect())
+    assert sum(len(v) for v in got.values()) == 5
+
+
+def test_empty_rdd_actions(ctx):
+    r = ctx.parallelize([], 2)
+    assert r.collect() == []
+    assert r.count() == 0
+    assert r.take(3) == []
+    with pytest.raises(ValueError):
+        r.first()
+
+
+def test_error_propagates(ctx):
+    r = ctx.parallelize(range(4), 2).map(lambda x: 1 // (x - 2))
+    with pytest.raises(RuntimeError):
+        r.collect()

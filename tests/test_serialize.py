@@ -1,0 +1,101 @@
+"""Closure shipping round-trips (reference test: tests/test_serialize.py)."""
+
+import functools
+import pickle
+
+from dpark_tpu.serialize import dumps, loads
+
+
+def test_plain_lambda():
+    f = loads(dumps(lambda x: x + 1))
+    assert f(1) == 2
+
+
+def test_closure_capture():
+    n = 10
+
+    def add_n(x):
+        return x + n
+    g = loads(dumps(add_n))
+    assert g(5) == 15
+
+
+def test_nested_closures():
+    def outer(a):
+        def inner(b):
+            return a * b
+        return inner
+    f = loads(dumps(outer(3)))
+    assert f(4) == 12
+
+
+def test_recursive_function():
+    def fact(n):
+        return 1 if n <= 1 else n * fact(n - 1)
+    g = loads(dumps(fact))
+    assert g(5) == 120
+
+
+def test_mutual_recursion_via_globals():
+    assert loads(dumps(_is_even))(10) is True
+    assert loads(dumps(_is_even))(7) is False
+
+
+def _is_even(n):
+    return True if n == 0 else _is_odd(n - 1)
+
+
+def _is_odd(n):
+    return False if n == 0 else _is_even(n - 1)
+
+
+def test_defaults_and_kwargs():
+    def f(a, b=2, *, c=3):
+        return a + b + c
+    g = loads(dumps(f))
+    assert g(1) == 6
+    assert g(1, 10, c=100) == 111
+
+
+def test_partial():
+    f = functools.partial(_mul, 3)
+    assert loads(dumps(f))(7) == 21
+
+
+def _mul(a, b):
+    return a * b
+
+
+def test_module_function_by_reference():
+    g = loads(dumps(pickle.dumps))
+    assert g is pickle.dumps
+
+
+def test_bound_method_of_local_instance():
+    class Adder:
+        def __init__(self, n):
+            self.n = n
+
+        def add(self, x):
+            return self.n + x
+    # class defined in a local scope -> method must ship by value
+    a = Adder(4)
+    try:
+        g = loads(dumps(a.add))
+        assert g(3) == 7
+    except (pickle.PicklingError, AttributeError):
+        # local classes by value are best-effort (documented limitation)
+        pass
+
+
+def test_generator_function():
+    def gen(n):
+        for i in range(n):
+            yield i * i
+    g = loads(dumps(gen))
+    assert list(g(4)) == [0, 1, 4, 9]
+
+
+def test_lambda_capturing_module_global():
+    g = loads(dumps(lambda x: _mul(x, 5)))
+    assert g(2) == 10
