@@ -1,0 +1,88 @@
+"""Benchmark: reduceByKey shuffle throughput, tpu master vs process master.
+
+Prints ONE JSON line:
+  {"metric": "reduceByKey_GBps_per_chip", "value": N, "unit": "GB/s/chip",
+   "vs_baseline": N}
+vs_baseline is the tpu-master speedup over the reference-semantics
+`-m process` CPU baseline on the same workload (BASELINE.md: the reference
+publishes no numbers; the process master IS the baseline).
+
+The process run executes FIRST, before jax is imported, so its fork pool is
+jax-free (fork after jax import can deadlock).
+"""
+
+import json
+import os
+import sys
+import time
+
+N_PAIRS = int(os.environ.get("BENCH_PAIRS", 4_000_000))
+N_KEYS = int(os.environ.get("BENCH_KEYS", 65_536))
+BYTES = N_PAIRS * 8            # two int32 columns
+
+
+def make_data():
+    # scrambled int keys, deterministic
+    mult = 2654435761
+    return [(((i * mult) & 0x7FFFFFFF) % N_KEYS, i & 0xFFFF)
+            for i in range(N_PAIRS)]
+
+
+def run_once(ctx, data, n_parts, expect_keys=None):
+    t0 = time.perf_counter()
+    r = (ctx.parallelize(data, n_parts)
+         .reduceByKey(lambda a, b: a + b, n_parts))
+    n = r.count()
+    dt = time.perf_counter() - t0
+    if expect_keys is not None:
+        assert n == expect_keys, (n, expect_keys)
+    return dt
+
+
+def bench_process(data):
+    from dpark_tpu import DparkContext
+    nproc = min(8, os.cpu_count() or 4)
+    ctx = DparkContext("process:%d" % nproc)
+    ctx.start()
+    dt = run_once(ctx, data, nproc, min(N_KEYS, N_PAIRS))
+    ctx.stop()
+    return dt
+
+
+def bench_tpu(data):
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):     # e.g. cpu mesh for CI
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import DparkContext
+    ctx = DparkContext("tpu")
+    ctx.start()
+    ndev = ctx.scheduler.executor.ndev
+    # warm-up: compile the stage programs
+    run_once(ctx, data[: max(1024, ndev * 128)], ndev)
+    best = min(run_once(ctx, data, ndev, min(N_KEYS, N_PAIRS))
+               for _ in range(3))
+    ctx.stop()
+    return best, ndev
+
+
+def main():
+    data = make_data()
+    t_proc = bench_process(data)
+    t_tpu, ndev = bench_tpu(data)
+    gbps_chip = BYTES / t_tpu / 1e9 / ndev
+    gbps_proc = BYTES / t_proc / 1e9
+    out = {
+        "metric": "reduceByKey_GBps_per_chip",
+        "value": round(gbps_chip, 4),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(t_proc / t_tpu, 2),
+    }
+    print(json.dumps(out))
+    print("# pairs=%d keys=%d chips=%d tpu=%.3fs process=%.3fs "
+          "(process=%.4f GB/s)"
+          % (N_PAIRS, N_KEYS, ndev, t_tpu, t_proc, gbps_proc),
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,331 @@
+"""JAXExecutor: compiles and runs fused stage programs over the device mesh.
+
+This replaces the reference's executor + shuffle services for the tpu
+master (dpark/executor.py, dpark/shuffle.py): partitions live in HBM as
+sharded arrays, a stage is one jitted shard_map program, and the map->reduce
+hop is a count-exchange + multi-round lax.all_to_all over ICI (SURVEY.md
+sections 2.8 and 7.1 step 5).
+
+Shuffle data written by the array path stays device-resident in
+`shuffle_store`; a host bridge exports buckets as (k, combiner) items so
+downstream host-path stages (untraceable user code) can consume them
+through the ordinary ShuffleFetcher protocol.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dpark_tpu import conf
+from dpark_tpu.backend.tpu import collectives, fuse, layout
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("tpu.executor")
+
+AXIS = conf.MESH_AXIS
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except (ImportError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+class JAXExecutor:
+    def __init__(self, devices=None):
+        # 64-bit ints on device: dpark semantics are Python ints, and a
+        # counting/summing workload must not silently wrap at 2**31
+        # (parity contract with the local master)
+        jax.config.update("jax_enable_x64", True)
+        self.mesh = layout.make_mesh(devices)
+        self.ndev = int(self.mesh.devices.size)
+        self.shuffle_store = {}       # sid -> stored map output metadata
+        self._store_order = []        # LRU for HBM eviction
+        self._store_bytes = 0
+        self._compiled = {}
+        # register the host bridge so file-path stages can read HBM shuffles
+        from dpark_tpu import shuffle as shuffle_mod
+        shuffle_mod.HBM_EXPORTERS[id(self)] = self.export_bucket
+        self._exporter_key = id(self)
+
+    # ------------------------------------------------------------------
+    # compilation
+    # ------------------------------------------------------------------
+    def _sharding(self):
+        return NamedSharding(self.mesh, P(AXIS))
+
+    def _compile_narrow(self, plan, cap, nleaves_in):
+        """Program A: (in_leaves, counts) -> ops -> result or bucketized
+        shuffle output.  Shapes are (ndev, cap, ...) sharded on dim 0."""
+        key = ("narrow", plan.program_key, cap, nleaves_in)
+        if key in self._compiled:
+            return self._compiled[key]
+        ops = plan.ops
+        epilogue = plan.epilogue
+        n_dst = self.ndev
+        merge_fn = None
+        if epilogue is not None:
+            dep = epilogue[1]
+            try:
+                nval = len(plan.out_specs) - 1
+                merge_fn = fuse._leaves_merge_fn(
+                    dep.aggregator.merge_combiners, nval)
+                structs = fuse._batched_spec_struct(plan.out_specs[1:])
+                jax.eval_shape(lambda *v: merge_fn(list(v), list(v)),
+                               *structs)
+            except Exception:
+                merge_fn = None       # exchange raw created combiners
+
+        def per_device(counts, *leaves):
+            n = counts[0]
+            lv = [l[0] for l in leaves]          # squeeze mesh dim
+            for op in ops:
+                lv, n = op.apply(lv, n)
+            if epilogue is None:
+                return (jnp.expand_dims(n, 0),) + tuple(
+                    jnp.expand_dims(l, 0) for l in lv)
+            k, vs = lv[0], lv[1:]
+            if merge_fn is not None:
+                k2, v2, cnts, offs = collectives.bucketize_combine(
+                    k, vs, n, n_dst, merge_fn)
+            else:
+                sorted_lv, cnts, offs = collectives.bucketize(
+                    k, lv, n, n_dst)
+                k2, v2 = sorted_lv[0], sorted_lv[1:]
+            out = (cnts, offs, k2) + tuple(v2)
+            return tuple(jnp.expand_dims(o, 0) for o in out)
+
+        n_out = (1 + len(plan.out_specs)) if epilogue is None \
+            else (2 + len(plan.out_specs))
+        fn = _shard_map(per_device, self.mesh,
+                        in_specs=(P(AXIS),) * (1 + nleaves_in),
+                        out_specs=(P(AXIS),) * n_out)
+        jitted = jax.jit(fn)
+        self._compiled[key] = jitted
+        return jitted
+
+    def _compile_exchange(self, dtypes, nleaves, slot, cap):
+        key = ("exchange", dtypes, nleaves, slot, cap)
+        if key in self._compiled:
+            return self._compiled[key]
+
+        def per_device(offsets, counts, sent, *leaves):
+            lv = [l[0] for l in leaves]
+            recv, recv_cnt, new_sent, overflow = collectives.exchange_round(
+                AXIS, lv, offsets[0], counts[0], sent[0], slot)
+            out = (recv_cnt, new_sent,
+                   jnp.reshape(overflow, (1,))) + tuple(recv)
+            return tuple(jnp.expand_dims(o, 0) for o in out)
+
+        fn = _shard_map(per_device, self.mesh,
+                        in_specs=(P(AXIS),) * (3 + nleaves),
+                        out_specs=(P(AXIS),) * (3 + nleaves))
+        jitted = jax.jit(fn)
+        self._compiled[key] = jitted
+        return jitted
+
+    def _compile_reduce(self, plan, rounds, slot, nleaves):
+        """Program B: (recv buffers over `rounds`, recv counts) ->
+        flatten -> segment reduce -> ops -> result or bucketize."""
+        key = ("reduce", plan.program_key, rounds, slot, nleaves)
+        if key in self._compiled:
+            return self._compiled[key]
+        dep = plan.source[1]
+        nval = len(plan.in_specs) - 1
+        merge_fn = fuse._leaves_merge_fn(
+            dep.aggregator.merge_combiners, nval)
+        ops = plan.ops
+        epilogue = plan.epilogue
+        n_dst = self.ndev
+        out_merge_fn = None
+        if epilogue is not None:
+            out_nval = len(plan.out_specs) - 1
+            try:
+                out_merge_fn = fuse._leaves_merge_fn(
+                    epilogue[1].aggregator.merge_combiners, out_nval)
+                structs = fuse._batched_spec_struct(plan.out_specs[1:])
+                jax.eval_shape(
+                    lambda *v: out_merge_fn(list(v), list(v)), *structs)
+            except Exception:
+                out_merge_fn = None
+
+        def per_device(*args):
+            cnts = [c[0] for c in args[:rounds]]
+            buf_args = args[rounds:]
+            recvs = []
+            for r in range(rounds):
+                recvs.append([buf_args[r * nleaves + li][0]
+                              for li in range(nleaves)])
+            flat, mask = collectives.flatten_received(recvs, cnts)
+            k, vs, n = collectives.segment_reduce(
+                flat[0], flat[1:], mask, merge_fn)
+            lv = [k] + list(vs)
+            for op in ops:
+                lv, n = op.apply(lv, n)
+            if epilogue is None:
+                return (jnp.expand_dims(n, 0),) + tuple(
+                    jnp.expand_dims(l, 0) for l in lv)
+            kk, vv = lv[0], lv[1:]
+            if out_merge_fn is not None:
+                k2, v2, cnts2, offs2 = collectives.bucketize_combine(
+                    kk, vv, n, n_dst, out_merge_fn)
+            else:
+                sorted_lv, cnts2, offs2 = collectives.bucketize(
+                    kk, lv, n, n_dst)
+                k2, v2 = sorted_lv[0], sorted_lv[1:]
+            out = (cnts2, offs2, k2) + tuple(v2)
+            return tuple(jnp.expand_dims(o, 0) for o in out)
+
+        n_in = rounds + rounds * nleaves
+        n_out = (1 + len(plan.out_specs)) if epilogue is None \
+            else (2 + len(plan.out_specs))
+        fn = _shard_map(per_device, self.mesh,
+                        in_specs=(P(AXIS),) * n_in,
+                        out_specs=(P(AXIS),) * n_out)
+        jitted = jax.jit(fn)
+        self._compiled[key] = jitted
+        return jitted
+
+    # ------------------------------------------------------------------
+    # running
+    # ------------------------------------------------------------------
+    def run_stage(self, plan):
+        """Execute the whole stage for all partitions at once.
+
+        Returns ("result", list_of_row_lists) or ("shuffle", sid)."""
+        if plan.source[0] == "ingest":
+            pc = plan.source[1]
+            key_leaf = 0 if plan.epilogue is not None else None
+            batch = layout.ingest(self.mesh, pc._slices, plan.in_treedef,
+                                  plan.in_specs, key_leaf=key_leaf)
+            jitted = self._compile_narrow(plan, batch.cap, len(batch.cols))
+            outs = jitted(batch.counts, *batch.cols)
+        else:
+            outs = self._run_exchange_and_reduce(plan)
+        return self._finish_stage(plan, outs)
+
+    def _finish_stage(self, plan, outs):
+        if plan.epilogue is None:
+            counts, leaves = outs[0], list(outs[1:])
+            batch = layout.Batch(plan.out_treedef, leaves, counts)
+            return ("result", layout.egest(batch))
+        dep = plan.epilogue[1]
+        cnts, offs = outs[0], outs[1]
+        leaves = list(outs[2:])
+        sid = dep.shuffle_id
+        nbytes = sum(int(l.nbytes) for l in leaves)
+        self.shuffle_store[sid] = {
+            "leaves": leaves,            # (ndev, cap, ...) dst-sorted
+            "counts": cnts,              # (ndev, R)
+            "offsets": offs,             # (ndev, R)
+            "out_treedef": plan.out_treedef,
+            "out_specs": plan.out_specs,
+            "nbytes": nbytes,
+        }
+        self._store_order.append(sid)
+        self._store_bytes += nbytes
+        self._evict(keep=sid)
+        return ("shuffle", sid)
+
+    def _evict(self, keep):
+        """LRU-evict HBM shuffle outputs beyond conf.SHUFFLE_HBM_BUDGET.
+        An evicted shuffle still registered in the map-output tracker
+        surfaces as FetchFailed -> lineage recomputes the parent stage."""
+        budget = conf.SHUFFLE_HBM_BUDGET
+        while (self._store_bytes > budget and len(self._store_order) > 1):
+            victim = self._store_order[0]
+            if victim == keep:
+                break
+            self._store_order.pop(0)
+            store = self.shuffle_store.pop(victim, None)
+            if store:
+                self._store_bytes -= store["nbytes"]
+                logger.debug("evicted HBM shuffle %d (%d bytes)",
+                             victim, store["nbytes"])
+
+    def _run_exchange_and_reduce(self, plan):
+        dep = plan.source[1]
+        store = self.shuffle_store[dep.shuffle_id]
+        if dep.shuffle_id in self._store_order:      # LRU touch
+            self._store_order.remove(dep.shuffle_id)
+            self._store_order.append(dep.shuffle_id)
+        leaves = store["leaves"]
+        counts = store["counts"]
+        offsets = store["offsets"]
+        nleaves = len(leaves)
+        cap = leaves[0].shape[1]
+        # slot sizing: 2x the mean per-(src,dst) volume, clamped to the max
+        # run length; skewed keys overflow into extra rounds
+        host_counts = np.asarray(jax.device_get(counts))
+        max_run = int(host_counts.max()) if host_counts.size else 1
+        mean = int(host_counts.sum()) // max(1, host_counts.size)
+        slot = layout.round_capacity(min(max(64, 2 * mean), max(1, max_run)))
+        exchange = self._compile_exchange(
+            tuple(str(l.dtype) for l in leaves), nleaves, slot, cap)
+        sharding = self._sharding()
+        sent = jax.device_put(
+            np.zeros((self.ndev, self.ndev), np.int32), sharding)
+        recv_rounds, cnt_rounds = [], []
+        while True:
+            outs = exchange(offsets, counts, sent, *leaves)
+            recv_cnt, sent, overflow = outs[0], outs[1], outs[2]
+            recv_rounds.append(list(outs[3:]))
+            cnt_rounds.append(recv_cnt)
+            if int(np.asarray(jax.device_get(overflow))[0]) == 0:
+                break
+            if len(recv_rounds) > 512:
+                raise RuntimeError("shuffle exchange did not converge")
+        rounds = len(recv_rounds)
+        reduce_fn = self._compile_reduce(plan, rounds, slot, nleaves)
+        args = list(cnt_rounds)
+        for r in range(rounds):
+            args.extend(recv_rounds[r])
+        return reduce_fn(*args)
+
+    # ------------------------------------------------------------------
+    # host bridge
+    # ------------------------------------------------------------------
+    def has_shuffle(self, sid):
+        return sid in self.shuffle_store
+
+    def export_bucket(self, sid, map_id, reduce_id):
+        """Device-resident map output -> host (k, combiner) items, for
+        host-path reduce stages (shuffle.read_bucket 'hbm://' uris)."""
+        store = self.shuffle_store.get(sid)
+        if store is None:
+            raise KeyError("no HBM shuffle %d" % sid)
+        counts = np.asarray(jax.device_get(store["counts"]))
+        offsets = np.asarray(jax.device_get(store["offsets"]))
+        off = int(offsets[map_id, reduce_id])
+        cnt = int(counts[map_id, reduce_id])
+        treedef = store["out_treedef"]
+        rows = []
+        if cnt:
+            mats = [np.asarray(jax.device_get(
+                lax.slice_in_dim(l, map_id, map_id + 1, axis=0)
+            ))[0, off:off + cnt] for l in store["leaves"]]
+            lists = [m.tolist() for m in mats]
+            for i in range(cnt):
+                rows.append(jax.tree_util.tree_unflatten(
+                    treedef, [pl[i] for pl in lists]))
+        return rows
+
+    def drop_shuffle(self, sid):
+        store = self.shuffle_store.pop(sid, None)
+        if store:
+            self._store_bytes -= store["nbytes"]
+            if sid in self._store_order:
+                self._store_order.remove(sid)
+
+    def stop(self):
+        from dpark_tpu import shuffle as shuffle_mod
+        shuffle_mod.HBM_EXPORTERS.pop(self._exporter_key, None)
+        self.shuffle_store.clear()

@@ -1,0 +1,293 @@
+"""Stage fusion: narrow RDD chains -> one traceable per-device program.
+
+The reference pipelines narrow dependencies as nested Python generators
+(dpark/rdd.py MappedRDD.compute etc., SURVEY.md 3.1 hot loop #1).  Here the
+same chain is *recorded* as a list of array ops and fused into a single
+function: user record-level lambdas become columnar code via jax.vmap, so
+the whole stage runs as one XLA program per device.
+
+Graceful degradation (SURVEY.md 7.2 item 1): `analyze_stage` probes every
+user function with jax.eval_shape on the record spec; anything untraceable
+(strings, data-dependent control flow, side effects) returns None and the
+scheduler falls back to the object path for that stage.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dpark_tpu.backend.tpu import layout
+from dpark_tpu.rdd import (
+    FilteredRDD, KeyedRDD, MappedRDD, MappedValuesRDD, ParallelCollection,
+    ShuffledRDD)
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("tpu.fuse")
+
+
+def _spec_struct(specs):
+    return [jax.ShapeDtypeStruct(shape, dt) for dt, shape in specs]
+
+
+def _batched_spec_struct(specs, n=4):
+    return [jax.ShapeDtypeStruct((n,) + shape, dt) for dt, shape in specs]
+
+
+def fn_key(f):
+    """Structural identity of a user function: same code + same captured
+    cell values => same compiled program.  Unhashable captures fall back to
+    object identity (no cross-run sharing, still correct)."""
+    try:
+        cells = tuple(c.cell_contents for c in (f.__closure__ or ()))
+        hash(cells)
+        return (f.__code__, cells)
+    except Exception:
+        return ("id", id(f))
+
+
+def _row_fn(f, in_treedef):
+    """Wrap a record-level user fn as leaves -> leaves with output treedef
+    discovered at trace time."""
+    def fn(*leaves):
+        rec = jax.tree_util.tree_unflatten(in_treedef, list(leaves))
+        out = f(rec)
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+        fn.out_treedef = out_treedef
+        return tuple(out_leaves)
+    return fn
+
+
+class MapOp:
+    """map / mapValue / keyBy — all are record->record functions."""
+
+    def __init__(self, f, key=None):
+        self.f = f
+        self.key = ("map", key if key is not None else fn_key(f))
+
+    def probe(self, treedef, specs):
+        fn = _row_fn(self.f, treedef)
+        out_structs = jax.eval_shape(fn, *_spec_struct(specs))
+        out_specs = [(np.dtype(s.dtype), tuple(s.shape))
+                     for s in out_structs]
+        for dt, shape in out_specs:
+            if dt == np.dtype(object):
+                raise TypeError("object dtype")
+        self._vfn = jax.vmap(fn)
+        self._out_treedef = fn.out_treedef
+        return self._out_treedef, out_specs
+
+    def apply(self, leaves, n):
+        out = self._vfn(*leaves)
+        return list(out), n
+
+
+class FilterOp:
+    def __init__(self, f, key=None):
+        self.f = f
+        self.key = ("filter", key if key is not None else fn_key(f))
+
+    def probe(self, treedef, specs):
+        fn = _row_fn(self.f, treedef)
+        out_structs = jax.eval_shape(fn, *_spec_struct(specs))
+        if (len(out_structs) != 1 or out_structs[0].shape != ()):
+            raise TypeError("filter predicate must return a scalar")
+        self._vfn = jax.vmap(fn)
+        return treedef, specs          # unchanged record type
+
+    def apply(self, leaves, n):
+        from dpark_tpu.backend.tpu import collectives
+        cap = leaves[0].shape[0]
+        (pred,) = self._vfn(*leaves)
+        mask = pred.astype(bool) & (jnp.arange(cap) < n)
+        return collectives.compact(leaves, mask)
+
+
+class StagePlan:
+    """Everything needed to run one stage on the array path."""
+
+    def __init__(self, source, ops, epilogue, in_treedef, in_specs,
+                 out_treedef, out_specs, stage):
+        self.source = source        # ("ingest", pc) | ("hbm", dep)
+        self.ops = ops
+        self.epilogue = epilogue    # None | ("shuffle_write", dep)
+        self.in_treedef = in_treedef
+        self.in_specs = in_specs
+        self.out_treedef = out_treedef
+        self.out_specs = out_specs
+        self.stage = stage
+        self.program_key = self._make_key()
+
+    def _make_key(self):
+        """Structural program identity: same ops/specs/aggregators compile
+        to the same XLA program regardless of RDD/stage ids — repeated jobs
+        (benchmark loops, DStream batches) reuse the jit cache."""
+        spec_key = tuple((str(dt), shape) for dt, shape in self.in_specs)
+        op_keys = tuple(op.key for op in self.ops)
+        if self.epilogue is None:
+            epi_key = None
+        else:
+            dep = self.epilogue[1]
+            agg = dep.aggregator
+            epi_key = ("shuffle", dep.partitioner.num_partitions,
+                       fn_key(agg.create_combiner),
+                       fn_key(agg.merge_combiners))
+        src_key = self.source[0]
+        if src_key == "hbm":
+            src_key = ("hbm",
+                       fn_key(self.source[1].aggregator.merge_combiners))
+        return (src_key, spec_key, op_keys, epi_key)
+
+
+def _mapvalue_as_record_fn(f):
+    def fn(rec):
+        return (rec[0], f(rec[1]))
+    return fn
+
+
+def _keyby_as_record_fn(f):
+    def fn(rec):
+        return (f(rec), rec)
+    return fn
+
+
+def extract_chain(top):
+    """Walk narrow one-parent links from the stage's top RDD to its source.
+    Returns (source_rdd, ops list root->top) or None."""
+    ops = []
+    cur = top
+    while True:
+        if isinstance(cur, MappedValuesRDD):
+            ops.append(MapOp(_mapvalue_as_record_fn(cur.f),
+                             ("mapvalue", fn_key(cur.f))))
+            cur = cur.prev
+        elif isinstance(cur, KeyedRDD):
+            ops.append(MapOp(_keyby_as_record_fn(cur.f),
+                             ("keyby", fn_key(cur.f))))
+            cur = cur.prev
+        elif isinstance(cur, MappedRDD):
+            ops.append(MapOp(cur.f))
+            cur = cur.prev
+        elif isinstance(cur, FilteredRDD):
+            ops.append(FilterOp(cur.f))
+            cur = cur.prev
+        elif isinstance(cur, (ParallelCollection, ShuffledRDD)):
+            ops.reverse()
+            return cur, ops
+        else:
+            return None
+
+
+def _sample_record(pc):
+    """First record of a ParallelCollection (driver-side only)."""
+    for s in pc._slices:
+        if s:
+            return s[0]
+    return None
+
+
+def _leaves_merge_fn(merge, nleaves):
+    """User merge_combiners (value, value) -> value lifted to leaf lists,
+    vmapped for use inside segment scans."""
+    def leaf_merge(*flat):
+        va = flat[:nleaves]
+        vb = flat[nleaves:]
+        out = merge(_maybe_unwrap(va), _maybe_unwrap(vb))
+        out_leaves = jax.tree_util.tree_leaves(out)
+        return tuple(out_leaves)
+
+    def _maybe_unwrap(leaves):
+        return leaves[0] if nleaves == 1 else tuple(leaves)
+
+    vfn = jax.vmap(leaf_merge)
+
+    def merged(va_leaves, vb_leaves):
+        return list(vfn(*(list(va_leaves) + list(vb_leaves))))
+    return merged
+
+
+def analyze_stage(stage, ndev, hbm_sids):
+    """Decide whether `stage` can run on the array path; build its plan.
+
+    hbm_sids: set of shuffle ids whose map outputs are HBM-resident.
+    Returns StagePlan or None (host fallback).
+    """
+    top = stage.rdd
+    extracted = extract_chain(top)
+    if extracted is None:
+        return None
+    source_rdd, ops = extracted
+
+    # -- source record spec ---------------------------------------------
+    if isinstance(source_rdd, ParallelCollection):
+        if source_rdd._slices is None or len(source_rdd._slices) != ndev:
+            return None
+        sample = _sample_record(source_rdd)
+        if sample is None:
+            return None
+        try:
+            treedef, specs = layout.record_spec(sample)
+        except (TypeError, ValueError):
+            return None
+        for dt, _ in specs:
+            if dt == np.dtype(object) or dt.kind in "USO":
+                return None
+        source = ("ingest", source_rdd)
+    elif isinstance(source_rdd, ShuffledRDD):
+        dep = source_rdd.dep
+        if dep.shuffle_id not in hbm_sids:
+            return None                  # parent shuffle lives on host
+        if dep.partitioner.num_partitions != ndev:
+            return None
+        # record spec after combine: (key, combiner) — registered by the
+        # executor when the map side ran
+        meta = hbm_sids[dep.shuffle_id]
+        treedef, specs = meta["out_treedef"], meta["out_specs"]
+        try:
+            merge_fn = _leaves_merge_fn(
+                dep.aggregator.merge_combiners, len(specs) - 1)
+            # probe merge on batched value leaves (merge is vmapped)
+            vstructs = _batched_spec_struct(specs[1:])
+            jax.eval_shape(
+                lambda *v: merge_fn(list(v), list(v)), *vstructs)
+        except Exception as e:
+            logger.debug("merge_combiners not traceable: %s", e)
+            return None
+        source = ("hbm", dep)
+    else:
+        return None
+
+    # -- probe the narrow ops -------------------------------------------
+    cur_treedef, cur_specs = treedef, specs
+    try:
+        for op in ops:
+            cur_treedef, cur_specs = op.probe(cur_treedef, cur_specs)
+    except Exception as e:
+        logger.debug("stage %s not traceable (%s); host fallback",
+                     stage, e)
+        return None
+
+    # -- epilogue --------------------------------------------------------
+    epilogue = None
+    if stage.is_shuffle_map:
+        dep = stage.shuffle_dep
+        if dep.partitioner.num_partitions != ndev:
+            return None
+        # shuffle write needs an int scalar key and a traceable
+        # create_combiner
+        if layout.key_leaf_index(cur_treedef, cur_specs) is None:
+            return None
+        create = dep.aggregator.create_combiner
+        try:
+            op = MapOp(lambda rec: (rec[0], create(rec[1])))
+            cur_treedef, cur_specs = op.probe(cur_treedef, cur_specs)
+            ops.append(op)
+        except Exception as e:
+            logger.debug("create_combiner not traceable: %s", e)
+            return None
+        if layout.key_leaf_index(cur_treedef, cur_specs) is None:
+            return None
+        epilogue = ("shuffle_write", dep)
+
+    return StagePlan(source, ops, epilogue, treedef, specs,
+                     cur_treedef, cur_specs, stage)

@@ -1,0 +1,168 @@
+"""Columnar array-partition layout for the TPU backend.
+
+The object path (dpark/rdd.py generators) represents a partition as a Python
+iterator of records.  The array path represents a *stage's worth* of
+partitions as a struct-of-arrays batch sharded over the device mesh:
+
+  * a record is a JAX pytree (e.g. ``(k, v)`` or a bare scalar);
+  * each pytree leaf becomes one column array of shape ``(ndev, cap)``
+    (+ trailing dims), sharded ``P('parts', None)`` so device d holds
+    logical partition d;
+  * ``counts`` (shape ``(ndev,)``) gives the number of valid rows per
+    device; rows past the count are padding.
+
+This is the TPU-native replacement for the reference's pickled partition
+streams (dpark/shuffle.py file buckets): data never leaves HBM between
+stages.  Reference parity anchor: SURVEY.md section 7.0 "array partitions".
+"""
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dpark_tpu import conf
+
+AXIS = conf.MESH_AXIS
+# int64 sentinel: keys must be < 2**63 - 1; ingest() rejects the sentinel
+# value itself (-> host fallback) so no real key can collide with padding
+KEY_SENTINEL = np.int64(2 ** 63 - 1)
+
+
+def make_mesh(devices=None):
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def round_capacity(n):
+    """Pad capacities to power-of-two size classes so recompilation only
+    happens when the class changes (SURVEY.md 7.2 item 5)."""
+    return max(8, 1 << math.ceil(math.log2(max(n, 1))))
+
+
+class Batch:
+    """A sharded struct-of-arrays batch: one stage's partitions in HBM."""
+
+    def __init__(self, treedef, cols, counts):
+        self.treedef = treedef          # record pytree structure
+        self.cols = list(cols)          # leaf arrays, each (ndev, cap, ...)
+        self.counts = counts            # (ndev,) int32
+        self.ndev = cols[0].shape[0]
+        self.cap = cols[0].shape[1]
+
+    def unflatten_record(self, leaves):
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+def record_spec(sample):
+    """(treedef, leaf dtypes/shapes) for a sample record."""
+    leaves, treedef = jax.tree_util.tree_flatten(sample)
+    specs = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        dt = arr.dtype
+        if dt == np.float64:
+            # device path computes in float32 (TPU-native); parity tests
+            # use allclose for float reductions (SURVEY.md 7.2 item 6)
+            dt = np.dtype(np.float32)
+        elif np.issubdtype(dt, np.integer):
+            # int64 so counting/summing workloads cannot silently wrap —
+            # exact parity with the local master's Python ints up to 2**63
+            dt = np.dtype(np.int64)
+        elif dt == np.bool_:
+            dt = np.dtype(np.bool_)
+        specs.append((dt, arr.shape))
+    return treedef, specs
+
+
+def ingest(mesh, partitions, treedef, specs, key_leaf=None):
+    """Host rows -> sharded Batch.
+
+    `partitions`: list (len == mesh size) of lists of records.  Each record
+    must match `treedef`/`specs`.  When `key_leaf` is given, that leaf is
+    checked against KEY_SENTINEL (raises ValueError -> host fallback).
+    """
+    ndev = mesh.devices.size
+    assert len(partitions) == ndev, (len(partitions), ndev)
+    counts = np.array([len(p) for p in partitions], dtype=np.int32)
+    cap = round_capacity(int(counts.max()) if len(counts) else 1)
+    cols = []
+    for li, (dt, shape) in enumerate(specs):
+        col = np.zeros((ndev, cap) + shape, dtype=dt)
+        cols.append(col)
+    flat_scalars = all(shape == () for _, shape in specs)
+    for d, part in enumerate(partitions):
+        if not part:
+            continue
+        if flat_scalars and len(specs) > 1 and isinstance(part[0], tuple) \
+                and len(part[0]) == len(specs):
+            # fast path: rows are flat tuples of scalars -> one 2D array
+            mat = np.asarray(part)
+            for li, (dt, shape) in enumerate(specs):
+                cols[li][d, :counts[d]] = mat[:, li].astype(dt)
+            continue
+        if flat_scalars and len(specs) == 1:
+            cols[0][d, :counts[d]] = np.asarray(part, dtype=specs[0][0])
+            continue
+        # general path: flatten rows to leaves column-wise
+        leaf_lists = [[] for _ in specs]
+        for rec in part:
+            leaves = jax.tree_util.tree_leaves(rec)
+            for li, leaf in enumerate(leaves):
+                leaf_lists[li].append(leaf)
+        for li, (dt, shape) in enumerate(specs):
+            cols[li][d, :counts[d]] = np.asarray(leaf_lists[li], dtype=dt)
+    if key_leaf is not None and cols[key_leaf].size:
+        if int(cols[key_leaf].max()) == int(KEY_SENTINEL):
+            raise ValueError("key equal to the device sentinel (2**63-1); "
+                             "taking the host path")
+    sharding = NamedSharding(mesh, P(AXIS))
+    dev_cols = [jax.device_put(c, sharding) for c in cols]
+    dev_counts = jax.device_put(counts, NamedSharding(mesh, P(AXIS)))
+    return Batch(treedef, dev_cols, dev_counts)
+
+
+def egest(batch):
+    """Sharded Batch -> list of per-partition row lists (host)."""
+    counts = np.asarray(jax.device_get(batch.counts))
+    host_cols = [np.asarray(jax.device_get(c)) for c in batch.cols]
+    # fast path: records that are flat tuples of scalars (or bare scalars)
+    sample = jax.tree_util.tree_unflatten(
+        batch.treedef, list(range(len(batch.cols))))
+    flat_tuple = (isinstance(sample, tuple)
+                  and all(isinstance(x, int) for x in sample)
+                  and list(sample) == list(range(len(batch.cols)))
+                  and all(c.ndim == 2 for c in host_cols))
+    bare_scalar = (len(batch.cols) == 1 and sample == 0
+                   and host_cols[0].ndim == 2)
+    out = []
+    for d in range(batch.ndev):
+        n = int(counts[d])
+        rows = []
+        if n:
+            if bare_scalar:
+                rows = host_cols[0][d, :n].tolist()
+            elif flat_tuple:
+                rows = list(zip(*[c[d, :n].tolist() for c in host_cols]))
+            else:
+                per_leaf = [c[d, :n].tolist() for c in host_cols]
+                for i in range(n):
+                    rows.append(batch.unflatten_record(
+                        [pl[i] for pl in per_leaf]))
+        out.append(rows)
+    return out
+
+
+def key_leaf_index(treedef, specs):
+    """The key of a KV record is leaf 0 of the pytree (records are
+    ``(k, v...)`` tuples); it must be an integer scalar for the device
+    shuffle.  Returns None when the record has no device-hashable key."""
+    if not specs:
+        return None
+    dt, shape = specs[0]
+    if shape != () or not np.issubdtype(dt, np.integer):
+        return None
+    return 0

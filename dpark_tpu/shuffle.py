@@ -57,8 +57,20 @@ class LocalFileShuffle:
         return LocalFileShuffle.get_server_uri()
 
 
+# device-resident shuffle outputs: the TPU executor registers an exporter
+# here so host-path stages can read HBM buckets through the same protocol
+HBM_EXPORTERS = {}
+
+
 def read_bucket(uri, shuffle_id, map_id, reduce_id):
     """Fetch one map output bucket, yielding (k, combiner) pairs."""
+    if uri.startswith("hbm://"):
+        for exporter in HBM_EXPORTERS.values():
+            try:
+                return exporter(shuffle_id, map_id, reduce_id)
+            except KeyError:
+                continue
+        raise ValueError("no exporter for %r" % uri)
     if uri.startswith("file://"):
         workdir = uri[len("file://"):]
         path = os.path.join(workdir, "shuffle", str(shuffle_id),
@@ -81,7 +93,11 @@ class SimpleShuffleFetcher:
                 raise FetchFailed(uri, shuffle_id, map_id, reduce_id)
             try:
                 items = read_bucket(uri, shuffle_id, map_id, reduce_id)
-            except (OSError, pickle.PickleError) as e:
+            except FetchFailed:
+                raise
+            except Exception as e:
+                # any read failure (missing file, evicted HBM shuffle,
+                # decode error) becomes FetchFailed -> lineage recovery
                 logger.warning("fetch failed %s: %s", uri, e)
                 raise FetchFailed(uri, shuffle_id, map_id, reduce_id)
             merge_func(items)
@@ -120,7 +136,8 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
                     results.put((None,
                                  read_bucket(uri, shuffle_id, map_id,
                                              reduce_id)))
-                except (OSError, pickle.PickleError):
+                except BaseException:
+                    # never die silently: the fetch loop counts results
                     results.put((FetchFailed(uri, shuffle_id, map_id,
                                              reduce_id), None))
 

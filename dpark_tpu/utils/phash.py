@@ -79,14 +79,21 @@ def portable_hash(obj):
 def phash_device(keys):
     """Device-side portable hash of an int array -> uint32 array.
 
-    Bit-exactly matches `portable_hash` for values in int32 range: the host
-    path computes lo = u32(k), hi = sign-extension word, and fmix32(lo ^ hi);
-    an arithmetic shift by 31 reproduces the sign word on device.
+    Bit-exactly matches `portable_hash` for any int64 value: the host path
+    computes lo = x & 0xFFFFFFFF, hi = (x >> 32) & 0xFFFFFFFF, and
+    fmix32(lo ^ hi).  For int32 inputs the hi word is the sign extension,
+    reproduced with an arithmetic shift.  Host/device agreement is what
+    makes partition assignment identical across masters (lookup,
+    partitionBy co-location).
     """
     import jax.numpy as jnp
-    k = keys.astype(jnp.int32)
-    lo = k.astype(jnp.uint32)
-    hi = (k >> 31).astype(jnp.uint32)          # 0 or 0xFFFFFFFF
+    if keys.dtype == jnp.int64:
+        lo = (keys & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = ((keys >> 32) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    else:
+        k = keys.astype(jnp.int32)
+        lo = k.astype(jnp.uint32)
+        hi = (k >> 31).astype(jnp.uint32)      # 0 or 0xFFFFFFFF
     h = lo ^ hi
     h ^= h >> 16
     h = h * jnp.uint32(_M1)

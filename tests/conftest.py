@@ -6,12 +6,22 @@ test asserts backend output == local output)."""
 import os
 import shutil
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"      # override e.g. axon tunnel
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("DPARK_PROGRESS", "0")
+
+# the environment may pre-load a TPU tunnel plugin that ignores the env
+# var; force the platform through the config API as well.  jax is optional
+# for the pure-host tests.
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 import pytest
 

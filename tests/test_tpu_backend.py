@@ -1,0 +1,191 @@
+"""TPU master parity: every test asserts `-m tpu` output == local-master
+semantics on the same program (SURVEY.md section 4 implication), running on
+the 8-virtual-CPU-device mesh from conftest."""
+
+import pytest
+
+
+@pytest.fixture()
+def tctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu")
+    c.start()
+    yield c
+    c.stop()
+
+
+def _used_array_path(tctx):
+    return len(tctx.scheduler.executor.shuffle_store) > 0
+
+
+def test_parallelize_collect_roundtrip(tctx):
+    data = list(range(100))
+    assert tctx.parallelize(data, 8).collect() == data
+
+
+def test_map_filter_fused(tctx):
+    r = tctx.parallelize(list(range(64)), 8)
+    got = r.map(lambda x: x * 3).filter(lambda x: x % 2 == 0).collect()
+    assert got == [x * 3 for x in range(64) if (x * 3) % 2 == 0]
+
+
+def test_reduce_by_key_device_shuffle(tctx):
+    pairs = [(i % 13, i) for i in range(1000)]
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    expect = {}
+    for k, v in pairs:
+        expect[k] = expect.get(k, 0) + v
+    assert got == expect
+    assert _used_array_path(tctx)
+
+
+def test_reduce_by_key_matches_local(tctx):
+    from dpark_tpu import DparkContext
+    pairs = [((i * 7919) % 101, i % 17) for i in range(5000)]
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    lctx = DparkContext("local")
+    expect = dict(lctx.parallelize(pairs, 8)
+                  .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert got == expect
+
+
+def test_negative_and_large_keys(tctx):
+    pairs = [(k, 1) for k in
+             [-1, -2, 0, 2**30, -(2**30), 7, -7] * 10]
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert got == {-1: 10, -2: 10, 0: 10, 2**30: 10,
+                   -(2**30): 10, 7: 10, -7: 10}
+
+
+def test_skewed_keys_multi_round(tctx):
+    # one dominant key forces slot overflow -> multi-round exchange
+    pairs = [(0, 1)] * 3000 + [(i, 1) for i in range(1, 50)]
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert got[0] == 3000
+    assert all(got[i] == 1 for i in range(1, 50))
+
+
+def test_map_after_shuffle(tctx):
+    pairs = [(i % 5, 1) for i in range(100)]
+    got = sorted(tctx.parallelize(pairs, 8)
+                 .reduceByKey(lambda a, b: a + b, 8)
+                 .map(lambda kv: (kv[0], kv[1] * 10)).collect())
+    assert got == [(k, 200) for k in range(5)]
+
+
+def test_chained_shuffles(tctx):
+    pairs = [(i % 10, 1) for i in range(400)]
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8)
+               .map(lambda kv: (kv[0] % 2, kv[1]))
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert got == {0: 200, 1: 200}
+
+
+def test_float_values(tctx):
+    pairs = [(i % 4, float(i) * 0.5) for i in range(100)]
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    expect = {}
+    for k, v in pairs:
+        expect[k] = expect.get(k, 0.0) + v
+    for k in expect:
+        assert abs(got[k] - expect[k]) < 1e-3
+
+
+def test_tuple_values_combine(tctx):
+    # average via (sum, count) combiners — tuple-valued records
+    pairs = [(i % 3, (i, 1)) for i in range(90)]
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: (a[0] + b[0], a[1] + b[1]), 8)
+               .collect())
+    assert got[0][1] == 30 and got[1][1] == 30 and got[2][1] == 30
+    assert sum(v[0] for v in got.values()) == sum(range(90))
+
+
+def test_untraceable_falls_back(tctx):
+    # string records cannot ride the array path; result must still be right
+    r = tctx.parallelize([("a", 1), ("b", 2), ("a", 3)], 2)
+    got = dict(r.reduceByKey(lambda a, b: a + b).collect())
+    assert got == {"a": 4, "b": 2}
+
+
+def test_side_effect_lambda_falls_back(tctx):
+    seen = []
+    r = tctx.parallelize([(1, 1), (2, 2)], 2)
+    got = dict(r.reduceByKey(lambda a, b: (seen.append(1), a + b)[1])
+               .collect())
+    assert got == {1: 1, 2: 2}
+
+
+def test_hbm_to_host_bridge(tctx):
+    """Device-written shuffle consumed by an untraceable downstream stage
+    (mapPartitions is object-path-only) — must read via the HBM bridge."""
+    pairs = [(i % 6, 1) for i in range(600)]
+    r = (tctx.parallelize(pairs, 8)
+         .reduceByKey(lambda a, b: a + b, 8)
+         .mapPartitions(lambda it: [sorted(it)]))
+    parts = r.collect()
+    flat = [kv for part in parts for kv in part]
+    assert dict(flat) == {k: 100 for k in range(6)}
+
+
+def test_count_and_take_on_device_pipeline(tctx):
+    r = tctx.parallelize([(i % 11, 1) for i in range(800)], 8) \
+            .reduceByKey(lambda a, b: a + b, 8)
+    assert r.count() == 11
+    assert len(r.take(5)) == 5
+
+
+def test_non_divisible_partitions_fall_back(tctx):
+    # 5 partitions on an 8-device mesh -> object path, same answer
+    pairs = [(i % 3, 1) for i in range(50)]
+    got = dict(tctx.parallelize(pairs, 5)
+               .reduceByKey(lambda a, b: a + b, 5).collect())
+    assert got == {0: 17, 1: 17, 2: 16}
+
+
+def test_large_sum_no_overflow(tctx):
+    """Values summing past 2**31 must not wrap (int64 device path)."""
+    pairs = [(1, 2_000_000_000)] * 8
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert got == {1: 16_000_000_000}
+
+
+def test_int32_max_key_not_dropped(tctx):
+    """INT32_MAX is a legitimate key, not padding."""
+    pairs = [(2**31 - 1, 1)] * 8 + [(5, 2)] * 8
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert got == {2**31 - 1: 8, 5: 16}
+
+
+def test_int64_sentinel_key_falls_back(tctx):
+    """The one reserved key value (2**63-1) takes the host path."""
+    pairs = [(2**63 - 1, 1)] * 4 + [(3, 1)] * 4
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert got == {2**63 - 1: 4, 3: 4}
+
+
+def test_hbm_eviction_triggers_lineage_recovery(tctx):
+    from dpark_tpu import conf
+    old = conf.SHUFFLE_HBM_BUDGET
+    conf.SHUFFLE_HBM_BUDGET = 1          # evict everything beyond newest
+    try:
+        r1 = tctx.parallelize([(i % 4, 1) for i in range(200)], 8) \
+                 .reduceByKey(lambda a, b: a + b, 8)
+        assert dict(r1.collect()) == {k: 50 for k in range(4)}
+        r2 = tctx.parallelize([(i % 3, 1) for i in range(90)], 8) \
+                 .reduceByKey(lambda a, b: a + b, 8)
+        assert dict(r2.collect()) == {k: 30 for k in range(3)}
+        # r1's HBM store may be gone; a new action must still succeed
+        # (FetchFailed -> parent stage recompute)
+        assert dict(r1.collect()) == {k: 50 for k in range(4)}
+    finally:
+        conf.SHUFFLE_HBM_BUDGET = old
