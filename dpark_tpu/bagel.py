@@ -1,0 +1,204 @@
+"""Bagel: Pregel-style BSP graph processing on RDDs.
+
+Reference parity: dpark/bagel.py (SURVEY.md sections 2.3 and 3.2) — the
+superstep loop cogroups vertices with inbound messages, applies the user
+compute(vertex, messages, aggregated, superstep), emits (new vertex, out
+messages), optionally pre-combines messages per target (Combiner) and
+reduces a global Aggregator over all vertices each superstep; halts when
+every vertex is inactive and no messages remain.
+
+TPU mapping (SURVEY.md 3.2): each superstep is ordinary RDD algebra —
+cogroup (shuffle) + mapValue + flatMap — so on the tpu master the message
+combine rides the device segmented-reduce and the halting counters are a
+psum-style accumulator.  The Python loop stays on the host, exactly like
+the reference.
+"""
+
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("bagel")
+
+
+class Vertex:
+    def __init__(self, id, value, outEdges=None, active=True):
+        self.id = id
+        self.value = value
+        self.outEdges = outEdges or []
+        self.active = active
+
+    def __repr__(self):
+        return "<Vertex(%s, %r, active=%s)>" % (
+            self.id, self.value, self.active)
+
+
+class Edge:
+    def __init__(self, target_id, value=None):
+        self.target_id = target_id
+        self.value = value
+
+
+class Message:
+    def __init__(self, target_id, value):
+        self.target_id = target_id
+        self.value = value
+
+
+class Combiner:
+    """Pre-shuffle message combine (reference: Bagel Combiner)."""
+
+    def createCombiner(self, msg):
+        return [msg]
+
+    def mergeValue(self, combiner, msg):
+        combiner.append(msg)
+        return combiner
+
+    def mergeCombiners(self, a, b):
+        a.extend(b)
+        return a
+
+
+class BasicCombiner(Combiner):
+    """Combine message values with a binary op (e.g. operator.add)."""
+
+    def __init__(self, op):
+        self.op = op
+
+    def createCombiner(self, msg):
+        return msg
+
+    def mergeValue(self, combiner, msg):
+        return self.op(combiner, msg)
+
+    def mergeCombiners(self, a, b):
+        return self.op(a, b)
+
+
+class Aggregator:
+    """Global per-superstep reduce over all vertices; the result is
+    visible to every vertex in the NEXT superstep."""
+
+    def createAggregator(self, vert):
+        raise NotImplementedError
+
+    def mergeAggregators(self, a, b):
+        raise NotImplementedError
+
+
+class Bagel:
+    @classmethod
+    def run(cls, ctx, verts, msgs, compute,
+            combiner=None, aggregator=None,
+            max_superstep=80, numSplits=None, checkpoint_interval=10):
+        """verts: RDD of (id, Vertex); msgs: RDD of (id, message_value).
+
+        compute(vertex, messages_or_combined, aggregated, superstep)
+          -> (new_vertex, [Message, ...])
+        Returns the final verts RDD.
+        """
+        superstep = 0
+        combiner = combiner or Combiner()
+        numSplits = numSplits or len(verts.splits)
+
+        while superstep < max_superstep:
+            logger.debug("superstep %d", superstep)
+            aggregated = None
+            if aggregator is not None:
+                parts = [p for p in verts.ctx.runJob(
+                    verts.map(_AggCreate(aggregator)),
+                    _PartReduceBy(aggregator.mergeAggregators))
+                    if p is not _NO_VALUE]
+                if parts:
+                    aggregated = parts[0]
+                    for p in parts[1:]:
+                        aggregated = aggregator.mergeAggregators(
+                            aggregated, p)
+
+            combined = msgs.combineByKey(
+                combiner.createCombiner, combiner.mergeValue,
+                combiner.mergeCombiners, numSplits)
+            grouped = verts.groupWith(combined, numSplits=numSplits)
+            processed = grouped.flatMapValue(
+                _ComputeFn(compute, aggregated, superstep)).cache()
+
+            # force evaluation; count active vertices and pending messages
+            num_active, num_msgs = processed.map(_stats).fold(
+                (0, 0), _merge_stats)
+
+            verts = processed.mapValue(_fst_of_pair)
+            msgs = processed.flatMap(_OutMessages())
+            superstep += 1
+            if checkpoint_interval and superstep % checkpoint_interval == 0 \
+                    and ctx.checkpoint_dir:
+                verts = verts.mapValue(_identity)
+                verts.checkpoint()
+            if num_msgs == 0 and num_active == 0:
+                break
+        return verts
+
+
+_NO_VALUE = "__bagel_no_value__"
+
+
+class _PartReduceBy:
+    def __init__(self, merge):
+        self.merge = merge
+
+    def __call__(self, it):
+        out = _NO_VALUE
+        for x in it:
+            out = x if out is _NO_VALUE else self.merge(out, x)
+        return out
+
+
+class _AggCreate:
+    def __init__(self, aggregator):
+        self.aggregator = aggregator
+
+    def __call__(self, kv):
+        return self.aggregator.createAggregator(kv[1])
+
+
+class _ComputeFn:
+    """grouped value = ([vertex...], [combined_messages...]); vertices
+    without an entry (messages to unknown ids) are dropped, inactive
+    vertices with no mail are passed through untouched."""
+
+    def __init__(self, compute, aggregated, superstep):
+        self.compute = compute
+        self.aggregated = aggregated
+        self.superstep = superstep
+
+    def __call__(self, groups):
+        vs, cs = groups
+        if not vs:
+            return []
+        vert = vs[0]
+        mail = cs[0] if cs else None
+        if mail is None and not vert.active:
+            return [(vert, [])]
+        out = self.compute(vert, mail, self.aggregated, self.superstep)
+        return [out]
+
+
+class _OutMessages:
+    def __call__(self, kv):
+        _, (vert, out_msgs) = kv
+        return [(m.target_id, m.value) for m in out_msgs]
+
+
+def _stats(kv):
+    vert, out_msgs = kv[1]
+    return (1 if vert.active else 0, len(out_msgs))
+
+
+def _merge_stats(a, b):
+    return (a[0] + b[0], a[1] + b[1])
+
+
+def _fst_of_pair(pair):
+    return pair[0]
+
+
+def _identity(x):
+    return x

@@ -17,6 +17,8 @@ import importlib.util
 
 MEM_PER_TASK = 200.0          # MB per task (process/mesos masters)
 MAX_TASK_FAILURES = 4         # retries before a job aborts
+SCHEDULER_STALL_TIMEOUT = 60  # s between event-queue deadlock checks; a
+                              # check only aborts when NO task is in flight
 MAX_TASK_MEMORY = 15 << 10    # MB hard ceiling when escalating retries
 
 # shuffle behaviour (the reference's `rddconf`)

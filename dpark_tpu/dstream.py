@@ -1,0 +1,728 @@
+"""DStream: micro-batch stream processing over RDDs.
+
+Reference parity: dpark/dstream.py (SURVEY.md sections 2.3 and 3.3) — a
+DStream is a time-indexed sequence of RDDs; a recurring timer turns each
+batch tick into ordinary RDD jobs generated from the output streams.
+Windowing unions the parent's RDDs over the window; updateStateByKey
+cogroups the previous state RDD with the new batch; reduceByKeyAndWindow
+supports the incremental inverse-reduce optimization.
+
+On the tpu master every batch reuses the structurally-keyed compiled stage
+programs (backend/tpu/fuse.py), so the per-tick cost is execution, not
+compilation — the DStream-specific recompile hazard of SURVEY.md 7.2.5.
+"""
+
+import os
+import socket as _socket
+import threading
+import time as _time
+
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("dstream")
+
+
+class StreamingContext:
+    def __init__(self, ctx, batchDuration):
+        from dpark_tpu.context import DparkContext
+        if isinstance(ctx, str):
+            ctx = DparkContext(ctx)
+        self.ctx = ctx
+        self.batch_duration = float(batchDuration)
+        self.zero_time = None
+        self.output_streams = []
+        self.input_streams = []
+        self._timer = None
+        self._stopped = threading.Event()
+        self._thread = None
+        self.checkpoint_interval = 10     # batches
+
+    batchDuration = property(lambda self: self.batch_duration)
+
+    # -- input stream constructors --------------------------------------
+    def queueStream(self, queue, oneAtATime=True, defaultRDD=None):
+        """queue: list/deque of RDDs or of plain lists (auto-parallelized)."""
+        return QueueInputDStream(self, list(queue), oneAtATime, defaultRDD)
+
+    def textFileStream(self, directory, filter_fn=None):
+        return FileInputDStream(self, directory, filter_fn)
+
+    fileStream = textFileStream
+
+    def socketTextStream(self, hostname, port):
+        return SocketInputDStream(self, hostname, port)
+
+    def makeStream(self, rdd):
+        return ConstantInputDStream(self, rdd)
+
+    def union(self, *streams):
+        return UnionDStream(list(streams))
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, t0=None):
+        if not self.output_streams:
+            raise ValueError("no output streams registered "
+                             "(call foreachRDD / pprint)")
+        self.ctx.start()
+        for ins in self.input_streams:
+            ins.start()
+        bd = self.batch_duration
+        now = t0 if t0 is not None else _time.time()
+        self.zero_time = now - (now % bd)
+        self._stopped.clear()
+        self._thread = threading.Thread(target=self._run_loop, daemon=True)
+        self._thread.start()
+
+    def _run_loop(self):
+        bd = self.batch_duration
+        t = self.zero_time + bd
+        while not self._stopped.is_set():
+            now = _time.time()
+            if now < t:
+                self._stopped.wait(min(t - now, 0.05))
+                continue
+            try:
+                self.run_batch(t)
+            except Exception:
+                logger.exception("batch at %s failed", t)
+            t += bd
+
+    def run_batch(self, t):
+        """Generate and run one batch's jobs (called by the timer loop; in
+        tests it can be driven manually for determinism)."""
+        t = round(t, 6)
+        for out in self.output_streams:
+            out.generate_job(t)
+        for out in self.output_streams:
+            out.forget_old(t)
+
+    def awaitTermination(self, timeout=None):
+        if self._thread:
+            self._thread.join(timeout)
+
+    def stop(self, stop_context=False):
+        self._stopped.set()
+        if self._thread:
+            self._thread.join(self.batch_duration * 2 + 1)
+            self._thread = None
+        for ins in self.input_streams:
+            ins.stop()
+        if stop_context:
+            self.ctx.stop()
+
+
+class DStream:
+    def __init__(self, ssc):
+        self.ssc = ssc
+        self.generated = {}            # time -> rdd (or None)
+        self.must_checkpoint = False
+        self._batches_seen = 0
+
+    @property
+    def slide_duration(self):
+        return self.ssc.batch_duration
+
+    @property
+    def parents(self):
+        return []
+
+    @property
+    def window_duration(self):
+        """How long this stream's own RDDs must be remembered by parents."""
+        return self.slide_duration
+
+    def compute(self, t):
+        raise NotImplementedError
+
+    def getOrCompute(self, t):
+        t = round(t, 6)
+        zero = self.ssc.zero_time
+        if zero is not None and t <= zero + 1e-9:
+            return None                 # before the stream started
+        if t in self.generated:
+            return self.generated[t]
+        rdd = self.compute(t)
+        self.generated[t] = rdd
+        if rdd is not None and self.must_checkpoint:
+            self._batches_seen += 1
+            if (self.ssc.ctx.checkpoint_dir
+                    and self._batches_seen
+                    % self.ssc.checkpoint_interval == 0):
+                rdd.checkpoint()
+        return rdd
+
+    def forget_old(self, t, keep=None):
+        keep = keep if keep is not None else self._remember_duration()
+        for ts in list(self.generated):
+            if ts < t - keep:
+                rdd = self.generated.pop(ts)
+                if rdd is not None and rdd.should_cache:
+                    rdd.unpersist()     # free cached partitions, not just
+                                        # the reference (long-running jobs)
+        for p in self.parents:
+            p.forget_old(t, keep=max(keep, self.window_duration))
+
+    def _remember_duration(self):
+        return max(self.slide_duration * 4, self.window_duration * 2)
+
+    # -- transformations -------------------------------------------------
+    def map(self, f):
+        return MappedDStream(self, f)
+
+    def flatMap(self, f):
+        return TransformedDStream(self, _rdd_op("flatMap", f))
+
+    def filter(self, f):
+        return TransformedDStream(self, _rdd_op("filter", f))
+
+    def glom(self):
+        return TransformedDStream(self, _rdd_op("glom"))
+
+    def mapPartitions(self, f):
+        return TransformedDStream(self, _rdd_op("mapPartitions", f))
+
+    def mapValue(self, f):
+        return TransformedDStream(self, _rdd_op("mapValue", f))
+
+    mapValues = mapValue
+
+    def transform(self, func):
+        """func(rdd) or func(rdd, time) -> rdd"""
+        return TransformedDStream(self, func)
+
+    def groupByKey(self, numSplits=None):
+        return TransformedDStream(
+            self, _rdd_op("groupByKey", numSplits))
+
+    def reduceByKey(self, func, numSplits=None):
+        return TransformedDStream(
+            self, _rdd_op("reduceByKey", func, numSplits))
+
+    def combineByKey(self, createCombiner, mergeValue, mergeCombiners,
+                     numSplits=None):
+        return TransformedDStream(
+            self, _rdd_op("combineByKey", createCombiner, mergeValue,
+                          mergeCombiners, numSplits))
+
+    def countByValue(self):
+        return TransformedDStream(
+            self, lambda r: r.map(_pair_one_ds).reduceByKey(_add_ds))
+
+    def union(self, other):
+        return UnionDStream([self, other])
+
+    def join(self, other, numSplits=None):
+        return CoGroupedDStream([self, other], "join", numSplits)
+
+    def cogroup(self, other, numSplits=None):
+        return CoGroupedDStream([self, other], "cogroup", numSplits)
+
+    # -- windows ---------------------------------------------------------
+    def window(self, windowDuration, slideDuration=None):
+        return WindowedDStream(self, windowDuration, slideDuration)
+
+    def reduceByWindow(self, reduceFunc, windowDuration, slideDuration=None,
+                       invReduceFunc=None):
+        """Whole-window reduce; with invReduceFunc it rides the incremental
+        keyed path (constant key) instead of recomputing the window."""
+        if invReduceFunc is not None:
+            keyed = self.map(_const_key)
+            red = keyed.reduceByKeyAndWindow(
+                reduceFunc, windowDuration, slideDuration,
+                invFunc=invReduceFunc)
+            return TransformedDStream(red, _rdd_op("map", _drop_key))
+        w = self.window(windowDuration, slideDuration)
+        return TransformedDStream(w, _reduce_to_rdd(reduceFunc))
+
+    def countByWindow(self, windowDuration, slideDuration=None):
+        return (self.window(windowDuration, slideDuration)
+                .transform(_count_to_rdd))
+
+    def reduceByKeyAndWindow(self, func, windowDuration, slideDuration=None,
+                             numSplits=None, invFunc=None):
+        if invFunc is None:
+            w = self.window(windowDuration, slideDuration)
+            return TransformedDStream(
+                w, _rdd_op("reduceByKey", func, numSplits))
+        return ReducedWindowedDStream(self, func, invFunc, windowDuration,
+                                      slideDuration, numSplits)
+
+    # -- state -----------------------------------------------------------
+    def updateStateByKey(self, updateFunc, numSplits=None):
+        """updateFunc(new_values_list, prev_state_or_None) -> state|None"""
+        return StateDStream(self, updateFunc, numSplits)
+
+    # -- outputs ---------------------------------------------------------
+    def foreachRDD(self, func):
+        out = ForEachDStream(self, func)
+        self.ssc.output_streams.append(out)
+        return out
+
+    def pprint(self, num=10):
+        def show(rdd, t):
+            items = rdd.take(num)
+            print("--- time %s ---" % t)
+            for it in items:
+                print(it)
+        return self.foreachRDD(show)
+
+    def collect_batches(self, sink):
+        """Test/utility output: append (time, list) per non-empty batch."""
+        return self.foreachRDD(
+            lambda rdd, t: sink.append((t, rdd.collect())))
+
+
+def _rdd_op(name, *args):
+    def op(rdd):
+        f = getattr(rdd, name)
+        return f(*[a for a in args if a is not None])
+    return op
+
+
+def _pair_one_ds(x):
+    return (x, 1)
+
+
+def _const_key(x):
+    return (0, x)
+
+
+def _drop_key(kv):
+    return kv[1]
+
+
+def _add_ds(a, b):
+    return a + b
+
+
+def _reduce_to_rdd(func):
+    def op(rdd):
+        vals = rdd.mapPartitions(lambda it: _safe_reduce(it, func)) \
+                  .collect()
+        out = None
+        have = False
+        for v in vals:
+            out = v if not have else func(out, v)
+            have = True
+        return rdd.ctx.parallelize([out] if have else [], 1)
+    return op
+
+
+def _safe_reduce(it, func):
+    out = None
+    have = False
+    for x in it:
+        out = x if not have else func(out, x)
+        have = True
+    return [out] if have else []
+
+
+def _count_to_rdd(rdd):
+    return rdd.ctx.parallelize([rdd.count()], 1)
+
+
+class DerivedDStream(DStream):
+    def __init__(self, parent):
+        super().__init__(parent.ssc)
+        self.parent = parent
+
+    @property
+    def parents(self):
+        return [self.parent]
+
+    @property
+    def slide_duration(self):
+        return self.parent.slide_duration
+
+
+class MappedDStream(DerivedDStream):
+    def __init__(self, parent, f):
+        super().__init__(parent)
+        self.f = f
+
+    def compute(self, t):
+        rdd = self.parent.getOrCompute(t)
+        return rdd.map(self.f) if rdd is not None else None
+
+
+class TransformedDStream(DerivedDStream):
+    def __init__(self, parent, func):
+        super().__init__(parent)
+        self.func = func
+        import inspect
+        try:
+            self._two_args = len(inspect.signature(func).parameters) >= 2
+        except (TypeError, ValueError):
+            self._two_args = False
+
+    def compute(self, t):
+        rdd = self.parent.getOrCompute(t)
+        if rdd is None:
+            return None
+        return self.func(rdd, t) if self._two_args else self.func(rdd)
+
+
+class UnionDStream(DStream):
+    def __init__(self, streams):
+        super().__init__(streams[0].ssc)
+        self.streams = streams
+
+    @property
+    def parents(self):
+        return list(self.streams)
+
+    @property
+    def slide_duration(self):
+        return self.streams[0].slide_duration
+
+    def compute(self, t):
+        rdds = [s.getOrCompute(t) for s in self.streams]
+        rdds = [r for r in rdds if r is not None]
+        if not rdds:
+            return None
+        return self.ssc.ctx.union(rdds)
+
+
+class CoGroupedDStream(DStream):
+    def __init__(self, streams, how, numSplits=None):
+        super().__init__(streams[0].ssc)
+        self.streams = streams
+        self.how = how
+        self.numSplits = numSplits
+
+    @property
+    def parents(self):
+        return list(self.streams)
+
+    @property
+    def slide_duration(self):
+        return self.streams[0].slide_duration
+
+    def compute(self, t):
+        rdds = [s.getOrCompute(t) for s in self.streams]
+        if any(r is None for r in rdds):
+            empty = self.ssc.ctx.parallelize([], 1)
+            rdds = [r if r is not None else empty for r in rdds]
+        a, b = rdds
+        if self.how == "join":
+            return a.join(b, self.numSplits)
+        return a.cogroup(b, numSplits=self.numSplits)
+
+
+class WindowedDStream(DerivedDStream):
+    def __init__(self, parent, windowDuration, slideDuration=None):
+        super().__init__(parent)
+        self._window = float(windowDuration)
+        self._slide = float(slideDuration or parent.slide_duration)
+
+    @property
+    def slide_duration(self):
+        return self._slide
+
+    @property
+    def window_duration(self):
+        return self._window
+
+    def compute(self, t):
+        rdds = []
+        step = self.parent.slide_duration
+        # window covers (t - window, t]
+        k = t
+        while k > t - self._window + 1e-9:
+            rdd = self.parent.getOrCompute(round(k, 6))
+            if rdd is not None:
+                rdds.append(rdd)
+            k -= step
+        if not rdds:
+            return None
+        return self.ssc.ctx.union(rdds)
+
+
+class ReducedWindowedDStream(DerivedDStream):
+    """Incremental windowed reduce: new_window = inv(prev_window - old
+    slice) + new slice (reference: ReducedWindowedDStream)."""
+
+    def __init__(self, parent, func, invFunc, windowDuration,
+                 slideDuration=None, numSplits=None):
+        super().__init__(parent)
+        self.func = func
+        self.invFunc = invFunc
+        self._window = float(windowDuration)
+        self._slide = float(slideDuration or parent.slide_duration)
+        self.numSplits = numSplits
+        self.must_checkpoint = True
+        self._reduced = {}      # time -> per-batch reduced rdd
+
+    @property
+    def slide_duration(self):
+        return self._slide
+
+    @property
+    def window_duration(self):
+        return self._window
+
+    def _batch_reduced(self, t):
+        if t not in self._reduced:
+            rdd = self.parent.getOrCompute(t)
+            self._reduced[t] = (rdd.reduceByKey(self.func, self.numSplits)
+                                if rdd is not None else None)
+        return self._reduced[t]
+
+    def compute(self, t):
+        prev = self.generated.get(round(t - self._slide, 6))
+        step = self.parent.slide_duration
+        if prev is None:
+            # cold start: plain window reduce
+            rdds = []
+            k = t
+            while k > t - self._window + 1e-9:
+                r = self._batch_reduced(round(k, 6))
+                if r is not None:
+                    rdds.append(r)
+                k -= step
+            if not rdds:
+                return None
+            out = rdds[0]
+            for r in rdds[1:]:
+                out = out.union(r)
+            return out.reduceByKey(self.func, self.numSplits).cache()
+        # incremental: subtract slices leaving the window, add new ones
+        leaving, entering = [], []
+        k = t - self._window
+        while k > t - self._window - self._slide + 1e-9:
+            r = self._batch_reduced(round(k, 6))
+            if r is not None:
+                leaving.append(r)
+            k -= step
+        k = t
+        while k > t - self._slide + 1e-9:
+            r = self._batch_reduced(round(k, 6))
+            if r is not None:
+                entering.append(r)
+            k -= step
+        out = prev
+        for r in leaving:
+            joined = out.leftOuterJoin(r, self.numSplits)
+            out = joined.mapValue(_InvApply(self.invFunc))
+        for r in entering:
+            out = out.union(r).reduceByKey(self.func, self.numSplits)
+        # drop keys whose count reached the zero element is left to the
+        # user's invFunc semantics (parity with reference)
+        return out.cache()
+
+    def forget_old(self, t, keep=None):
+        super().forget_old(t, keep)
+        for ts in list(self._reduced):
+            if ts < t - (self._window + self._slide * 2):
+                rdd = self._reduced.pop(ts)
+                if rdd is not None and rdd.should_cache:
+                    rdd.unpersist()
+
+
+class _InvApply:
+    def __init__(self, invFunc):
+        self.invFunc = invFunc
+
+    def __call__(self, pair):
+        cur, old = pair
+        return self.invFunc(cur, old) if old is not None else cur
+
+
+class StateDStream(DerivedDStream):
+    def __init__(self, parent, updateFunc, numSplits=None):
+        super().__init__(parent)
+        self.updateFunc = updateFunc
+        self.numSplits = numSplits
+        self.must_checkpoint = True
+
+    def compute(self, t):
+        prev = self.generated.get(round(t - self.slide_duration, 6))
+        batch = self.parent.getOrCompute(t)
+        ctx = self.ssc.ctx
+        if batch is None:
+            batch = ctx.parallelize([], 1)
+        if prev is None:
+            prev = ctx.parallelize([], 1)
+        grouped = batch.cogroup(prev, numSplits=self.numSplits)
+        updated = grouped.mapValue(_StateUpdate(self.updateFunc)) \
+                         .filter(_state_not_none)
+        return updated.mapValue(_unwrap_state).cache()
+
+
+class _StateUpdate:
+    def __init__(self, updateFunc):
+        self.updateFunc = updateFunc
+
+    def __call__(self, groups):
+        new_values, old_states = groups
+        prev = old_states[0] if old_states else None
+        return (self.updateFunc(new_values, prev),)
+
+
+def _state_not_none(kv):
+    return kv[1][0] is not None
+
+
+def _unwrap_state(wrapped):
+    return wrapped[0]
+
+
+class ForEachDStream(DerivedDStream):
+    def __init__(self, parent, func):
+        super().__init__(parent)
+        self.func = func
+        import inspect
+        try:
+            self._two_args = len(inspect.signature(func).parameters) >= 2
+        except (TypeError, ValueError):
+            self._two_args = False
+
+    def compute(self, t):
+        return self.parent.getOrCompute(t)
+
+    def generate_job(self, t):
+        rdd = self.getOrCompute(t)
+        if rdd is None:
+            return
+        if self._two_args:
+            self.func(rdd, t)
+        else:
+            self.func(rdd)
+
+
+# --------------------------------------------------------------------------
+# input streams
+# --------------------------------------------------------------------------
+
+class InputDStream(DStream):
+    def __init__(self, ssc):
+        super().__init__(ssc)
+        ssc.input_streams.append(self)
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+class ConstantInputDStream(InputDStream):
+    def __init__(self, ssc, rdd):
+        super().__init__(ssc)
+        self.rdd = rdd
+
+    def compute(self, t):
+        return self.rdd
+
+
+class QueueInputDStream(InputDStream):
+    def __init__(self, ssc, queue, oneAtATime=True, defaultRDD=None):
+        super().__init__(ssc)
+        self.queue = queue
+        self.oneAtATime = oneAtATime
+        self.defaultRDD = defaultRDD
+
+    def put(self, item):
+        self.queue.append(item)
+
+    def _to_rdd(self, item):
+        from dpark_tpu.rdd import RDD
+        if isinstance(item, RDD):
+            return item
+        return self.ssc.ctx.parallelize(item, 2)
+
+    def compute(self, t):
+        if self.queue:
+            if self.oneAtATime:
+                return self._to_rdd(self.queue.pop(0))
+            items = list(self.queue)
+            del self.queue[:len(items)]
+            rdds = [self._to_rdd(i) for i in items]
+            return rdds[0] if len(rdds) == 1 else self.ssc.ctx.union(rdds)
+        return self.defaultRDD
+
+
+class FileInputDStream(InputDStream):
+    """Scan a directory each batch; per-file byte offsets are tracked so a
+    batch picks up both new files AND data appended to known files
+    (tail -f semantics; reference FileInputDStream scans by mtime)."""
+
+    def __init__(self, ssc, directory, filter_fn=None, newFilesOnly=True):
+        super().__init__(ssc)
+        self.directory = directory
+        self.filter_fn = filter_fn or (lambda n: not n.startswith("."))
+        self.offsets = {}               # path -> bytes already consumed
+        self.new_files_only = newFilesOnly
+
+    def start(self):
+        if self.new_files_only:
+            for name in os.listdir(self.directory):
+                p = os.path.join(self.directory, name)
+                if os.path.isfile(p):
+                    self.offsets[p] = os.path.getsize(p)
+
+    def compute(self, t):
+        rdds = []
+        for name in sorted(os.listdir(self.directory)):
+            if not self.filter_fn(name):
+                continue
+            p = os.path.join(self.directory, name)
+            if not os.path.isfile(p):
+                continue
+            size = os.path.getsize(p)
+            off = self.offsets.get(p, 0)
+            if size > off:
+                rdds.append(self.ssc.ctx.partialTextFile(p, off, size))
+                self.offsets[p] = size
+        if not rdds:
+            return None
+        return rdds[0] if len(rdds) == 1 else self.ssc.ctx.union(rdds)
+
+
+class SocketInputDStream(InputDStream):
+    """TCP line reader: a background thread accumulates lines; each batch
+    drains the buffer (reference: socketTextStream)."""
+
+    def __init__(self, ssc, hostname, port):
+        super().__init__(ssc)
+        self.hostname = hostname
+        self.port = port
+        self.buffer = []
+        self.lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._read, daemon=True)
+        self._thread.start()
+
+    def _read(self):
+        while not self._stop.is_set():
+            try:
+                sock = _socket.create_connection(
+                    (self.hostname, self.port), timeout=2)
+                f = sock.makefile("rb")
+                for line in f:
+                    if self._stop.is_set():
+                        break
+                    with self.lock:
+                        self.buffer.append(
+                            line.rstrip(b"\r\n").decode("utf-8", "replace"))
+                sock.close()
+            except OSError:
+                if self._stop.wait(0.5):
+                    return
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(3)
+            self._thread = None
+
+    def compute(self, t):
+        with self.lock:
+            lines, self.buffer = self.buffer, []
+        if not lines:
+            return None
+        return self.ssc.ctx.parallelize(lines, 2)

@@ -1,0 +1,36 @@
+"""Space-saving top-k counter.
+
+Reference parity: dpark/hotcounter.py (SURVEY.md section 2.1) — bounded-
+memory heavy-hitters behind rdd.hot() for very high-cardinality streams.
+The exact rdd.hot() path uses a full reduceByKey (dpark_tpu/rdd.py hot());
+HotCounter is the approximate alternative for when the key space does not
+fit (Metwally et al. space-saving algorithm).
+"""
+
+
+class HotCounter:
+    def __init__(self, capacity=1000):
+        self.capacity = capacity
+        self.counts = {}          # value -> (count, error)
+
+    def add(self, value, count=1):
+        c = self.counts
+        if value in c:
+            cnt, err = c[value]
+            c[value] = (cnt + count, err)
+        elif len(c) < self.capacity:
+            c[value] = (count, 0)
+        else:
+            # evict the minimum, inherit its count as error bound
+            victim = min(c, key=lambda k: c[k][0])
+            vcnt, _ = c.pop(victim)
+            c[value] = (vcnt + count, vcnt)
+
+    def update(self, other):
+        for value, (cnt, err) in other.counts.items():
+            self.add(value, cnt)
+        return self
+
+    def top(self, n=10):
+        items = sorted(self.counts.items(), key=lambda kv: -kv[1][0])
+        return [(v, cnt) for v, (cnt, err) in items[:n]]

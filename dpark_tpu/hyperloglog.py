@@ -1,0 +1,58 @@
+"""HyperLogLog approximate distinct counter.
+
+Reference parity: dpark/hyperloglog.py (SURVEY.md section 2.1) — backs the
+table DSL's adcount() and RDD-level approximate distinct counting.
+Standard HLL with 2^p registers and the small/large-range corrections.
+"""
+
+import math
+
+from dpark_tpu.utils.phash import portable_hash, fmix32
+
+
+class HyperLogLog:
+    def __init__(self, p=12):
+        self.p = p
+        self.m = 1 << p
+        self.registers = bytearray(self.m)
+        if p == 4:
+            self.alpha = 0.673
+        elif p == 5:
+            self.alpha = 0.697
+        elif p == 6:
+            self.alpha = 0.709
+        else:
+            self.alpha = 0.7213 / (1 + 1.079 / self.m)
+
+    def add(self, value):
+        # 64-bit-ish hash from two independent 32-bit mixes
+        h1 = portable_hash(value)
+        h2 = fmix32(h1 ^ 0x9E3779B9)
+        h = (h1 << 32) | h2
+        idx = h & (self.m - 1)
+        w = h >> self.p
+        rank = 1
+        # rank = position of the leftmost 1-bit of w within 64-p bits
+        bits = 64 - self.p
+        rank = bits - w.bit_length() + 1 if w else bits + 1
+        if rank > self.registers[idx]:
+            self.registers[idx] = rank
+
+    def update(self, other):
+        if other.p != self.p:
+            raise ValueError("cannot merge HLLs of different precision")
+        for i, r in enumerate(other.registers):
+            if r > self.registers[i]:
+                self.registers[i] = r
+        return self
+
+    def __len__(self):
+        est = self.alpha * self.m * self.m / sum(
+            2.0 ** -r for r in self.registers)
+        if est <= 2.5 * self.m:
+            zeros = self.registers.count(0)
+            if zeros:
+                est = self.m * math.log(self.m / float(zeros))
+        elif est > (1 << 62):
+            est = -(1 << 64) * math.log(1 - est / (1 << 64))
+        return int(round(est))

@@ -471,6 +471,22 @@ class RDD:
     def saveAsTableFile(self, path, overwrite=True):
         return OutputPickleFileRDD(self, path, overwrite).collect()
 
+    def asTable(self, fields, name="table"):
+        """Wrap this RDD of tuples as a schema'd TableRDD (reference:
+        rdd.asTable, dpark/table.py)."""
+        from dpark_tpu.table import TableRDD
+        return TableRDD(self, fields, name)
+
+    def adcount(self, p=12):
+        """Approximate distinct count via HyperLogLog merge."""
+        from dpark_tpu.hyperloglog import HyperLogLog
+        parts = self.ctx.runJob(self, _HLLPartition(p))
+        h = HyperLogLog(p)
+        for part in parts:
+            if part is not None:
+                h.update(part)
+        return len(h)
+
 
 _EMPTY = object()
 
@@ -629,6 +645,18 @@ class _ZipWithIndexFn:
 
     def __call__(self, i, it):
         return ((x, j) for j, x in enumerate(it, self.offsets[i]))
+
+
+class _HLLPartition:
+    def __init__(self, p):
+        self.p = p
+
+    def __call__(self, it):
+        from dpark_tpu.hyperloglog import HyperLogLog
+        h = HyperLogLog(self.p)
+        for x in it:
+            h.add(x)
+        return h
 
 
 class _CheckpointWriteFn:

@@ -145,6 +145,7 @@ class DAGScheduler:
         # so a generator abandoned mid-iteration (take/iterate) can never
         # leak its late completions into a subsequent job's loop
         events = queue.Queue()
+        in_flight = [0]          # submitted tasks whose event hasn't arrived
 
         def report(task, status, payload):
             events.put((task, status, payload))
@@ -185,12 +186,23 @@ class DAGScheduler:
             pending_tasks.setdefault(stage, set()).update(
                 t.partition for t in tasks)
             logger.debug("submit stage %s with %d tasks", stage, len(tasks))
+            in_flight[0] += len(tasks)
             self.submit_tasks(stage, tasks, report)
 
         submit_stage(final_stage)
 
         while num_finished < len(output_parts):
-            task, status, payload = events.get()
+            try:
+                task, status, payload = events.get(
+                    timeout=conf.SCHEDULER_STALL_TIMEOUT)
+            except queue.Empty:
+                if in_flight[0] > 0:
+                    continue        # a long task is legitimately running
+                raise RuntimeError(
+                    "scheduler deadlock: no tasks in flight and no events "
+                    "(waiting=%r running=%r finished=%d/%d)"
+                    % (waiting, running, num_finished, len(output_parts)))
+            in_flight[0] -= 1
             stage = stage_of.get(task.stage_id)
             if status == "success":
                 result, acc_updates = payload
@@ -212,6 +224,12 @@ class DAGScheduler:
                     pend = pending_tasks.get(stage)
                     if pend is not None:
                         pend.discard(task.partition)
+                    if not stage.is_available and pend is not None \
+                            and not pend:
+                        # outputs were invalidated (FetchFailed on another
+                        # map) while this stage was running: resubmit the
+                        # holes, else the job deadlocks with no events left
+                        submit_missing_tasks(stage)
                     if stage.is_available:
                         env.map_output_tracker.register_outputs(
                             stage.shuffle_dep.shuffle_id, stage.output_locs)
@@ -249,6 +267,7 @@ class DAGScheduler:
                 logger.warning("task %r failed (try %d): %s",
                                task, failures[key], str(payload)[:200])
                 task.tried += 1
+                in_flight[0] += 1
                 self.submit_tasks(stage, [task], report)
 
     # -- master-specific -------------------------------------------------

@@ -1,0 +1,388 @@
+"""Table DSL: schema'd RDDs of named rows with expression select/where,
+grouped aggregation, sort and joins.
+
+Reference parity: dpark/table.py (SURVEY.md section 2.3) — a TableRDD wraps
+an RDD of namedtuple rows; string expressions are compiled with eval
+against the row's fields; groupBy supports sum/count/avg/min/max and
+approximate distinct count (HyperLogLog, dpark/hyperloglog.py analog in
+dpark_tpu/hyperloglog.py).  Exact method shapes follow this framework's
+conventions; the surface (select/where/groupBy/sort/top/join/collect) is
+the reference's.
+"""
+
+import re
+from collections import namedtuple
+
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("table")
+
+_AGG_RE = re.compile(
+    r"^\s*(count|sum|avg|min|max|adcount|first|group_concat)\s*"
+    r"\(\s*(.*?)\s*\)\s*$", re.I)
+_AS_RE = re.compile(r"^(.*?)\s+as\s+(\w+)\s*$", re.I)
+
+
+def _compile_expr(expr, fields):
+    """Compile a string expression over row fields into row -> value."""
+    code = compile(expr, "<table:%s>" % expr, "eval")
+
+    def run(row):
+        env = dict(zip(fields, row))
+        return eval(code, {"__builtins__": _SAFE_BUILTINS}, env)
+    run.expr = expr
+    return run
+
+
+_SAFE_BUILTINS = {
+    "abs": abs, "min": min, "max": max, "len": len, "round": round,
+    "int": int, "float": float, "str": str, "bool": bool, "sum": sum,
+    "True": True, "False": False, "None": None,
+}
+
+
+class _Agg:
+    """One aggregate column: (create, merge, combine, finalize)."""
+
+    def __init__(self, func, arg_fn, name):
+        self.func = func
+        self.arg_fn = arg_fn
+        self.name = name
+
+    def create(self, row):
+        f = self.func
+        if f == "count":
+            if self.arg_fn is None:
+                return 1
+            return 0 if self.arg_fn(row) is None else 1
+        v = self.arg_fn(row)
+        if f == "sum":
+            return v
+        if f == "avg":
+            return (v, 1)
+        if f in ("min", "max", "first"):
+            return v
+        if f == "adcount":
+            from dpark_tpu.hyperloglog import HyperLogLog
+            h = HyperLogLog()
+            h.add(v)
+            return h
+        if f == "group_concat":
+            return [v]
+        raise ValueError("unknown aggregate %r" % f)
+
+    def merge(self, acc, row):
+        return self.combine(acc, self.create(row))
+
+    def combine(self, a, b):
+        f = self.func
+        if f in ("count", "sum"):
+            return a + b
+        if f == "avg":
+            return (a[0] + b[0], a[1] + b[1])
+        if f == "min":
+            return a if a <= b else b
+        if f == "max":
+            return a if a >= b else b
+        if f == "first":
+            return a
+        if f == "adcount":
+            a.update(b)
+            return a
+        if f == "group_concat":
+            a.extend(b)
+            return a
+        raise ValueError(f)
+
+    def finalize(self, acc):
+        f = self.func
+        if f == "avg":
+            return acc[0] / acc[1] if acc[1] else None
+        if f == "adcount":
+            return len(acc)
+        if f == "group_concat":
+            return ",".join(str(x) for x in acc)
+        return acc
+
+
+def _parse_column(col, fields, index):
+    """'expr as name' | 'agg(expr)' | 'name' -> (name, fn_or_agg)."""
+    name = None
+    m = _AS_RE.match(col)
+    if m:
+        col, name = m.group(1), m.group(2)
+    m = _AGG_RE.match(col)
+    if m:
+        func, arg = m.group(1).lower(), m.group(2)
+        arg_fn = None
+        if arg and arg != "*":
+            arg_fn = _compile_expr(arg, fields)
+        agg_name = name or ("%s_%s" % (func, arg.replace("*", "all")
+                                       .replace("(", "").replace(")", "")
+                                       .strip() or "all"))
+        agg_name = re.sub(r"\W+", "_", agg_name).strip("_") or \
+            ("agg%d" % index)
+        return agg_name, _Agg(func, arg_fn, agg_name)
+    if col in fields:
+        return name or col, _compile_expr(col, fields)
+    return (name or ("col%d" % index)), _compile_expr(col, fields)
+
+
+class TableRDD:
+    def __init__(self, rdd, fields, name="table"):
+        if isinstance(fields, str):
+            fields = [f.strip() for f in fields.replace(",", " ").split()]
+        self.rdd = rdd
+        self.fields = list(fields)
+        self.name = name
+        self._row_type = namedtuple("Row", self.fields, rename=True)
+
+    # -- basic relational ops -------------------------------------------
+    def select(self, *cols):
+        cols = _split_cols(cols)
+        parsed = [_parse_column(c, self.fields, i)
+                  for i, c in enumerate(cols)]
+        if any(isinstance(fn, _Agg) for _, fn in parsed):
+            return self._aggregate_all(parsed)
+        names = [n for n, _ in parsed]
+        fns = [fn for _, fn in parsed]
+        out = self.rdd.map(_SelectFn(fns))
+        return TableRDD(out, names, self.name)
+
+    def where(self, *conditions):
+        conds = [_compile_expr(c, self.fields)
+                 for c in _split_cols(conditions)]
+        out = self.rdd.filter(_WhereFn(conds))
+        return TableRDD(out, self.fields, self.name)
+
+    filter = where
+
+    def groupBy(self, keys, *aggs, **named_aggs):
+        key_cols = _split_cols((keys,) if isinstance(keys, str) else keys)
+        key_fns = [_compile_expr(k, self.fields) for k in key_cols]
+        parsed = [_parse_column(a, self.fields, i)
+                  for i, a in enumerate(_split_cols(aggs))]
+        for name, expr in sorted(named_aggs.items()):
+            n, fn = _parse_column(expr, self.fields, 0)
+            parsed.append((name, fn))
+        for n, fn in parsed:
+            if not isinstance(fn, _Agg):
+                raise ValueError("groupBy columns must be aggregates: %r"
+                                 % n)
+        aggs_only = [fn for _, fn in parsed]
+        keyed = self.rdd.map(_PairKeyFn(key_fns))
+        combined = keyed.combineByKey(
+            _AggCreate(aggs_only), _AggMerge(aggs_only),
+            _AggCombine(aggs_only))
+        out = combined.map(_AggFinalize(aggs_only, len(key_cols)))
+        names = [re.sub(r"\W+", "_", k).strip("_") or ("k%d" % i)
+                 for i, k in enumerate(key_cols)]
+        names += [n for n, _ in parsed]
+        return TableRDD(out, names, self.name)
+
+    def _aggregate_all(self, parsed):
+        aggs = [fn for _, fn in parsed]
+        for n, fn in parsed:
+            if not isinstance(fn, _Agg):
+                raise ValueError("mixing aggregates with plain columns "
+                                 "requires groupBy")
+        zero = None
+        create, combine = _AggCreate(aggs), _AggCombine(aggs)
+        parts = [p for p in self.rdd.ctx.runJob(
+            self.rdd, _AggPartition(aggs)) if p is not None]
+        if parts:
+            acc = parts[0]
+            for p in parts[1:]:
+                acc = combine(acc, p)
+            row = tuple(a.finalize(v) for a, v in zip(aggs, acc))
+        else:
+            row = tuple(None for _ in aggs)
+        out = self.rdd.ctx.parallelize([row], 1)
+        return TableRDD(out, [n for n, _ in parsed], self.name)
+
+    def sort(self, key, reverse=False, numSplits=None):
+        fns = [_compile_expr(k, self.fields)
+               for k in _split_cols((key,) if isinstance(key, str)
+                                    else key)]
+        out = self.rdd.sort(key=_GroupKeyFn(fns), reverse=reverse,
+                            numSplits=numSplits)
+        return TableRDD(out, self.fields, self.name)
+
+    def top(self, n=10, key=None, reverse=False):
+        if key is None:
+            key_fn = None
+        else:
+            fns = [_compile_expr(k, self.fields)
+                   for k in _split_cols((key,) if isinstance(key, str)
+                                        else key)]
+            key_fn = _GroupKeyFn(fns)
+        return [self._row_type(*r)
+                for r in self.rdd.top(n, key=key_fn, reverse=reverse)]
+
+    def join(self, other, on, numSplits=None):
+        """Equi-join on a column name present in both tables."""
+        if on not in self.fields or on not in other.fields:
+            raise ValueError("join column %r must be a plain field of "
+                             "both tables" % on)
+        li, ri = self.fields.index(on), other.fields.index(on)
+        lf = _compile_expr(on, self.fields)
+        rf = _compile_expr(on, other.fields)
+        left = self.rdd.map(_JoinKeyFn(lf))
+        right = other.rdd.map(_JoinKeyFn(rf))
+        joined = left.join(right, numSplits)
+        out = joined.map(_JoinMerge(li, ri))
+        fields = ([on] + [f for f in self.fields if f != on]
+                  + [f if f not in self.fields else other.name + "_" + f
+                     for f in other.fields if f != on])
+        # ensure uniqueness
+        seen, uniq = set(), []
+        for f in fields:
+            while f in seen:
+                f = f + "_"
+            seen.add(f)
+            uniq.append(f)
+        return TableRDD(out, uniq, self.name)
+
+    # -- actions ---------------------------------------------------------
+    def collect(self):
+        return [self._row_type(*r) if isinstance(r, tuple)
+                else self._row_type(r) for r in self.rdd.collect()]
+
+    def take(self, n):
+        return [self._row_type(*r) for r in self.rdd.take(n)]
+
+    def count(self):
+        return self.rdd.count()
+
+    def save(self, path):
+        return self.rdd.saveAsCSVFile(path)
+
+    def indexBy(self, key):
+        fn = _compile_expr(key, self.fields)
+        return self.rdd.map(_JoinKeyFn(fn))
+
+    def __repr__(self):
+        return "<TableRDD %s(%s)>" % (self.name, ", ".join(self.fields))
+
+
+def _split_cols(cols):
+    out = []
+    for c in cols:
+        if isinstance(c, (list, tuple)):
+            out.extend(_split_cols(c))
+        else:
+            # split on top-level commas (not inside parens)
+            depth, cur = 0, ""
+            for ch in c:
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                if ch == "," and depth == 0:
+                    out.append(cur.strip())
+                    cur = ""
+                else:
+                    cur += ch
+            if cur.strip():
+                out.append(cur.strip())
+    return out
+
+
+class _SelectFn:
+    def __init__(self, fns):
+        self.fns = fns
+
+    def __call__(self, row):
+        return tuple(fn(row) for fn in self.fns)
+
+
+class _WhereFn:
+    def __init__(self, conds):
+        self.conds = conds
+
+    def __call__(self, row):
+        return all(c(row) for c in self.conds)
+
+
+class _GroupKeyFn:
+    def __init__(self, fns):
+        self.fns = fns
+
+    def __call__(self, row):
+        if len(self.fns) == 1:
+            return self.fns[0](row)
+        return tuple(fn(row) for fn in self.fns)
+
+
+class _PairKeyFn(_GroupKeyFn):
+    def __call__(self, row):
+        return (super().__call__(row), row)
+
+
+class _JoinKeyFn(_GroupKeyFn):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, row):
+        return (self.fn(row), row)
+
+
+class _JoinMerge:
+    def __init__(self, li, ri):
+        self.li = li
+        self.ri = ri
+
+    def __call__(self, kv):
+        k, (l, r) = kv
+        l = tuple(x for i, x in enumerate(l) if i != self.li)
+        r = tuple(x for i, x in enumerate(r) if i != self.ri)
+        return (k,) + l + r
+
+
+class _AggCreate:
+    def __init__(self, aggs):
+        self.aggs = aggs
+
+    def __call__(self, row):
+        return tuple(a.create(row) for a in self.aggs)
+
+
+class _AggMerge:
+    def __init__(self, aggs):
+        self.aggs = aggs
+
+    def __call__(self, acc, row):
+        return tuple(a.merge(v, row) for a, v in zip(self.aggs, acc))
+
+
+class _AggCombine:
+    def __init__(self, aggs):
+        self.aggs = aggs
+
+    def __call__(self, a, b):
+        return tuple(g.combine(x, y) for g, x, y in zip(self.aggs, a, b))
+
+
+class _AggFinalize:
+    def __init__(self, aggs, n_keys):
+        self.aggs = aggs
+        self.n_keys = n_keys
+
+    def __call__(self, kv):
+        k, acc = kv
+        keys = k if isinstance(k, tuple) and self.n_keys > 1 else (k,)
+        return tuple(keys) + tuple(
+            a.finalize(v) for a, v in zip(self.aggs, acc))
+
+
+class _AggPartition:
+    def __init__(self, aggs):
+        self.aggs = aggs
+
+    def __call__(self, it):
+        acc = None
+        merge = _AggMerge(self.aggs)
+        create = _AggCreate(self.aggs)
+        for row in it:
+            acc = create(row) if acc is None else merge(acc, row)
+        return acc

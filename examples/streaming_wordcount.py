@@ -1,0 +1,40 @@
+"""Windowed streaming wordcount over a queue stream
+(reference: Spark-Streaming-style dpark example).
+
+Usage: python examples/streaming_wordcount.py [-m local|process|tpu]
+"""
+
+import operator
+import time
+
+from dpark_tpu import DparkContext, parse_options
+from dpark_tpu.dstream import StreamingContext
+
+
+def main():
+    options = parse_options()
+    ctx = DparkContext(options.master)
+    ssc = StreamingContext(ctx, 0.25)
+    batches = [
+        ["the quick brown fox", "the lazy dog"],
+        ["the fox jumps", "over the dog"],
+        ["brown fox red fox"],
+    ]
+    q = ssc.queueStream(batches)
+    counts = (q.flatMap(lambda line: line.split())
+               .map(lambda w: (w, 1))
+               .reduceByKeyAndWindow(operator.add, 0.75))
+    out = []
+    counts.collect_batches(out)
+    ssc.start()
+    deadline = time.time() + 10
+    while len(out) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    for t, batch in out[:3]:
+        print(sorted(batch, key=lambda kv: (-kv[1], kv[0]))[:4])
+    ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Bagel BSP tests: PageRank and shortest path convergence on a mini
+graph (reference: tests/test_bagel.py, SURVEY.md section 4)."""
+
+import operator
+
+import pytest
+
+from dpark_tpu.bagel import (Bagel, BasicCombiner, Edge, Message, Vertex,
+                             Aggregator)
+
+
+def make_graph(ctx, links):
+    """links: dict id -> list of target ids"""
+    n = len(links)
+    verts = ctx.parallelize(
+        [(i, Vertex(i, 1.0 / n, [Edge(t) for t in targets]))
+         for i, targets in links.items()], 2)
+    msgs = ctx.parallelize([], 2)
+    return verts, msgs, n
+
+
+GRAPH = {0: [1, 2], 1: [2], 2: [0], 3: [2]}
+
+
+class PRCompute:
+    """Fixed-iteration power method: every vertex stays active for
+    `steps` supersteps so rank mass is conserved."""
+
+    def __init__(self, n, damping=0.8, steps=25):
+        self.n = n
+        self.damping = damping
+        self.steps = steps
+
+    def __call__(self, vert, msg_sum, agg, superstep):
+        if superstep == 0:
+            new_value = vert.value
+        else:
+            incoming = msg_sum or 0.0
+            new_value = (1 - self.damping) / self.n + self.damping * incoming
+        active = superstep < self.steps
+        v = Vertex(vert.id, new_value, vert.outEdges, active)
+        if active and vert.outEdges:
+            share = new_value / len(vert.outEdges)
+            out = [Message(e.target_id, share) for e in vert.outEdges]
+        else:
+            out = []
+        return (v, out)
+
+
+def test_pagerank_converges(ctx):
+    verts, msgs, n = make_graph(ctx, GRAPH)
+    final = Bagel.run(ctx, verts, msgs, PRCompute(n),
+                      combiner=BasicCombiner(operator.add))
+    ranks = {vid: v.value for vid, v in final.collect()}
+    assert len(ranks) == 4
+    assert abs(sum(ranks.values()) - 1.0) < 0.02
+    # 2 has the most inbound links; 3 has none
+    assert ranks[2] == max(ranks.values())
+    assert ranks[3] == min(ranks.values())
+
+
+class SPCompute:
+    """Single-source shortest path over unit-weight edges."""
+
+    def __call__(self, vert, mail, agg, superstep):
+        best = vert.value
+        if mail:
+            best = min(best, min(mail))
+        if best < vert.value or superstep == 0:
+            v = Vertex(vert.id, best, vert.outEdges, False)
+            out = [Message(e.target_id, best + 1) for e in vert.outEdges] \
+                if best < float("inf") else []
+            return (v, out)
+        return (Vertex(vert.id, vert.value, vert.outEdges, False), [])
+
+
+def test_shortest_path(ctx):
+    import math
+    chain = {0: [1], 1: [2], 2: [3], 3: []}
+    inf = float("inf")
+    verts = ctx.parallelize(
+        [(i, Vertex(i, 0.0 if i == 0 else inf,
+                    [Edge(t) for t in targets]))
+         for i, targets in chain.items()], 2)
+    msgs = ctx.parallelize([], 2)
+    final = Bagel.run(ctx, verts, msgs, SPCompute())
+    dist = {vid: v.value for vid, v in final.collect()}
+    assert dist == {0: 0.0, 1: 1.0, 2: 2.0, 3: 3.0}
+
+
+class MaxAggregator(Aggregator):
+    def createAggregator(self, vert):
+        return vert.value
+
+    def mergeAggregators(self, a, b):
+        return max(a, b)
+
+
+def test_aggregator_visible_next_superstep(ctx):
+    seen = []
+
+    def compute(vert, mail, agg, superstep):
+        if superstep == 1:
+            seen.append(agg)
+        active = superstep < 1
+        return (Vertex(vert.id, vert.value, vert.outEdges, active),
+                [Message(vert.id, 0)] if active else [])
+
+    verts = ctx.parallelize(
+        [(i, Vertex(i, float(i), [])) for i in range(5)], 2)
+    msgs = ctx.parallelize([], 2)
+    Bagel.run(ctx, verts, msgs, compute, aggregator=MaxAggregator())
+    assert seen and all(a == 4.0 for a in seen)

@@ -1,0 +1,213 @@
+"""DStream tests — queueStream-driven with manual batch stepping for
+determinism (reference style: tests/test_dstream.py collects per-batch
+outputs and asserts sequences, SURVEY.md section 4)."""
+
+import operator
+import time
+
+import pytest
+
+from dpark_tpu.dstream import StreamingContext
+
+
+def make_ssc(ctx, batch=1.0):
+    return StreamingContext(ctx, batch)
+
+
+def run_batches(ssc, n, t0=1000.0):
+    """Deterministic manual clock: run n batches without the timer."""
+    ssc.ctx.start()
+    for ins in ssc.input_streams:
+        if type(ins).__name__ != "SocketInputDStream":
+            ins.start()
+    ssc.zero_time = t0
+    for k in range(1, n + 1):
+        ssc.run_batch(t0 + k * ssc.batch_duration)
+
+
+def test_map_filter_stream(ctx):
+    ssc = make_ssc(ctx)
+    out = []
+    q = ssc.queueStream([[1, 2, 3], [4, 5, 6]])
+    q.map(lambda x: x * 2).filter(lambda x: x > 4).collect_batches(out)
+    run_batches(ssc, 2)
+    assert [sorted(v) for _, v in out] == [[6], [8, 10, 12]]
+
+
+def test_flatmap_glom_count(ctx):
+    ssc = make_ssc(ctx)
+    out = []
+    q = ssc.queueStream([["a b", "c"], ["d e f"]])
+    q.flatMap(lambda line: line.split()).countByValue().collect_batches(out)
+    run_batches(ssc, 2)
+    assert dict(out[0][1]) == {"a": 1, "b": 1, "c": 1}
+    assert dict(out[1][1]) == {"d": 1, "e": 1, "f": 1}
+
+
+def test_reduce_by_key_stream(ctx):
+    ssc = make_ssc(ctx)
+    out = []
+    q = ssc.queueStream([[("a", 1), ("a", 2), ("b", 1)]])
+    q.reduceByKey(operator.add).collect_batches(out)
+    run_batches(ssc, 1)
+    assert dict(out[0][1]) == {"a": 3, "b": 1}
+
+
+def test_window(ctx):
+    ssc = make_ssc(ctx, batch=1.0)
+    out = []
+    q = ssc.queueStream([[1], [2], [3], [4]])
+    q.window(2.0).collect_batches(out)
+    run_batches(ssc, 4)
+    assert [sorted(v) for _, v in out] == [[1], [1, 2], [2, 3], [3, 4]]
+
+
+def test_count_by_window(ctx):
+    ssc = make_ssc(ctx, batch=1.0)
+    out = []
+    q = ssc.queueStream([[1, 1], [2], [3, 3, 3], []])
+    q.countByWindow(2.0).collect_batches(out)
+    run_batches(ssc, 4)
+    assert [v for _, v in out] == [[2], [3], [4], [3]]
+
+
+def test_reduce_by_key_and_window_plain(ctx):
+    ssc = make_ssc(ctx, batch=1.0)
+    out = []
+    q = ssc.queueStream([[("k", 1)], [("k", 2)], [("k", 4)], [("k", 8)]])
+    q.reduceByKeyAndWindow(operator.add, 2.0).collect_batches(out)
+    run_batches(ssc, 4)
+    assert [dict(v) for _, v in out] == [
+        {"k": 1}, {"k": 3}, {"k": 6}, {"k": 12}]
+
+
+def test_reduce_by_key_and_window_incremental(ctx):
+    ssc = make_ssc(ctx, batch=1.0)
+    out = []
+    q = ssc.queueStream([[("k", 1)], [("k", 2)], [("k", 4)], [("k", 8)]])
+    q.reduceByKeyAndWindow(operator.add, 2.0,
+                           invFunc=operator.sub).collect_batches(out)
+    run_batches(ssc, 4)
+    assert [dict(v) for _, v in out] == [
+        {"k": 1}, {"k": 3}, {"k": 6}, {"k": 12}]
+
+
+def test_update_state_by_key(ctx):
+    ssc = make_ssc(ctx)
+    out = []
+    q = ssc.queueStream([[("a", 1)], [("a", 2), ("b", 5)], [("b", 1)]])
+
+    def update(new_values, prev):
+        return sum(new_values) + (prev or 0)
+
+    q.updateStateByKey(update).collect_batches(out)
+    run_batches(ssc, 3)
+    assert dict(out[0][1]) == {"a": 1}
+    assert dict(out[1][1]) == {"a": 3, "b": 5}
+    assert dict(out[2][1]) == {"a": 3, "b": 6}
+
+
+def test_state_eviction(ctx):
+    """update returning None drops the key."""
+    ssc = make_ssc(ctx)
+    out = []
+    q = ssc.queueStream([[("a", 1), ("b", 1)], [("b", 1)], [("b", 1)]])
+
+    def update(new_values, prev):
+        if not new_values:
+            return None                 # evict idle keys
+        return sum(new_values) + (prev or 0)
+
+    q.updateStateByKey(update).collect_batches(out)
+    run_batches(ssc, 3)
+    assert dict(out[2][1]) == {"b": 3}
+
+
+def test_union_join_streams(ctx):
+    ssc = make_ssc(ctx)
+    out_u, out_j = [], []
+    a = ssc.queueStream([[("x", 1)], [("y", 2)]])
+    b = ssc.queueStream([[("x", 10)], [("y", 20)]])
+    a.union(b).collect_batches(out_u)
+    a.join(b).collect_batches(out_j)
+    run_batches(ssc, 2)
+    assert sorted(out_u[0][1]) == [("x", 1), ("x", 10)]
+    assert out_j[0][1] == [("x", (1, 10))]
+    assert out_j[1][1] == [("y", (2, 20))]
+
+
+def test_transform_with_time(ctx):
+    ssc = make_ssc(ctx)
+    out = []
+    q = ssc.queueStream([[1], [2]])
+    q.transform(lambda rdd, t: rdd.map(lambda x: (x, t))) \
+     .collect_batches(out)
+    run_batches(ssc, 2, t0=100.0)
+    assert out[0][1] == [(1, 101.0)]
+    assert out[1][1] == [(2, 102.0)]
+
+
+def test_file_input_stream(ctx, tmp_path):
+    d = tmp_path / "stream"
+    d.mkdir()
+    ssc = make_ssc(ctx)
+    out = []
+    s = ssc.textFileStream(str(d))
+    s.collect_batches(out)
+    ssc.ctx.start()
+    s.start()
+    ssc.zero_time = 0.0
+    (d / "f1.txt").write_text("l1\nl2\n")
+    ssc.run_batch(1.0)
+    (d / "f2.txt").write_text("l3\n")
+    ssc.run_batch(2.0)
+    ssc.run_batch(3.0)
+    assert [v for _, v in out] == [["l1", "l2"], ["l3"]]
+
+
+def test_timer_driven_end_to_end(ctx):
+    """Real timer path: small batches, wait for results."""
+    ssc = make_ssc(ctx, batch=0.2)
+    out = []
+    q = ssc.queueStream([[("a", 1)], [("a", 2)], [("a", 4)]])
+    q.reduceByKey(operator.add).collect_batches(out)
+    ssc.start()
+    deadline = time.time() + 10
+    while len(out) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    assert len(out) >= 3
+    got = [dict(v) for _, v in out[:3]]
+    assert got == [{"a": 1}, {"a": 2}, {"a": 4}]
+
+
+def test_socket_text_stream(ctx):
+    import socket
+    import threading
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+    port = server.getsockname()[1]
+
+    def serve():
+        conn, _ = server.accept()
+        conn.sendall(b"hello\nworld\n")
+        time.sleep(1.0)
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    ssc = make_ssc(ctx, batch=0.2)
+    out = []
+    s = ssc.socketTextStream("127.0.0.1", port)
+    s.collect_batches(out)
+    ssc.start()
+    deadline = time.time() + 8
+    while not out and time.time() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    server.close()
+    flat = [x for _, v in out for x in v]
+    assert flat == ["hello", "world"]

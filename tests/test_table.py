@@ -1,0 +1,113 @@
+"""Table DSL + sketches tests (reference: tests/test_table.py style,
+SURVEY.md section 4)."""
+
+import pytest
+
+
+@pytest.fixture()
+def sales(ctx):
+    rows = [("north", "apple", 3, 1.5),
+            ("north", "pear", 2, 2.0),
+            ("south", "apple", 5, 1.4),
+            ("south", "pear", 1, 2.2),
+            ("south", "apple", 2, 1.6)]
+    return ctx.parallelize(rows, 2).asTable(
+        "region item qty price", name="sales")
+
+
+def test_select_exprs(sales):
+    t = sales.select("item", "qty * price as total")
+    got = t.collect()
+    assert t.fields == ["item", "total"]
+    assert got[0].item == "apple" and abs(got[0].total - 4.5) < 1e-9
+
+
+def test_where(sales):
+    t = sales.where("qty > 2", "region == 'south'")
+    assert [r.item for r in t.collect()] == ["apple"]
+
+
+def test_group_by(sales):
+    t = sales.groupBy("region", "sum(qty) as total_qty",
+                      "count(*) as n", "avg(price) as avg_price")
+    got = {r.region: r for r in t.collect()}
+    assert got["north"].total_qty == 5
+    assert got["north"].n == 2
+    assert got["south"].n == 3
+    assert abs(got["south"].avg_price - (1.4 + 2.2 + 1.6) / 3) < 1e-9
+
+
+def test_group_by_min_max(sales):
+    t = sales.groupBy("item", "min(price) as lo", "max(price) as hi")
+    got = {r.item: r for r in t.collect()}
+    assert got["apple"].lo == 1.4 and got["apple"].hi == 1.6
+    assert got["pear"].lo == 2.0 and got["pear"].hi == 2.2
+
+
+def test_global_aggregate(sales):
+    t = sales.select("sum(qty) as total", "count(*) as n")
+    (row,) = t.collect()
+    assert row.total == 13 and row.n == 5
+
+
+def test_sort_top(sales):
+    t = sales.sort("qty", reverse=True)
+    rows = t.collect()
+    assert [r.qty for r in rows] == [5, 3, 2, 2, 1]
+    top2 = sales.top(2, key="qty")
+    assert [r.qty for r in top2] == [5, 3]
+
+
+def test_join(ctx, sales):
+    prices = ctx.parallelize(
+        [("apple", "fruit"), ("pear", "fruit")], 2).asTable(
+        "item category", name="cat")
+    j = sales.select("item", "qty").join(prices, on="item")
+    got = j.collect()
+    assert len(got) == 5
+    assert all(r.category == "fruit" for r in got)
+
+
+def test_adcount(ctx):
+    t = ctx.parallelize([(i % 100, i) for i in range(10000)], 4) \
+           .asTable("k v")
+    (row,) = t.select("adcount(k) as distinct_keys").collect()
+    assert 90 <= row.distinct_keys <= 110
+
+
+def test_rdd_adcount_accuracy(ctx):
+    n = ctx.parallelize(list(range(5000)), 4).adcount()
+    assert 4500 <= n <= 5500
+
+
+def test_hotcounter():
+    from dpark_tpu.hotcounter import HotCounter
+    hc = HotCounter(capacity=50)
+    for i in range(10000):
+        hc.add(i % 200)             # uniform noise
+    for _ in range(500):
+        hc.add("hot1")
+    for _ in range(300):
+        hc.add("hot2")
+    top = [v for v, _ in hc.top(2)]
+    assert "hot1" in top and "hot2" in top
+
+
+def test_hyperloglog_merge():
+    from dpark_tpu.hyperloglog import HyperLogLog
+    a, b = HyperLogLog(), HyperLogLog()
+    for i in range(3000):
+        a.add(i)
+    for i in range(2000, 6000):
+        b.add(i)
+    a.update(b)
+    assert 5400 <= len(a) <= 6600
+
+
+def test_ctx_table_roundtrip(ctx, tmp_path):
+    rows = [(i, i * i) for i in range(100)]
+    ctx.parallelize(rows, 3).saveAsTableFile(str(tmp_path / "t"))
+    t = ctx.table(str(tmp_path / "t"), fields="a b")
+    assert t.count() == 100
+    got = t.where("a == 7").collect()
+    assert got[0].b == 49
