@@ -86,11 +86,11 @@ class TPUScheduler(DAGScheduler):
         if kind == "shuffle":
             uri = "hbm://%d" % result
             for task in tasks:
-                report(task, "success", (uri, {}))
+                report(task, "success", (uri, {}, {}))
         else:
             rows_per_part = result
             for task in tasks:
                 assert isinstance(task, ResultTask)
                 value = task.func(iter(rows_per_part[task.partition]))
-                report(task, "success", (value, {}))
+                report(task, "success", (value, {}, {}))
         logger.debug("array path ran %s (%d tasks)", stage, len(tasks))

@@ -70,6 +70,8 @@ class DparkContext:
         if self.started:
             return
         env.start(is_master=True)
+        if self.options.mem:
+            env.mem_limit = self.options.mem
         master, _, arg = self.master.partition(":")
         if master == "local":
             from dpark_tpu.schedule import LocalScheduler

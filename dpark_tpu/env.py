@@ -85,8 +85,11 @@ class DparkEnv:
         return tempfile.mkdtemp(prefix="dpark-")
 
     def environ_for_worker(self):
-        return {"DPARK_SESSION": self.session_id,
-                "DPARK_WORKDIR": self.workdir}
+        out = {"DPARK_SESSION": self.session_id,
+               "DPARK_WORKDIR": self.workdir}
+        if getattr(self, "mem_limit", None):
+            out["DPARK_MEM_LIMIT"] = str(self.mem_limit)
+        return out
 
     def stop(self):
         if not self.started:

@@ -1,0 +1,74 @@
+"""Per-host task failure tracking with decay.
+
+Reference parity: dpark/hostatus.py (TaskHostManager) — the scheduler
+consults it to avoid repeatedly dispatching onto failing hosts (SURVEY.md
+sections 2.1 and 5.3).  For the single-host masters this gates worker
+*processes*; the multi-host DCN layer uses it per hostname.
+"""
+
+import time
+
+
+class HostStatus:
+    def __init__(self, host, purge_elapsed=60 * 3):
+        self.host = host
+        self.purge_elapsed = purge_elapsed
+        self.failures = []            # timestamps
+        self.successes = []
+
+    def task_succeed(self, now=None):
+        self.successes.append(now if now is not None else time.time())
+
+    def task_failed(self, now=None):
+        self.failures.append(now if now is not None else time.time())
+
+    def purge_old(self, now=None):
+        now = now if now is not None else time.time()
+        horizon = now - self.purge_elapsed
+        self.failures = [t for t in self.failures if t >= horizon]
+        self.successes = [t for t in self.successes if t >= horizon]
+
+    def recent_failure_rate(self, now=None):
+        self.purge_old(now)
+        total = len(self.failures) + len(self.successes)
+        if not total:
+            return 0.0
+        return len(self.failures) / total
+
+    def should_forbid(self, now=None, threshold=0.8, min_failures=3):
+        self.purge_old(now)
+        return (len(self.failures) >= min_failures
+                and self.recent_failure_rate(now) >= threshold)
+
+
+class TaskHostManager:
+    def __init__(self, purge_elapsed=60 * 3):
+        self.hosts = {}
+        self.purge_elapsed = purge_elapsed
+
+    def _host(self, host):
+        st = self.hosts.get(host)
+        if st is None:
+            st = self.hosts[host] = HostStatus(host, self.purge_elapsed)
+        return st
+
+    def task_succeed_on(self, host, now=None):
+        self._host(host).task_succeed(now)
+
+    def task_failed_on(self, host, now=None):
+        self._host(host).task_failed(now)
+
+    def is_blacklisted(self, host, now=None):
+        st = self.hosts.get(host)
+        return st is not None and st.should_forbid(now)
+
+    def offer_choice(self, hosts, now=None):
+        """Pick the best host from candidates: never-blacklisted first,
+        fewest recent failures next (reference: task_prefered_hosts)."""
+        ranked = sorted(
+            (h for h in hosts if not self.is_blacklisted(h, now)),
+            key=lambda h: self.hosts[h].recent_failure_rate(now)
+            if h in self.hosts else 0.0)
+        if ranked:
+            return ranked[0]
+        return hosts[0] if hosts else None

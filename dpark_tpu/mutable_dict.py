@@ -1,0 +1,149 @@
+"""MutableDict: coarse-grained mutable KV usable inside jobs.
+
+Reference parity: dpark/mutable_dict.py (SURVEY.md section 2.1) — a
+partitioned dict whose writes inside tasks are buffered per-process and
+merged back on the driver after each job, with conflict resolution by
+write generation (last generation wins).  Reads see the driver snapshot
+from job start (shipped via broadcast-like file), plus local writes.
+"""
+
+import os
+import pickle
+import threading
+import uuid
+
+from dpark_tpu.utils import atomic_file, compress, decompress
+from dpark_tpu.utils.phash import portable_hash
+
+_registry = {}           # uuid -> MutableDict instance in this process
+_local = threading.local()
+
+
+class MutableDict:
+    def __init__(self, partitions=16):
+        self.uuid = uuid.uuid4().hex
+        self.partitions = partitions
+        self.generation = 0
+        self.data = {}                   # driver-side authoritative
+        self._key_gen = {}               # key -> generation of last write
+        self._updates = {}               # worker-side buffered writes
+        self.is_driver = True
+        _registry[self.uuid] = self
+        self._snapshot_path_cache = None
+
+    # -- api used inside and outside tasks -------------------------------
+    def get(self, key, default=None):
+        updates = self._updates
+        if key in updates:
+            return updates[key][0]
+        return self.data.get(key, default)
+
+    def _on_driver(self):
+        """Fork-safe driver detection: instance flags survive fork, the
+        env singleton's is_master is corrected by the worker bootstrap."""
+        from dpark_tpu.env import env
+        return self.is_driver and (not env.started or env.is_master)
+
+    def put(self, key, value):
+        if self._on_driver():
+            self.generation += 1         # new snapshot for the next job
+            self.data[key] = value
+            self._key_gen[key] = self.generation
+        else:
+            self._updates[key] = (value, self.generation + 1)
+
+    def __getitem__(self, key):
+        val = self.get(key, _MISSING)
+        if val is _MISSING:
+            raise KeyError(key)
+        return val
+
+    def __setitem__(self, key, value):
+        self.put(key, value)
+
+    def __contains__(self, key):
+        return self.get(key, _MISSING) is not _MISSING
+
+    def items(self):
+        merged = dict(self.data)
+        merged.update({k: v for k, (v, g) in self._updates.items()})
+        return merged.items()
+
+    def partition_of(self, key):
+        return portable_hash(key) % self.partitions
+
+    # -- shipping ---------------------------------------------------------
+    def _snapshot_path(self):
+        from dpark_tpu.env import env
+        d = os.path.join(env.workdir, "mutable_dict")
+        return os.path.join(d, "%s-%d" % (self.uuid, self.generation))
+
+    def _write_snapshot(self):
+        path = self._snapshot_path()
+        if not os.path.exists(path):
+            with atomic_file(path) as f:
+                f.write(compress(pickle.dumps(self.data, -1)))
+        return path
+
+    def __getstate__(self):
+        path = self._write_snapshot() if self.is_driver else None
+        return (self.uuid, self.partitions, self.generation,
+                path or self._snapshot_path_cache)
+
+    def __setstate__(self, state):
+        self.uuid, self.partitions, self.generation, path = state
+        self._snapshot_path_cache = path
+        existing = _registry.get(self.uuid)
+        if existing is not None and existing.generation >= self.generation:
+            self.__dict__ = existing.__dict__
+            return
+        self.is_driver = False
+        self._updates = {}
+        self.data = {}
+        self._key_gen = {}
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                self.data = pickle.loads(decompress(f.read()))
+        _registry[self.uuid] = self
+
+    # -- task lifecycle (driver merges updates shipped with results) -----
+    def flush_updates(self):
+        ups, self._updates = self._updates, {}
+        return ups
+
+    def merge_updates(self, updates):
+        """Driver-side merge: per-key, a write from generation >= the
+        key's last-written generation wins (same-generation tasks of one
+        job race arbitrarily — reference semantics)."""
+        for key, (value, gen) in updates.items():
+            if gen >= self._key_gen.get(key, -1):
+                self.data[key] = value
+                self._key_gen[key] = gen
+
+
+_MISSING = object()
+
+
+def clear_task_updates():
+    """Drop buffered writes (task start, and after a failed task) so a
+    failed attempt's partial state never ships with a later task."""
+    for md in _registry.values():
+        if not md._on_driver():
+            md._updates = {}
+
+
+def collect_task_updates():
+    """Gather buffered updates from every MutableDict in this process
+    (called by the task runner, shipped back with results)."""
+    out = {}
+    for u, md in _registry.items():
+        if not md._on_driver() and md._updates:
+            out[u] = md.flush_updates()
+    return out
+
+
+def merge_on_driver(all_updates):
+    for u, updates in (all_updates or {}).items():
+        md = _registry.get(u)
+        if md is not None and md._on_driver():
+            md.merge_updates(updates)

@@ -1,0 +1,201 @@
+"""ctypes bindings for the C++ host kernels (dpark_tpu/native/native.cpp).
+
+Reference parity: replaces dpark's Cython portable_hash + C crc32c + native
+codec dependencies (SURVEY.md section 2.6).  The shared library is built
+lazily with g++ on first import and cached next to the source; every
+binding degrades to a pure-Python fallback when no compiler is available.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native.cpp")
+_SO = os.path.join(_HERE, "libdpark_native.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    import tempfile
+    fd, tmp = tempfile.mkstemp(prefix=".build-", suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+               "-o", tmp, _SRC]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, _SO)        # atomic rename: concurrent builds safe
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def get_lib():
+    """The loaded shared library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.CalledProcessError) as e:
+            logger.info("native library unavailable (%s); pure-Python "
+                        "fallbacks in use", e)
+            return None
+        lib.phash_i64.restype = ctypes.c_uint32
+        lib.phash_i64.argtypes = [ctypes.c_int64]
+        lib.phash_i64_array.restype = None
+        lib.phash_i64_array.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.phash_bytes.restype = ctypes.c_uint32
+        lib.phash_bytes.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.crc32c.restype = ctypes.c_uint32
+        lib.crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                               ctypes.c_uint32]
+        lib.split_lines.restype = ctypes.c_int64
+        lib.split_lines.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_int64]
+        lib.tokendict_new.restype = ctypes.c_void_p
+        lib.tokendict_free.argtypes = [ctypes.c_void_p]
+        lib.tokendict_size.restype = ctypes.c_int64
+        lib.tokendict_size.argtypes = [ctypes.c_void_p]
+        lib.tokendict_encode.restype = ctypes.c_int64
+        lib.tokendict_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64]
+        lib.tokendict_get.restype = ctypes.c_int64
+        lib.tokendict_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def phash_i64_bulk(keys):
+    """uint32 portable hash of an int64 numpy array (C++ when available)."""
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    lib = get_lib()
+    out = np.empty(keys.shape, dtype=np.uint32)
+    if lib is not None:
+        lib.phash_i64_array(keys.ctypes.data, out.ctypes.data, keys.size)
+        return out
+    from dpark_tpu.utils.phash import portable_hash
+    for i, k in enumerate(keys.ravel()):
+        out.ravel()[i] = portable_hash(int(k))
+    return out
+
+
+def crc32c(data, crc=0):
+    lib = get_lib()
+    if lib is not None:
+        return lib.crc32c(bytes(data), len(data), crc)
+    # pure-Python table fallback
+    global _py_table
+    if "_py_table" not in globals():
+        t = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else (c >> 1)
+            t.append(c)
+        globals()["_py_table"] = t
+    c = crc ^ 0xFFFFFFFF
+    for b in bytes(data):
+        c = _py_table[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def split_lines(buf):
+    """(starts, lens) int64 arrays for the lines of `buf` (bytes)."""
+    lib = get_lib()
+    n = len(buf)
+    if lib is not None:
+        max_lines = buf.count(b"\n") + 1
+        starts = np.empty(max_lines, dtype=np.int64)
+        lens = np.empty(max_lines, dtype=np.int64)
+        cnt = lib.split_lines(buf, n, starts.ctypes.data,
+                              lens.ctypes.data, max_lines)
+        return starts[:cnt], lens[:cnt]
+    starts, lens = [], []
+    off = 0
+    for line in buf.split(b"\n"):
+        body = line[:-1] if line.endswith(b"\r") else line
+        if off < n or body:
+            starts.append(off)
+            lens.append(len(body))
+        off += len(line) + 1
+    if buf.endswith(b"\n") and starts and lens[-1] == 0 \
+            and starts[-1] >= n:
+        starts.pop()
+        lens.pop()
+    return (np.array(starts, dtype=np.int64),
+            np.array(lens, dtype=np.int64))
+
+
+class TokenDict:
+    """Exact string->dense-id dictionary encoder (C++ hashmap inside)."""
+
+    def __init__(self):
+        self._lib = get_lib()
+        if self._lib is not None:
+            self._h = self._lib.tokendict_new()
+        else:
+            self._h = None
+            self._map = {}
+            self._rev = []
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None \
+                and getattr(self, "_h", None):
+            self._lib.tokendict_free(self._h)
+            self._h = None
+
+    def __len__(self):
+        if self._h:
+            return self._lib.tokendict_size(self._h)
+        return len(self._rev)
+
+    def encode(self, buf):
+        """Tokenize bytes on whitespace -> int64 id array."""
+        if isinstance(buf, str):
+            buf = buf.encode("utf-8")
+        if self._h:
+            max_tokens = max(1, len(buf) // 2 + 1)
+            out = np.empty(max_tokens, dtype=np.int64)
+            cnt = self._lib.tokendict_encode(
+                self._h, buf, len(buf), out.ctypes.data, max_tokens)
+            return out[:cnt]
+        ids = []
+        for tok in buf.split():
+            tid = self._map.get(tok)
+            if tid is None:
+                tid = len(self._rev)
+                self._map[tok] = tid
+                self._rev.append(tok)
+            ids.append(tid)
+        return np.array(ids, dtype=np.int64)
+
+    def decode(self, tid):
+        if self._h:
+            buf = ctypes.create_string_buffer(1 << 16)
+            n = self._lib.tokendict_get(self._h, int(tid), buf, len(buf))
+            if n < 0:
+                raise KeyError(tid)
+            return buf.raw[:n].decode("utf-8", "replace")
+        return self._rev[tid].decode("utf-8", "replace")

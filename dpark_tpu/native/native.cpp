@@ -1,0 +1,164 @@
+// Host-side native kernels for dpark_tpu (reference parity: the reference's
+// native bits were portable_hash.pyx [Cython], crc32c C speedups and lz4
+// codecs — SURVEY.md section 2.6).  TPU-native equivalents: bulk portable
+// hashing for partition planning, crc32c for storage integrity, newline
+// splitting and dictionary token encoding to feed device_put with columnar
+// data.  Compiled with plain g++ into libdpark_native.so, bound via ctypes
+// (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <unordered_map>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// --------------------------------------------------------------------------
+// portable hash: murmur3 fmix32 over (lo ^ hi) words, bit-identical to
+// dpark_tpu/utils/phash.py portable_hash()/_hash_int and phash_device().
+// --------------------------------------------------------------------------
+static inline uint32_t fmix32(uint32_t h) {
+    h ^= h >> 16;
+    h *= 0x85EBCA6Bu;
+    h ^= h >> 13;
+    h *= 0xC2B2AE35u;
+    h ^= h >> 16;
+    return h;
+}
+
+uint32_t phash_i64(int64_t x) {
+    uint64_t u = (uint64_t)x;
+    uint32_t lo = (uint32_t)(u & 0xFFFFFFFFu);
+    uint32_t hi = (uint32_t)((u >> 32) & 0xFFFFFFFFu);
+    return fmix32(lo ^ hi);
+}
+
+void phash_i64_array(const int64_t* xs, uint32_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; i++) out[i] = phash_i64(xs[i]);
+}
+
+// FNV-1a over bytes + fmix32 finalizer — matches phash.py _hash_bytes.
+uint32_t phash_bytes(const uint8_t* data, int64_t n) {
+    uint32_t h = 0x811C9DC5u;
+    for (int64_t i = 0; i < n; i++) {
+        h = (h ^ data[i]) * 0x01000193u;
+    }
+    return fmix32(h);
+}
+
+// --------------------------------------------------------------------------
+// crc32c (Castagnoli), table-driven — storage integrity (beansdb records,
+// tabular chunks).  Standard polynomial 0x82F63B78.
+// --------------------------------------------------------------------------
+static uint32_t crc32c_table[256];
+static bool crc32c_ready = false;
+
+static void crc32c_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+        crc32c_table[i] = c;
+    }
+    crc32c_ready = true;
+}
+
+uint32_t crc32c(const uint8_t* data, int64_t n, uint32_t crc) {
+    if (!crc32c_ready) crc32c_init();
+    crc = ~crc;
+    for (int64_t i = 0; i < n; i++)
+        crc = crc32c_table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+    return ~crc;
+}
+
+// --------------------------------------------------------------------------
+// newline splitter: fill start/length arrays for each line in buf.
+// Returns the number of lines found (at most max_lines); a trailing
+// fragment without '\n' counts as a line.
+// --------------------------------------------------------------------------
+int64_t split_lines(const uint8_t* buf, int64_t n,
+                    int64_t* starts, int64_t* lens, int64_t max_lines) {
+    int64_t count = 0;
+    int64_t start = 0;
+    for (int64_t i = 0; i < n && count < max_lines; i++) {
+        if (buf[i] == '\n') {
+            int64_t len = i - start;
+            if (len > 0 && buf[start + len - 1] == '\r') len--;
+            starts[count] = start;
+            lens[count] = len;
+            count++;
+            start = i + 1;
+        }
+    }
+    if (start < n && count < max_lines) {
+        starts[count] = start;
+        lens[count] = n - start;
+        count++;
+    }
+    return count;
+}
+
+// --------------------------------------------------------------------------
+// TokenDict: exact string -> dense int64 id dictionary encoder.  Feeds the
+// device wordcount path: host tokenizes+encodes, device reduces int64 ids,
+// host decodes ids back to strings.  (The reference counts Python strings
+// in dicts; this is the columnar equivalent.)
+// --------------------------------------------------------------------------
+struct TokenDict {
+    std::unordered_map<std::string, int64_t> map;
+    std::vector<std::string> rev;
+};
+
+void* tokendict_new() { return new TokenDict(); }
+
+void tokendict_free(void* h) { delete (TokenDict*)h; }
+
+int64_t tokendict_size(void* h) {
+    return (int64_t)((TokenDict*)h)->rev.size();
+}
+
+// Tokenize buf on ASCII whitespace, encode each token to its id (assigning
+// new ids in first-seen order), write ids into out (capacity max_tokens).
+// Returns the number of tokens written.
+int64_t tokendict_encode(void* h, const uint8_t* buf, int64_t n,
+                         int64_t* out, int64_t max_tokens) {
+    TokenDict* d = (TokenDict*)h;
+    int64_t count = 0;
+    int64_t i = 0;
+    while (i < n && count < max_tokens) {
+        while (i < n && (buf[i] == ' ' || buf[i] == '\t' ||
+                         buf[i] == '\n' || buf[i] == '\r')) i++;
+        if (i >= n) break;
+        int64_t start = i;
+        while (i < n && !(buf[i] == ' ' || buf[i] == '\t' ||
+                          buf[i] == '\n' || buf[i] == '\r')) i++;
+        std::string tok((const char*)buf + start, (size_t)(i - start));
+        auto it = d->map.find(tok);
+        int64_t id;
+        if (it == d->map.end()) {
+            id = (int64_t)d->rev.size();
+            d->map.emplace(std::move(tok), id);
+            d->rev.push_back(std::string((const char*)buf + start,
+                                         (size_t)(i - start)));
+        } else {
+            id = it->second;
+        }
+        out[count++] = id;
+    }
+    return count;
+}
+
+// Copy token `id` into out (capacity cap); returns its length or -1.
+int64_t tokendict_get(void* h, int64_t id, uint8_t* out, int64_t cap) {
+    TokenDict* d = (TokenDict*)h;
+    if (id < 0 || id >= (int64_t)d->rev.size()) return -1;
+    const std::string& s = d->rev[(size_t)id];
+    int64_t n = (int64_t)s.size();
+    if (n > cap) return -1;
+    std::memcpy(out, s.data(), (size_t)n);
+    return n;
+}
+
+}  // extern "C"

@@ -205,8 +205,11 @@ class DAGScheduler:
             in_flight[0] -= 1
             stage = stage_of.get(task.stage_id)
             if status == "success":
-                result, acc_updates = payload
+                result, acc_updates, md_updates = payload
                 accumulator.merge_on_driver(acc_updates)
+                if md_updates:
+                    from dpark_tpu import mutable_dict
+                    mutable_dict.merge_on_driver(md_updates)
                 if isinstance(task, ResultTask):
                     idx = task.output_id
                     if not finished[idx]:
@@ -280,16 +283,21 @@ class DAGScheduler:
 
 
 def _run_task_inline(task):
+    from dpark_tpu import mutable_dict
     accumulator.start_task()
+    mutable_dict.clear_task_updates()
     try:
         result = task.run(task.tried)
         updates = accumulator.finish_task()
-        return "success", (result, updates)
+        md_updates = mutable_dict.collect_task_updates()
+        return "success", (result, updates, md_updates)
     except FetchFailed as e:
         accumulator.finish_task()
+        mutable_dict.clear_task_updates()
         return "fetch_failed", e
     except Exception:
         accumulator.finish_task()
+        mutable_dict.clear_task_updates()
         return "failed", traceback.format_exc()
 
 
@@ -312,13 +320,30 @@ class LocalScheduler(DAGScheduler):
 def _process_worker(task_bytes, snapshot, environ):
     """Runs in a forked pool worker; returns result bytes (our serializer,
     so arbitrary user values survive the trip back)."""
+    from dpark_tpu.utils import memory as memutil
     env.start(is_master=False, environ=environ)
+    env.is_master = False      # fork inherits the driver's started env
     env.map_output_tracker.update(snapshot)
     try:
         task = serialize.loads(task_bytes)
     except Exception:
         return pickle.dumps(("failed", traceback.format_exc()), -1)
-    status, payload = _run_task_inline(task)
+    limit = float(environ.get("DPARK_MEM_LIMIT") or 0)
+    checker = None
+    if limit and task.tried >= conf.MAX_TASK_FAILURES - 1:
+        limit = 0.0        # final attempt runs unrestricted
+    if limit:
+        # escalate the budget on retries (reference: memory-kill + retry
+        # with more memory, SURVEY.md 5.3), capped by MAX_TASK_MEMORY
+        limit = min(limit * (1 << task.tried), conf.MAX_TASK_MEMORY)
+        checker = memutil.MemoryChecker(limit).start()
+        memutil.current_checker = checker
+    try:
+        status, payload = _run_task_inline(task)
+    finally:
+        if checker is not None:
+            checker.stop()
+            memutil.current_checker = None
     try:
         return serialize.dumps((status, payload))
     except Exception:

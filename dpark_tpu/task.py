@@ -59,6 +59,8 @@ class ShuffleMapTask(Task):
         n = dep.partitioner.num_partitions
         buckets = [{} for _ in range(n)]
         create, merge = agg.create_combiner, agg.merge_value
+        from dpark_tpu.utils.memory import maybe_check
+        i = 0
         # HOT LOOP (reference 3.1 #2): per-record hash + dict combine.  On
         # the TPU backend this loop is replaced by device-side
         # sort+segment_sum (backend/tpu/), this path serves local/process.
@@ -68,6 +70,9 @@ class ShuffleMapTask(Task):
                 b[k] = merge(b[k], v)
             else:
                 b[k] = create(v)
+            i += 1
+            if not (i & 0x3FFF):
+                maybe_check()        # RSS limit (process master policing)
         return LocalFileShuffle.write_buckets(
             dep.shuffle_id, self.partition, buckets)
 

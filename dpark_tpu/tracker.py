@@ -1,0 +1,206 @@
+"""Central KV tracker service over TCP.
+
+Reference parity: dpark/tracker.py — a tiny zmq REQ/REP KV server carrying
+map-output and cache locations between driver and executors (SURVEY.md
+section 2.8).  This implementation speaks length-prefixed pickle over a
+plain TCP socket (no zmq dependency): the single-host masters use the
+in-process MapOutputTracker in env.py; this server is the DCN metadata
+plane for multi-host deployments (driver runs TrackerServer, remote hosts
+use TrackerClient).
+"""
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("tracker")
+
+
+import uuid as _uuid
+
+
+class GetValueMessage:
+    def __init__(self, key):
+        self.key = key
+
+
+class _Mutation:
+    """Mutating messages carry a unique id; the server dedups replays so a
+    client's retry-after-connection-error is exactly-once."""
+
+    def __init__(self):
+        self.msg_id = _uuid.uuid4().hex
+
+
+class SetValueMessage(_Mutation):
+    def __init__(self, key, value):
+        super().__init__()
+        self.key = key
+        self.value = value
+
+
+class AddItemMessage(_Mutation):
+    def __init__(self, key, item):
+        super().__init__()
+        self.key = key
+        self.item = item
+
+
+class RemoveItemMessage(_Mutation):
+    def __init__(self, key, item):
+        super().__init__()
+        self.key = key
+        self.item = item
+
+
+class StopTrackerMessage:
+    pass
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, -1)
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_msg(sock):
+    header = _recv_exact(sock, 4)
+    (n,) = struct.unpack("<I", header)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("tracker connection closed")
+        buf += chunk
+    return buf
+
+
+class TrackerServer:
+    def __init__(self, host="0.0.0.0", port=0):
+        self.data = {}
+        self.lock = threading.Lock()
+        self._applied = {}          # msg_id -> reply (bounded)
+        self._applied_order = []
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        reply = outer._handle(msg)
+                        _send_msg(self.request, reply)
+                        if isinstance(msg, StopTrackerMessage):
+                            outer._server.shutdown()
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self._thread = None
+
+    @property
+    def addr(self):
+        host, port = self._server.server_address[:2]
+        if host == "0.0.0.0":
+            host = socket.gethostname()
+        return "%s:%d" % (host, port)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        logger.debug("tracker server on %s", self.addr)
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(2)
+            self._thread = None
+
+    def _handle(self, msg):
+        with self.lock:
+            if isinstance(msg, GetValueMessage):
+                return self.data.get(msg.key)
+            if isinstance(msg, _Mutation):
+                if msg.msg_id in self._applied:
+                    return self._applied[msg.msg_id]    # retry replay
+                if isinstance(msg, SetValueMessage):
+                    self.data[msg.key] = msg.value
+                elif isinstance(msg, AddItemMessage):
+                    self.data.setdefault(msg.key, []).append(msg.item)
+                elif isinstance(msg, RemoveItemMessage):
+                    items = self.data.get(msg.key, [])
+                    if msg.item in items:
+                        items.remove(msg.item)
+                self._applied[msg.msg_id] = True
+                self._applied_order.append(msg.msg_id)
+                if len(self._applied_order) > 100_000:
+                    old = self._applied_order[:50_000]
+                    del self._applied_order[:50_000]
+                    for mid in old:
+                        self._applied.pop(mid, None)
+                return True
+            if isinstance(msg, StopTrackerMessage):
+                return True
+        return None
+
+
+class TrackerClient:
+    def __init__(self, addr):
+        host, _, port = addr.partition(":")
+        self.addr = (host, int(port))
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _conn(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(self.addr, timeout=30)
+        return self._sock
+
+    def call(self, msg):
+        with self._lock:
+            try:
+                sock = self._conn()
+                _send_msg(sock, msg)
+                return _recv_msg(sock)
+            except (ConnectionError, OSError):
+                self.close()
+                sock = self._conn()
+                _send_msg(sock, msg)
+                return _recv_msg(sock)
+
+    def get(self, key):
+        return self.call(GetValueMessage(key))
+
+    def set(self, key, value):
+        return self.call(SetValueMessage(key, value))
+
+    def add_item(self, key, item):
+        return self.call(AddItemMessage(key, item))
+
+    def remove_item(self, key, item):
+        return self.call(RemoveItemMessage(key, item))
+
+    def stop_server(self):
+        return self.call(StopTrackerMessage())
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

@@ -1,0 +1,88 @@
+"""Worker memory policing.
+
+Reference parity: dpark/utils/memory.py (MemoryChecker) — psutil-based RSS
+tracking inside executor workers; over-limit tasks are killed and retried
+with more memory (SURVEY.md sections 2.1 and 5.3).  Works without psutil
+by reading /proc/self/statm.
+"""
+
+import os
+import threading
+
+try:
+    import psutil
+except ImportError:
+    psutil = None
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def rss_mb(pid=None):
+    """Resident set size of a process in MB."""
+    if psutil is not None:
+        p = psutil.Process(pid) if pid else psutil.Process()
+        return p.memory_info().rss / (1 << 20)
+    path = "/proc/%s/statm" % (pid or "self")
+    try:
+        with open(path) as f:
+            fields = f.read().split()
+        return int(fields[1]) * _PAGE / (1 << 20)
+    except (OSError, IndexError, ValueError):
+        return 0.0
+
+
+class MemoryExceeded(MemoryError):
+    def __init__(self, used_mb, limit_mb):
+        super().__init__("task used %.0fMB > limit %.0fMB"
+                         % (used_mb, limit_mb))
+        self.used_mb = used_mb
+        self.limit_mb = limit_mb
+
+
+# process-wide checker installed by the worker bootstrap; hot loops call
+# maybe_check() periodically (reference: executor-side RSS policing)
+current_checker = None
+
+
+def maybe_check():
+    if current_checker is not None:
+        current_checker.check()
+
+
+class MemoryChecker:
+    """Background sampler; raises in the worker (via a flag the task loop
+    checks) or reports a peak.  The process master multiplies the limit by
+    the retry count so OOM-killed tasks escalate (reference behavior)."""
+
+    def __init__(self, limit_mb=None, interval=0.5):
+        self.limit_mb = limit_mb
+        self.interval = interval
+        self.peak_mb = 0.0
+        self.exceeded = None
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            used = rss_mb()
+            self.peak_mb = max(self.peak_mb, used)
+            if self.limit_mb and used > self.limit_mb:
+                self.exceeded = MemoryExceeded(used, self.limit_mb)
+                return
+
+    def check(self):
+        if self.exceeded is not None:
+            raise self.exceeded
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(1)
+            self._thread = None
+        return self.peak_mb

@@ -1,0 +1,74 @@
+"""Streaming group-by with disk spill for oversized groups.
+
+Reference parity: dpark/utils/nested_groupby.py (GroupByNestedIter) — when
+one key's value list cannot fit in memory, values stream to a spill file
+and the group iterates lazily from disk (SURVEY.md section 2.1; the
+"external merge" family of 5.7).
+"""
+
+import os
+import pickle
+import tempfile
+
+
+class NestedGroup:
+    """Iterable over one group's values; transparently disk-backed."""
+
+    def __init__(self, max_in_memory=100_000, spill_dir=None):
+        self.values = []
+        self.max_in_memory = max_in_memory
+        self.spill_dir = spill_dir
+        self.spill_file = None
+        self.spilled = 0
+
+    def append(self, v):
+        self.values.append(v)
+        if len(self.values) >= self.max_in_memory:
+            self._spill()
+
+    def _spill(self):
+        if self.spill_file is None:
+            d = self.spill_dir
+            if d is None:
+                from dpark_tpu.env import env
+                d = os.path.join(env.workdir, "groupby")
+            os.makedirs(d, exist_ok=True)
+            fd, path = tempfile.mkstemp(dir=d, prefix="group-")
+            self.spill_file = os.fdopen(fd, "w+b")
+            os.unlink(path)              # anonymous: freed on close
+        pickle.dump(self.values, self.spill_file, -1)
+        self.spilled += len(self.values)
+        self.values = []
+
+    def __iter__(self):
+        if self.spill_file is not None:
+            self.spill_file.flush()
+            self.spill_file.seek(0)
+            remaining = self.spilled
+            while remaining > 0:
+                chunk = pickle.load(self.spill_file)
+                remaining -= len(chunk)
+                yield from chunk
+            self.spill_file.seek(0, 2)
+        yield from self.values
+
+    def __len__(self):
+        return self.spilled + len(self.values)
+
+    def close(self):
+        if self.spill_file is not None:
+            self.spill_file.close()
+            self.spill_file = None
+
+
+def group_by_nested(iterator, key_fn, max_in_memory=100_000):
+    """Group (already merge-compatible) records by key_fn with bounded
+    memory per group; yields (key, NestedGroup)."""
+    groups = {}
+    for item in iterator:
+        k = key_fn(item)
+        g = groups.get(k)
+        if g is None:
+            g = groups[k] = NestedGroup(max_in_memory)
+        g.append(item)
+    yield from groups.items()

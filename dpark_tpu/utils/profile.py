@@ -1,0 +1,50 @@
+"""Task profiling: --profile wraps task execution in cProfile; stats ship
+back with results and the driver merges + prints the hottest functions.
+
+Reference parity: dpark/utils/profile.py (SURVEY.md sections 2.1 and 5.1).
+On the tpu master per-stage device profiling uses jax.profiler traces
+instead (see backend/tpu/executor.py stage timings).
+"""
+
+import cProfile
+import io
+import marshal
+import pstats
+
+
+def profile_call(func, *args, **kwargs):
+    """Run func under cProfile; returns (result, stats_bytes)."""
+    prof = cProfile.Profile()
+    result = prof.runcall(func, *args, **kwargs)
+    prof.create_stats()
+    return result, marshal.dumps(prof.stats)
+
+
+class MergedProfile:
+    def __init__(self):
+        self.stats = None
+
+    def add(self, stats_bytes):
+        stats = _StatsCarrier(marshal.loads(stats_bytes))
+        if self.stats is None:
+            self.stats = pstats.Stats(stats)
+        else:
+            self.stats.add(stats)
+
+    def summary(self, top=20, sort="cumulative"):
+        if self.stats is None:
+            return "(no profile data)"
+        buf = io.StringIO()
+        self.stats.stream = buf
+        self.stats.sort_stats(sort).print_stats(top)
+        return buf.getvalue()
+
+
+class _StatsCarrier:
+    """Duck-typed object pstats.Stats accepts (has create_stats/stats)."""
+
+    def __init__(self, stats):
+        self.stats = stats
+
+    def create_stats(self):
+        pass

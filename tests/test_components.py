@@ -1,0 +1,182 @@
+"""Component tests: tracker, mutable_dict, hostatus, memory, profile,
+nested groupby (reference: per-component unit tests, SURVEY.md section 4).
+"""
+
+import time
+
+import pytest
+
+
+def test_tracker_server_client():
+    from dpark_tpu.tracker import TrackerServer, TrackerClient
+    srv = TrackerServer(host="127.0.0.1")
+    srv.start()
+    try:
+        c = TrackerClient("127.0.0.1:%d" % srv._server.server_address[1])
+        assert c.get("missing") is None
+        c.set("k", {"a": 1})
+        assert c.get("k") == {"a": 1}
+        c.add_item("list", "x")
+        c.add_item("list", "y")
+        assert c.get("list") == ["x", "y"]
+        c.remove_item("list", "x")
+        assert c.get("list") == ["y"]
+        # second client sees the same data
+        c2 = TrackerClient("127.0.0.1:%d" % srv._server.server_address[1])
+        assert c2.get("k") == {"a": 1}
+        c.close()
+        c2.close()
+    finally:
+        srv.stop()
+
+
+def test_mutable_dict_local(ctx):
+    from dpark_tpu.mutable_dict import MutableDict
+    md = MutableDict()
+    md.put("init", 100)
+    r = ctx.parallelize(range(10), 2)
+
+    def bump(x):
+        md.put("task_%d" % x, x * 2)
+        return md.get("init") + x
+
+    got = r.map(bump).collect()
+    assert got == [100 + i for i in range(10)]
+    assert md.get("task_3") == 6
+    assert md.get("task_9") == 18
+
+
+def test_mutable_dict_across_process(pctx):
+    from dpark_tpu.mutable_dict import MutableDict
+    md = MutableDict()
+    md.put("base", 5)
+    r = pctx.parallelize(range(8), 4)
+
+    def write(x):
+        md.put(x, md.get("base") + x)
+        return x
+
+    r.map(write).collect()
+    for i in range(8):
+        assert md.get(i) == 5 + i
+
+
+def test_hostatus_blacklist():
+    from dpark_tpu.hostatus import TaskHostManager
+    m = TaskHostManager()
+    now = 1000.0
+    for _ in range(5):
+        m.task_failed_on("bad-host", now)
+    m.task_succeed_on("good-host", now)
+    assert m.is_blacklisted("bad-host", now)
+    assert not m.is_blacklisted("good-host", now)
+    assert m.offer_choice(["bad-host", "good-host"], now) == "good-host"
+    # decay: failures age out
+    later = now + 600
+    assert not m.is_blacklisted("bad-host", later)
+
+
+def test_memory_rss_and_checker():
+    from dpark_tpu.utils.memory import rss_mb, MemoryChecker, MemoryExceeded
+    assert rss_mb() > 1.0
+    ck = MemoryChecker(limit_mb=0.001, interval=0.01).start()
+    time.sleep(0.1)
+    with pytest.raises(MemoryExceeded):
+        ck.check()
+    ck.stop()
+    ck2 = MemoryChecker(limit_mb=10**9, interval=0.01).start()
+    time.sleep(0.05)
+    ck2.check()                        # under limit: no raise
+    peak = ck2.stop()
+    assert peak > 1.0
+
+
+def test_memory_kill_and_retry_escalation(pctx):
+    """A task over its RSS limit fails, retries escalate the limit, and
+    the job eventually succeeds (reference: executor memory kills)."""
+    from dpark_tpu.env import env
+    env.mem_limit = 1e-3               # absurd 1KB first-try limit
+
+    def hungry(it):
+        import time as _t
+        blob = [bytes(1 << 20) for _ in range(3)]   # ~3MB
+        _t.sleep(0.8)                  # give the sampler time to fire
+        from dpark_tpu.utils.memory import maybe_check
+        maybe_check()
+        return [sum(1 for _ in it) + (len(blob) > 0)]
+    try:
+        got = pctx.parallelize(range(10), 1).mapPartitions(hungry).collect()
+        assert got == [11]
+    finally:
+        env.mem_limit = None
+
+
+def test_profile_merge():
+    from dpark_tpu.utils.profile import profile_call, MergedProfile
+
+    def work(n):
+        return sum(i * i for i in range(n))
+
+    r1, s1 = profile_call(work, 10000)
+    r2, s2 = profile_call(work, 20000)
+    assert r1 == sum(i * i for i in range(10000))
+    m = MergedProfile()
+    m.add(s1)
+    m.add(s2)
+    out = m.summary(5)
+    assert "work" in out
+
+
+def test_nested_groupby_spill(tmp_path):
+    from dpark_tpu.utils.nested_groupby import group_by_nested
+    data = [("k%d" % (i % 3), i) for i in range(1000)]
+    groups = dict(group_by_nested(iter(data), lambda kv: kv[0],
+                                  max_in_memory=50))
+    assert set(groups) == {"k0", "k1", "k2"}
+    for k, g in groups.items():
+        vals = [v for _, v in g]
+        assert len(vals) == len(g)
+        expect = [i for i in range(1000) if "k%d" % (i % 3) == k]
+        assert [v for _, v in g] == expect      # re-iterable
+        g.close()
+
+
+def test_mutable_dict_many_tasks_same_key(pctx):
+    """Every task of a job writes the same pre-existing key; the final
+    value must come from one of them, never the stale original."""
+    from dpark_tpu.mutable_dict import MutableDict
+    md = MutableDict()
+    md.put("base", -1)
+
+    def write(x):
+        md.put("base", 1000 + x)
+        return x
+
+    pctx.parallelize(range(8), 8).map(write).collect()
+    assert md.get("base") in {1000 + i for i in range(8)}
+
+
+def test_mutable_dict_driver_write_between_jobs(pctx):
+    from dpark_tpu.mutable_dict import MutableDict
+    md = MutableDict()
+    md.put("a", 1)
+    r = pctx.parallelize([0], 1)
+    assert r.map(lambda _: md.get("a")).collect() == [1]
+    md.put("b", 2)                     # driver write AFTER first job
+    assert r.map(lambda _: md.get("b")).collect() == [2]
+
+
+def test_tracker_mutation_dedup():
+    from dpark_tpu.tracker import (TrackerServer, TrackerClient,
+                                   AddItemMessage)
+    srv = TrackerServer(host="127.0.0.1")
+    srv.start()
+    try:
+        c = TrackerClient("127.0.0.1:%d" % srv._server.server_address[1])
+        msg = AddItemMessage("k", "v")
+        c.call(msg)
+        c.call(msg)                    # simulated retry of the same message
+        assert c.get("k") == ["v"]
+        c.close()
+    finally:
+        srv.stop()
