@@ -22,10 +22,16 @@ BYTES = N_PAIRS * 8            # two int32 columns
 
 
 def make_data():
-    # scrambled int keys, deterministic
-    mult = 2654435761
-    return [(((i * mult) & 0x7FFFFFFF) % N_KEYS, i & 0xFFFF)
-            for i in range(N_PAIRS)]
+    # scrambled int keys, deterministic; columnar (numpy) input — the
+    # ingestion analog of the reference's file sources.  Both masters get
+    # the same columns: the process master iterates them as Python rows
+    # (its real execution model), the tpu master ingests them into HBM.
+    import numpy as np
+    from dpark_tpu import Columns
+    i = np.arange(N_PAIRS, dtype=np.int64)
+    keys = (i * 2654435761) % N_KEYS
+    vals = i & 0xFFFF
+    return Columns(keys, vals)
 
 
 def run_once(ctx, data, n_parts, expect_keys=None):
@@ -57,8 +63,8 @@ def bench_tpu(data):
     ctx = DparkContext("tpu")
     ctx.start()
     ndev = ctx.scheduler.executor.ndev
-    # warm-up: compile the stage programs
-    run_once(ctx, data[: max(1024, ndev * 128)], ndev)
+    # warm-up: compile the stage programs at the same size class
+    run_once(ctx, data, ndev)
     best = min(run_once(ctx, data, ndev, min(N_KEYS, N_PAIRS))
                for _ in range(3))
     ctx.stop()

@@ -1,0 +1,151 @@
+"""The five BASELINE.json benchmark configs, runnable against any master.
+
+Reference baseline: the reference publishes no numbers (BASELINE.md); the
+`-m process` master measured here IS the baseline the tpu master is
+compared against.
+
+Each config returns (bytes_processed, wall_seconds, checksum) so runs are
+verifiable across masters.
+"""
+
+import operator
+import os
+import random
+import time
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    out = fn()
+    return time.perf_counter() - t0, out
+
+
+# --------------------------------------------------------------------------
+def wordcount(ctx, path=None, n_lines=200_000):
+    """configs[0]: textFile -> flatMap -> map -> reduceByKey."""
+    if path is None:
+        path = "/tmp/dpark_bench_text.txt"
+        if not os.path.exists(path):
+            rng = random.Random(1)
+            words = ["w%d" % i for i in range(10_000)]
+            with open(path, "w") as f:
+                for _ in range(n_lines):
+                    f.write(" ".join(rng.choices(words, k=10)) + "\n")
+    nbytes = os.path.getsize(path)
+    dt, counts = _timed(lambda: dict(
+        ctx.textFile(path)
+        .flatMap(lambda line: line.split())
+        .map(lambda w: (w, 1))
+        .reduceByKey(operator.add).collect()))
+    return nbytes, dt, sum(counts.values())
+
+
+def sort_and_group(ctx, n=10_000_000, nparts=None):
+    """configs[1]: sortByKey + groupByKey over synthetic (int,int) pairs."""
+    nparts = nparts or ctx.default_parallelism
+    mult = 2654435761
+    pairs = [((i * mult) & 0x3FFFFFFF, i & 0xFFFF) for i in range(n)]
+    nbytes = n * 8
+
+    def run():
+        r = ctx.parallelize(pairs, nparts)
+        s = r.sortByKey(numSplits=nparts)
+        first = s.first()
+        g = r.map(lambda kv: (kv[0] & 0xFFFF, kv[1])) \
+             .groupByKey(nparts)
+        total_groups = g.count()
+        return first, total_groups
+
+    dt, (first, ngroups) = _timed(run)
+    return nbytes, dt, ngroups
+
+
+def join_cogroup(ctx, n_orders=1_000_000, n_items=2_000_000, nparts=None):
+    """configs[2]: join/cogroup of two keyed RDDs (TPC-H-subset shape:
+    orders(orderkey, custkey) joined with lineitem(orderkey, qty))."""
+    nparts = nparts or ctx.default_parallelism
+    orders = [(i, i % 1000) for i in range(n_orders)]
+    items = [(i % n_orders, (i * 7) % 50 + 1) for i in range(n_items)]
+    nbytes = (n_orders + n_items) * 8
+
+    def run():
+        o = ctx.parallelize(orders, nparts)
+        l = ctx.parallelize(items, nparts)
+        joined = o.join(l, nparts)
+        return joined.count()
+
+    dt, count = _timed(run)
+    return nbytes, dt, count
+
+
+def pagerank(ctx, n_vertices=20_000, steps=10, nparts=None):
+    """configs[3]: PageRank via the Bagel Pregel superstep loop."""
+    import dpark_tpu.bagel as bagel
+    nparts = nparts or ctx.default_parallelism
+    links = {i: [(i + 1) % n_vertices, (i * 13 + 7) % n_vertices]
+             for i in range(n_vertices)}
+    verts = ctx.parallelize(
+        [(i, bagel.Vertex(i, 1.0 / n_vertices,
+                          [bagel.Edge(t) for t in targets]))
+         for i, targets in links.items()], nparts)
+    msgs = ctx.parallelize([], nparts)
+
+    nbytes = n_vertices * 3 * 8 * steps
+    dt, final = _timed(lambda: bagel.Bagel.run(
+        ctx, verts, msgs, _PRCompute(n_vertices, steps),
+        combiner=bagel.BasicCombiner(operator.add),
+        max_superstep=steps + 1, numSplits=nparts))
+    total = final.map(lambda kv: kv[1].value).sum()
+    return nbytes, dt, round(total, 3)
+
+
+class _PRCompute:
+    def __init__(self, n_vertices, steps):
+        self.n = n_vertices
+        self.steps = steps
+
+    def __call__(self, vert, msg_sum, agg, superstep):
+        import dpark_tpu.bagel as bagel
+        if superstep == 0:
+            value = vert.value
+        else:
+            value = 0.15 / self.n + 0.85 * (msg_sum or 0.0)
+        active = superstep < self.steps
+        v = bagel.Vertex(vert.id, value, vert.outEdges, active)
+        out = [bagel.Message(e.target_id, value / len(vert.outEdges))
+               for e in vert.outEdges] if active else []
+        return (v, out)
+
+
+def dstream_window(ctx, n_batches=20, batch_items=50_000):
+    """configs[4]: DStream reduceByKeyAndWindow micro-batches (manual
+    clock: measures per-batch job cost, not wall-clock waits)."""
+    from dpark_tpu.dstream import StreamingContext
+    ssc = StreamingContext(ctx, 1.0)
+    batches = [[(i % 100, 1) for i in range(batch_items)]
+               for _ in range(n_batches)]
+    q = ssc.queueStream(batches)
+    out = []
+    q.reduceByKeyAndWindow(operator.add, 4.0,
+                           invFunc=operator.sub).collect_batches(out)
+    ctx.start()
+    ssc.zero_time = 1000.0
+
+    def run():
+        for k in range(1, n_batches + 1):
+            ssc.run_batch(1000.0 + k)
+        return len(out)
+
+    nbytes = n_batches * batch_items * 8
+    dt, nb = _timed(run)
+    checksum = sum(v for _, batch in out[-1:] for _, v in batch)
+    return nbytes, dt, checksum
+
+
+ALL = {
+    "wordcount": wordcount,
+    "sort_group": sort_and_group,
+    "join": join_cogroup,
+    "pagerank": pagerank,
+    "dstream_window": dstream_window,
+}

@@ -1,0 +1,74 @@
+"""Run the BASELINE.json benchmark configs against one or more masters.
+
+Usage:
+  python benchmarks/run.py [config ...] [-m master] [--compare]
+
+--compare runs each config on `process` then `tpu` and prints the
+speedup; checksums must agree between masters.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmarks import configs
+
+
+def run_master(master, names, scale=1.0):
+    from dpark_tpu import DparkContext
+    results = {}
+    for name in names:
+        ctx = DparkContext(master)
+        ctx.start()
+        try:
+            fn = configs.ALL[name]
+            nbytes, dt, checksum = fn(ctx)
+            results[name] = {
+                "bytes": nbytes, "seconds": round(dt, 3),
+                "MBps": round(nbytes / dt / 1e6, 2),
+                "checksum": checksum,
+            }
+            print("  %-16s %-8s %8.3fs  %9.2f MB/s  (checksum %s)"
+                  % (name, master, dt, nbytes / dt / 1e6, checksum),
+                  file=sys.stderr)
+        finally:
+            ctx.stop()
+    return results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("names", nargs="*", default=None)
+    p.add_argument("-m", "--master", default="process")
+    p.add_argument("--compare", action="store_true",
+                   help="run process then tpu, print speedups")
+    args = p.parse_args()
+    names = args.names or list(configs.ALL)
+
+    if not args.compare:
+        out = run_master(args.master, names)
+        print(json.dumps({args.master: out}))
+        return
+
+    base = run_master("process", names)
+    tpu = run_master("tpu", names)
+    report = {}
+    for name in names:
+        b, t = base[name], tpu[name]
+        if b["checksum"] != t["checksum"]:
+            print("CHECKSUM MISMATCH %s: %s vs %s"
+                  % (name, b["checksum"], t["checksum"]), file=sys.stderr)
+        report[name] = {
+            "process_s": b["seconds"], "tpu_s": t["seconds"],
+            "speedup": round(b["seconds"] / t["seconds"], 2),
+            "checksum_ok": b["checksum"] == t["checksum"],
+        }
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -8,6 +8,7 @@ mesh and shuffles run as ICI collectives (see SURVEY.md and backend/tpu/).
 """
 
 from dpark_tpu.context import DparkContext, optParser, parse_options
+from dpark_tpu.rdd import Columns
 
 __version__ = "0.1.0"
 

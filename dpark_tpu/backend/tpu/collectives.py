@@ -33,6 +33,21 @@ def _take(leaves, idx):
     return [leaf[idx] for leaf in leaves]
 
 
+def _lex_sort(ops, num_keys):
+    """Stable lexicographic sort of `ops` by its first num_keys operands.
+    On TPU a single multi-operand lax.sort (bitonic network carries the
+    payload); on CPU — or when any payload is not rank-1, which XLA Sort
+    cannot carry — sort indices and gather instead."""
+    import jax as _jax
+    if (_jax.default_backend() != "cpu"
+            and all(o.ndim == 1 for o in ops)):
+        return lax.sort(tuple(ops), num_keys=num_keys, is_stable=True)
+    order = jnp.arange(ops[0].shape[0])
+    for k in range(num_keys - 1, -1, -1):
+        order = order[jnp.argsort(ops[k][order], stable=True)]
+    return tuple(o[order] for o in ops)
+
+
 def _bcast(flag, leaf):
     """Broadcast a (n,) bool against a (n, ...) leaf."""
     extra = leaf.ndim - flag.ndim
@@ -42,8 +57,8 @@ def _bcast(flag, leaf):
 def compact(leaves, mask):
     """Move rows where mask is True to the front (stable); returns
     (leaves, new_count)."""
-    order = jnp.argsort(~mask, stable=True)
-    return _take(leaves, order), jnp.sum(mask).astype(jnp.int32)
+    sorted_ops = _lex_sort((~mask,) + tuple(leaves), 1)
+    return list(sorted_ops[1:]), jnp.sum(mask).astype(jnp.int32)
 
 
 def bucketize(key, leaves, n, n_dst):
@@ -115,6 +130,28 @@ def flatten_received(recv_rounds, cnt_rounds, key_index=0):
     return flat, mask
 
 
+_SEGMENT_OPS = {}
+
+
+def _segment_op(kind):
+    if not _SEGMENT_OPS:
+        from jax import ops as jops
+        _SEGMENT_OPS.update({
+            "add": jops.segment_sum, "min": jops.segment_min,
+            "max": jops.segment_max, "mul": jops.segment_prod})
+    return _SEGMENT_OPS[kind]
+
+
+def _monoid_segment_totals(starts, val_leaves, kind):
+    """Single-pass per-segment reduction for a classified monoid: one
+    scatter instead of the log-n associative scan.  Returns per-segment
+    totals indexed by segment id (= cumsum(starts)-1)."""
+    seg = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    op = _segment_op(kind)
+    m = starts.shape[0]
+    return seg, [op(v, seg, num_segments=m) for v in val_leaves]
+
+
 def segmented_combine(starts, val_leaves, merge_leaves):
     """Inclusive segmented scan: scanned[i] = reduction of values from the
     segment start through i.  starts: (m,) bool segment-start flags."""
@@ -130,7 +167,8 @@ def segmented_combine(starts, val_leaves, merge_leaves):
     return scanned
 
 
-def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves):
+def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves,
+                      monoid=None):
     """Map-side pre-combine (the classic combiner optimization): sort one
     device's rows by (destination, key), merge equal keys within each
     destination run, compact.  Cuts exchange volume to O(#distinct keys per
@@ -144,30 +182,35 @@ def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves):
     dst = (phash_device(key) % jnp.uint32(n_dst)).astype(jnp.int32)
     dst = jnp.where(valid, dst, n_dst)
     k = jnp.where(valid, key, _sentinel(key.dtype))
-    # stable two-pass sort: by key first, then by dst -> (dst, key) order
-    o1 = jnp.argsort(k, stable=True)
-    o2 = jnp.argsort(dst[o1], stable=True)
-    order = o1[o2]
-    k = k[order]
-    d = dst[order]
-    vs = [v[order] for v in val_leaves]
+    # one lexicographic (dst, key) sort carrying all value leaves
+    sorted_ops = _lex_sort((dst, k) + tuple(val_leaves), 2)
+    d, k = sorted_ops[0], sorted_ops[1]
+    vs = list(sorted_ops[2:])
 
     same = (k[1:] == k[:-1]) & (d[1:] == d[:-1])
     starts = jnp.concatenate([jnp.ones((1,), bool), ~same])
-    scanned = segmented_combine(starts, vs, merge_leaves)
-    is_last = jnp.concatenate([~same, jnp.ones((1,), bool)])
-    keep = is_last & (d < n_dst)
-    out_order = jnp.argsort(~keep, stable=True)
-    kk = jnp.where(keep, k, _sentinel(k.dtype))[out_order]
-    dd = jnp.where(keep, d, n_dst)[out_order]
-    vv = [s[out_order] for s in scanned]
+    if monoid is not None:
+        seg, totals = _monoid_segment_totals(starts, vs, monoid)
+        keep = starts & (d < n_dst)
+        reduced = [t[seg] for t in totals]
+    else:
+        scanned = segmented_combine(starts, vs, merge_leaves)
+        is_last = jnp.concatenate([~same, jnp.ones((1,), bool)])
+        keep = is_last & (d < n_dst)
+        reduced = scanned
+    kk_full = jnp.where(keep, k, _sentinel(k.dtype))
+    dd_full = jnp.where(keep, d, n_dst)
+    packed = _lex_sort((~keep, dd_full, kk_full) + tuple(reduced), 1)
+    dd, kk = packed[1], packed[2]
+    vv = list(packed[3:])
     counts = jnp.bincount(dd, length=n_dst + 1)[:n_dst].astype(jnp.int32)
     offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
     return kk, vv, counts, offsets
 
 
-def segment_reduce(key, val_leaves, valid_mask, merge_leaves):
+def segment_reduce(key, val_leaves, valid_mask, merge_leaves,
+                   monoid=None):
     """Combine values of equal keys with an associative merge.
 
     key: (m,) int with invalid rows already set to the dtype sentinel.
@@ -179,17 +222,27 @@ def segment_reduce(key, val_leaves, valid_mask, merge_leaves):
     to the front (sorted ascending by key).
     """
     m = key.shape[0]
-    order = jnp.argsort(key, stable=True)
-    k = key[order]
-    vs = [v[order] for v in val_leaves]
+    sorted_ops = _lex_sort((key,) + tuple(val_leaves), 1)
+    k = sorted_ops[0]
+    vs = list(sorted_ops[1:])
     nvalid = jnp.sum(valid_mask).astype(jnp.int32)
 
     starts = jnp.concatenate(
         [jnp.ones((1,), bool), k[1:] != k[:-1]])
-    scanned = segmented_combine(starts, vs, merge_leaves)
-    is_last = jnp.concatenate([k[1:] != k[:-1], jnp.ones((1,), bool)])
-    keep = is_last & (jnp.arange(m) < nvalid) & (k != _sentinel(k.dtype))
-    out_order = jnp.argsort(~keep, stable=True)
-    uk = jnp.where(keep, k, _sentinel(k.dtype))[out_order]
-    uv = [s[out_order] for s in scanned]
+    if monoid is not None:
+        seg, totals = _monoid_segment_totals(starts, vs, monoid)
+        keep = (starts & (jnp.arange(m) < nvalid)
+                & (k != _sentinel(k.dtype)))
+        reduced = [t[seg] for t in totals]
+    else:
+        scanned = segmented_combine(starts, vs, merge_leaves)
+        is_last = jnp.concatenate(
+            [k[1:] != k[:-1], jnp.ones((1,), bool)])
+        keep = (is_last & (jnp.arange(m) < nvalid)
+                & (k != _sentinel(k.dtype)))
+        reduced = scanned
+    uk_full = jnp.where(keep, k, _sentinel(k.dtype))
+    packed = _lex_sort((~keep, uk_full) + tuple(reduced), 1)
+    uk = packed[1]
+    uv = list(packed[2:])
     return uk, uv, jnp.sum(keep).astype(jnp.int32)

@@ -72,6 +72,7 @@ class JAXExecutor:
         epilogue = plan.epilogue
         n_dst = self.ndev
         merge_fn = None
+        monoid = None
         if epilogue is not None:
             dep = epilogue[1]
             try:
@@ -81,6 +82,8 @@ class JAXExecutor:
                 structs = fuse._batched_spec_struct(plan.out_specs[1:])
                 jax.eval_shape(lambda *v: merge_fn(list(v), list(v)),
                                *structs)
+                monoid = fuse.classify_merge(
+                    dep.aggregator.merge_combiners)
             except Exception:
                 merge_fn = None       # exchange raw created combiners
 
@@ -95,7 +98,7 @@ class JAXExecutor:
             k, vs = lv[0], lv[1:]
             if merge_fn is not None:
                 k2, v2, cnts, offs = collectives.bucketize_combine(
-                    k, vs, n, n_dst, merge_fn)
+                    k, vs, n, n_dst, merge_fn, monoid=monoid)
             else:
                 sorted_lv, cnts, offs = collectives.bucketize(
                     k, lv, n, n_dst)
@@ -142,10 +145,15 @@ class JAXExecutor:
         nval = len(plan.in_specs) - 1
         merge_fn = fuse._leaves_merge_fn(
             dep.aggregator.merge_combiners, nval)
+        try:
+            monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
+        except Exception:
+            monoid = None
         ops = plan.ops
         epilogue = plan.epilogue
         n_dst = self.ndev
         out_merge_fn = None
+        out_monoid = None
         if epilogue is not None:
             out_nval = len(plan.out_specs) - 1
             try:
@@ -154,6 +162,8 @@ class JAXExecutor:
                 structs = fuse._batched_spec_struct(plan.out_specs[1:])
                 jax.eval_shape(
                     lambda *v: out_merge_fn(list(v), list(v)), *structs)
+                out_monoid = fuse.classify_merge(
+                    epilogue[1].aggregator.merge_combiners)
             except Exception:
                 out_merge_fn = None
 
@@ -166,7 +176,7 @@ class JAXExecutor:
                               for li in range(nleaves)])
             flat, mask = collectives.flatten_received(recvs, cnts)
             k, vs, n = collectives.segment_reduce(
-                flat[0], flat[1:], mask, merge_fn)
+                flat[0], flat[1:], mask, merge_fn, monoid=monoid)
             lv = [k] + list(vs)
             for op in ops:
                 lv, n = op.apply(lv, n)
@@ -176,7 +186,7 @@ class JAXExecutor:
             kk, vv = lv[0], lv[1:]
             if out_merge_fn is not None:
                 k2, v2, cnts2, offs2 = collectives.bucketize_combine(
-                    kk, vv, n, n_dst, out_merge_fn)
+                    kk, vv, n, n_dst, out_merge_fn, monoid=out_monoid)
             else:
                 sorted_lv, cnts2, offs2 = collectives.bucketize(
                     kk, lv, n, n_dst)

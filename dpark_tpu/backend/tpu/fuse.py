@@ -34,6 +34,30 @@ def _batched_spec_struct(specs, n=4):
     return [jax.ShapeDtypeStruct((n,) + shape, dt) for dt, shape in specs]
 
 
+def classify_merge(merge):
+    """Probabilistic algebraic classification of a user merge function:
+    probe it on random int pairs; agreement with +, min, max or * on all
+    probes means (with overwhelming probability for any deterministic
+    function) it IS that monoid, unlocking single-pass segment scatters
+    instead of the generic O(log n)-pass associative scan."""
+    import operator
+    import random
+    rng = random.Random(0xD17A)
+    candidates = [("add", operator.add), ("min", min), ("max", max),
+                  ("mul", operator.mul)]
+    try:
+        probes = [(rng.randint(-2 ** 40, 2 ** 40),
+                   rng.randint(-2 ** 40, 2 ** 40)) for _ in range(8)]
+        results = [merge(a, b) for a, b in probes]
+        for name, op in candidates:
+            if all(bool(r == op(a, b))
+                   for (a, b), r in zip(probes, results)):
+                return name
+    except Exception:
+        pass              # tuple/array-valued or otherwise non-scalar
+    return None
+
+
 def fn_key(f):
     """Structural identity of a user function: same code + same captured
     cell values => same compiled program.  Unhashable captures fall back to

@@ -97,6 +97,13 @@ def ingest(mesh, partitions, treedef, specs, key_leaf=None):
     for d, part in enumerate(partitions):
         if not part:
             continue
+        part_cols = getattr(part, "columns", None)
+        if part_cols is not None and len(part_cols) == len(specs):
+            # columnar parallelize: memcpy + cast, no row objects
+            for li, (dt, shape) in enumerate(specs):
+                cols[li][d, :counts[d]] = part_cols[li].astype(
+                    dt, copy=False)
+            continue
         if flat_scalars and len(specs) > 1 and isinstance(part[0], tuple) \
                 and len(part[0]) == len(specs):
             # fast path: rows are flat tuples of scalars -> one 2D array

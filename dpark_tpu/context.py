@@ -72,6 +72,8 @@ class DparkContext:
         env.start(is_master=True)
         if self.options.mem:
             env.mem_limit = self.options.mem
+        if self.options.profile:
+            env.profile = True
         master, _, arg = self.master.partition(":")
         if master == "local":
             from dpark_tpu.schedule import LocalScheduler
@@ -100,6 +102,10 @@ class DparkContext:
             return
         self.started = False
         if self.scheduler:
+            prof = getattr(self.scheduler, "profile", None)
+            if prof is not None:
+                import sys
+                print(prof.summary(20), file=sys.stderr)
             self.scheduler.stop()
         env.stop()
 
@@ -162,6 +168,15 @@ class DparkContext:
         if isinstance(rdd_or_path, str):
             rdd_or_path = self.tableFile(rdd_or_path)
         return TableRDD(rdd_or_path, fields)
+
+    def beansdb(self, path, raw=False, check_crc=True):
+        from dpark_tpu.beansdb import BeansdbFileRDD
+        return BeansdbFileRDD(self, path, raw, check_crc)
+
+    def tabular(self, path, fields=None, wanted=None,
+                predicate_ranges=None):
+        from dpark_tpu.tabular import TabularRDD
+        return TabularRDD(self, path, fields, wanted, predicate_ranges)
 
     def union(self, rdds):
         return _rdd.UnionRDD(self, list(rdds))

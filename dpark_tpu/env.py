@@ -89,6 +89,8 @@ class DparkEnv:
                "DPARK_WORKDIR": self.workdir}
         if getattr(self, "mem_limit", None):
             out["DPARK_MEM_LIMIT"] = str(self.mem_limit)
+        if getattr(self, "profile", False):
+            out["DPARK_PROFILE"] = "1"
         return out
 
     def stop(self):

@@ -115,10 +115,16 @@ class MutableDict:
         """Driver-side merge: per-key, a write from generation >= the
         key's last-written generation wins (same-generation tasks of one
         job race arbitrarily — reference semantics)."""
+        changed = False
         for key, (value, gen) in updates.items():
             if gen >= self._key_gen.get(key, -1):
                 self.data[key] = value
                 self._key_gen[key] = gen
+                changed = True
+        if changed:
+            # new generation so the NEXT job's snapshot is rewritten with
+            # the merged state (snapshot files are keyed by generation)
+            self.generation += 1
 
 
 _MISSING = object()

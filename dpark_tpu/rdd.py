@@ -471,6 +471,18 @@ class RDD:
     def saveAsTableFile(self, path, overwrite=True):
         return OutputPickleFileRDD(self, path, overwrite).collect()
 
+    def saveAsBeansdb(self, path, overwrite=True):
+        """Write (key, value) pairs as beansdb .data files (reference:
+        saveAsBeansdb, dpark/utils/beansdb.py)."""
+        from dpark_tpu.beansdb import OutputBeansdbRDD
+        return OutputBeansdbRDD(self, path, overwrite).collect()
+
+    def saveAsTabular(self, path, fields, overwrite=True):
+        """Write tuple rows as the columnar tabular format (reference:
+        OutputTabularRDD, dpark/tabular.py)."""
+        from dpark_tpu.tabular import OutputTabularRDD
+        return OutputTabularRDD(self, path, fields, overwrite).collect()
+
     def asTable(self, fields, name="table"):
         """Wrap this RDD of tuples as a schema'd TableRDD (reference:
         rdd.asTable, dpark/table.py)."""
@@ -1098,12 +1110,83 @@ class ParallelSplit(Split):
         self.values = values
 
 
+class _ColumnarSlice:
+    """One partition's data held as numpy column arrays (zero-copy ingest
+    to the device path; row tuples materialize lazily on the object path).
+    """
+
+    def __init__(self, columns):
+        self.columns = columns
+
+    def __len__(self):
+        return len(self.columns[0]) if self.columns else 0
+
+    def __bool__(self):
+        return len(self) > 0
+
+    def __getitem__(self, i):
+        row = tuple(c[i] for c in self.columns)
+        return row[0] if len(row) == 1 else row
+
+    def __iter__(self):
+        lists = [c.tolist() for c in self.columns]
+        if len(lists) == 1:
+            return iter(lists[0])
+        return zip(*lists)
+
+
+class Columns:
+    """Explicit columnar input marker for parallelize: each argument is
+    one column array; records are row tuples across the columns.
+
+        ctx.parallelize(Columns(keys, values), n)
+
+    Explicit so ordinary parallelize semantics (a 2D array = rows of
+    arrays; a list of arrays = RDD of array elements) stay untouched."""
+
+    def __init__(self, *arrays):
+        import numpy as _np
+        self.arrays = [
+            _np.ascontiguousarray(a) for a in arrays]
+        if not self.arrays:
+            raise ValueError("Columns needs at least one array")
+        if any(a.ndim != 1 for a in self.arrays):
+            raise ValueError("Columns arrays must be 1-D")
+        if len({len(a) for a in self.arrays}) != 1:
+            raise ValueError("Columns arrays must have equal length")
+
+
+def _as_columns(seq):
+    """Columnar input only via the explicit Columns marker (plus a bare
+    1-D numpy array, whose row semantics are identical either way)."""
+    import numpy as _np
+    if isinstance(seq, Columns):
+        return list(seq.arrays)
+    if isinstance(seq, _np.ndarray) and seq.ndim == 1:
+        return [seq]
+    return None
+
+
 class ParallelCollection(RDD):
     """In-memory sequence split into `num_slices` (reference:
-    ParallelCollection from ctx.parallelize)."""
+    ParallelCollection from ctx.parallelize).
+
+    TPU-native extension: numpy input (a 2D array, or a tuple of 1D
+    column arrays) is kept columnar — the tpu master ingests it into HBM
+    without materializing Python row objects."""
 
     def __init__(self, ctx, seq, num_slices=None):
         super().__init__(ctx)
+        cols = _as_columns(seq)
+        if cols is not None:
+            total = len(cols[0])
+            n = num_slices or ctx.default_parallelism
+            n = max(1, min(n, total) if total else 1)
+            self._slices = [
+                _ColumnarSlice([c[total * i // n: total * (i + 1) // n]
+                                for c in cols])
+                for i in range(n)]
+            return
         seq = list(seq)
         n = num_slices or ctx.default_parallelism
         n = max(1, min(n, len(seq)) if seq else 1)

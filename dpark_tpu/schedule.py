@@ -77,8 +77,13 @@ class DAGScheduler:
     submit_tasks()."""
 
     def __init__(self):
+        from dpark_tpu.hostatus import TaskHostManager
         self.shuffle_to_stage = {}
         self.started = False
+        self.profile = None            # MergedProfile when --profile
+        # host health (trivial on single-host masters; the multi-host DCN
+        # dispatcher consults is_blacklisted/offer_choice)
+        self.host_manager = TaskHostManager()
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -206,6 +211,13 @@ class DAGScheduler:
             stage = stage_of.get(task.stage_id)
             if status == "success":
                 result, acc_updates, md_updates = payload
+                self.host_manager.task_succeed_on(env.host)
+                stats = (acc_updates or {}).pop(PROFILE_KEY, None)
+                if stats is not None:
+                    if self.profile is None:
+                        from dpark_tpu.utils.profile import MergedProfile
+                        self.profile = MergedProfile()
+                    self.profile.add(stats)
                 accumulator.merge_on_driver(acc_updates)
                 if md_updates:
                     from dpark_tpu import mutable_dict
@@ -260,6 +272,7 @@ class DAGScheduler:
                     waiting.add(stage)
                     submit_stage(parent)
             else:       # failure
+                self.host_manager.task_failed_on(env.host)
                 key = (task.stage_id, task.partition)
                 failures[key] = failures.get(key, 0) + 1
                 if failures[key] >= conf.MAX_TASK_FAILURES:
@@ -282,13 +295,22 @@ class DAGScheduler:
         return 2
 
 
+PROFILE_KEY = "__profile__"
+
+
 def _run_task_inline(task):
     from dpark_tpu import mutable_dict
     accumulator.start_task()
     mutable_dict.clear_task_updates()
     try:
-        result = task.run(task.tried)
+        if getattr(env, "profile", False):
+            from dpark_tpu.utils.profile import profile_call
+            result, stats = profile_call(task.run, task.tried)
+        else:
+            result, stats = task.run(task.tried), None
         updates = accumulator.finish_task()
+        if stats is not None:
+            updates[PROFILE_KEY] = stats
         md_updates = mutable_dict.collect_task_updates()
         return "success", (result, updates, md_updates)
     except FetchFailed as e:
@@ -323,6 +345,7 @@ def _process_worker(task_bytes, snapshot, environ):
     from dpark_tpu.utils import memory as memutil
     env.start(is_master=False, environ=environ)
     env.is_master = False      # fork inherits the driver's started env
+    env.profile = environ.get("DPARK_PROFILE") == "1"
     env.map_output_tracker.update(snapshot)
     try:
         task = serialize.loads(task_bytes)

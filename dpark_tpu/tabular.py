@@ -1,0 +1,202 @@
+"""Tabular: columnar on-disk format with per-column compression and
+column-pruned scans.
+
+Reference parity: dpark/tabular.py + dpark/bitindex.py (SURVEY.md section
+2.3) — column chunks with per-column compression and an optional index
+enabling predicate-pruned scans.  Format here is an original design with
+the same capabilities, numpy-friendly so ingestion to device columns is a
+memcpy:
+
+  file := header_json_len(4) header_json chunk*
+  chunk: per-column compressed numpy buffers (or pickled object columns),
+         with min/max statistics per numeric column in the header for
+         chunk pruning (the bitmap-index analog).
+"""
+
+import json
+import os
+import pickle
+import struct
+import zlib
+
+import numpy as np
+
+from dpark_tpu.rdd import RDD, Split, DerivedRDD
+from dpark_tpu.utils import atomic_file
+
+MAGIC = b"DTB1"
+
+
+def _pack_column(arr):
+    arr = np.asarray(arr)
+    if arr.dtype == object or arr.dtype.kind in "US":
+        payload = zlib.compress(pickle.dumps(list(arr), -1))
+        return {"kind": "object"}, payload
+    payload = zlib.compress(np.ascontiguousarray(arr).tobytes())
+    meta = {"kind": "numpy", "dtype": str(arr.dtype),
+            "shape": list(arr.shape)}
+    if arr.size and arr.dtype.kind in "if":
+        # .item() keeps integers exact (floats above 2**53 would make
+        # chunk pruning skip matching data)
+        meta["min"] = arr.min().item()
+        meta["max"] = arr.max().item()
+    return meta, payload
+
+
+def _unpack_column(meta, payload):
+    if meta["kind"] == "object":
+        return pickle.loads(zlib.decompress(payload))
+    buf = zlib.decompress(payload)
+    arr = np.frombuffer(buf, dtype=np.dtype(meta["dtype"]))
+    return arr.reshape(meta["shape"])
+
+
+def write_tabular(path, fields, rows, chunk_rows=65536):
+    """rows: iterable of tuples matching `fields`."""
+    chunks = []
+    payloads = []
+    buf = []
+
+    def flush():
+        if not buf:
+            return
+        cols = list(zip(*buf))
+        metas = []
+        offs = []
+        for col in cols:
+            meta, payload = _pack_column(np.asarray(col))
+            offs.append(len(payload))
+            metas.append(meta)
+            payloads.append(payload)
+        chunks.append({"rows": len(buf), "columns": metas, "sizes": offs})
+        buf.clear()
+
+    for row in rows:
+        buf.append(tuple(row))
+        if len(buf) >= chunk_rows:
+            flush()
+    flush()
+    header = json.dumps({"fields": list(fields),
+                         "chunks": chunks}).encode("utf-8")
+    with atomic_file(path) as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<I", len(header)))
+        f.write(header)
+        for p in payloads:
+            f.write(p)
+    return path
+
+
+def read_header(path):
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise IOError("not a tabular file: %s" % path)
+        (n,) = struct.unpack("<I", f.read(4))
+        header = json.loads(f.read(n).decode("utf-8"))
+        header["data_offset"] = f.tell()
+    return header
+
+
+def read_chunks(path, wanted_fields=None, predicate_ranges=None):
+    """Yield dicts of column-name -> array per chunk.
+
+    wanted_fields: subset of columns to materialize (column pruning).
+    predicate_ranges: {field: (lo, hi)} — chunks whose min/max statistics
+    cannot intersect are skipped without reading their bytes.
+    """
+    header = read_header(path)
+    fields = header["fields"]
+    want = wanted_fields or fields
+    with open(path, "rb") as f:
+        off = header["data_offset"]
+        for chunk in header["chunks"]:
+            sizes = chunk["sizes"]
+            metas = chunk["columns"]
+            # chunk pruning via column stats
+            skip = False
+            if predicate_ranges:
+                for fi, name in enumerate(fields):
+                    rng = predicate_ranges.get(name)
+                    meta = metas[fi]
+                    if rng and "min" in meta:
+                        lo, hi = rng
+                        if (hi is not None and meta["min"] > hi) or \
+                           (lo is not None and meta["max"] < lo):
+                            skip = True
+                            break
+            if skip:
+                off += sum(sizes)
+                continue
+            out = {}
+            coff = off
+            for fi, name in enumerate(fields):
+                if name in want:
+                    f.seek(coff)
+                    payload = f.read(sizes[fi])
+                    out[name] = _unpack_column(metas[fi], payload)
+                coff += sizes[fi]
+            off += sum(sizes)
+            yield chunk["rows"], out
+
+
+class TabularSplit(Split):
+    def __init__(self, index, path):
+        super().__init__(index)
+        self.path = path
+
+
+class TabularRDD(RDD):
+    """RDD of namedtuple-compatible row tuples from tabular part files,
+    with column pruning + chunk-stat predicate pushdown."""
+
+    def __init__(self, ctx, path, fields=None, wanted=None,
+                 predicate_ranges=None):
+        super().__init__(ctx)
+        self.path = path
+        if os.path.isdir(path):
+            self.files = sorted(
+                os.path.join(path, n) for n in os.listdir(path)
+                if n.endswith(".tab"))
+        else:
+            self.files = [path]
+        header = read_header(self.files[0]) if self.files else {"fields": []}
+        self.fields = fields or header["fields"]
+        self.wanted = wanted or self.fields
+        self.predicate_ranges = predicate_ranges
+
+    def _make_splits(self):
+        return [TabularSplit(i, p) for i, p in enumerate(self.files)]
+
+    def compute(self, split):
+        for nrows, cols in read_chunks(split.path, self.wanted,
+                                       self.predicate_ranges):
+            mats = [cols[name] for name in self.wanted]
+            pys = [m.tolist() if isinstance(m, np.ndarray) else m
+                   for m in mats]
+            for i in range(nrows):
+                yield tuple(p[i] for p in pys)
+
+    def asTable(self, name="tabular"):
+        from dpark_tpu.table import TableRDD
+        return TableRDD(self, self.wanted, name)
+
+
+class OutputTabularRDD(DerivedRDD):
+    def __init__(self, prev, path, fields, overwrite=True,
+                 chunk_rows=65536):
+        super().__init__(prev)
+        path = os.path.abspath(path)
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.fields = list(fields)
+        self.overwrite = overwrite
+        self.chunk_rows = chunk_rows
+
+    def compute(self, split):
+        target = os.path.join(self.path, "part-%05d.tab" % split.index)
+        if os.path.exists(target) and not self.overwrite:
+            yield target
+            return
+        write_tabular(target, self.fields, self.prev.iterator(split),
+                      self.chunk_rows)
+        yield target

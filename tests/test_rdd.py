@@ -305,3 +305,23 @@ def test_error_propagates(ctx):
     r = ctx.parallelize(range(4), 2).map(lambda x: 1 // (x - 2))
     with pytest.raises(RuntimeError):
         r.collect()
+
+
+def test_parallelize_list_of_arrays_keeps_element_semantics(ctx):
+    import numpy as np
+    pts = [np.array([1.0, 2.0]), np.array([3.0, 4.0]),
+           np.array([5.0, 6.0])]
+    got = ctx.parallelize(pts, 2).map(lambda p: float(p.sum())).collect()
+    assert got == [3.0, 7.0, 11.0]
+
+
+def test_profile_flag_collects_stats(ctx):
+    from dpark_tpu.env import env
+    env.profile = True
+    try:
+        ctx.parallelize(range(100), 4).map(lambda x: x * 2).count()
+        assert ctx.scheduler.profile is not None
+        assert "run" in ctx.scheduler.profile.summary(5)
+    finally:
+        env.profile = False
+        ctx.scheduler.profile = None

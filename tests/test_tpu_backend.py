@@ -189,3 +189,28 @@ def test_hbm_eviction_triggers_lineage_recovery(tctx):
         assert dict(r1.collect()) == {k: 50 for k in range(4)}
     finally:
         conf.SHUFFLE_HBM_BUDGET = old
+
+
+def test_columnar_parallelize_device(tctx):
+    import numpy as np
+    n = 100_000
+    keys = (np.arange(n, dtype=np.int64) * 2654435761) % 1000
+    vals = np.ones(n, dtype=np.int64)
+    from dpark_tpu import Columns
+    got = dict(tctx.parallelize(Columns(keys, vals), 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert len(got) == 1000
+    assert sum(got.values()) == n
+    assert _used_array_path(tctx)
+
+
+def test_columnar_parallelize_object_path_parity(ctx):
+    import numpy as np
+    keys = np.array([1, 2, 1, 3], dtype=np.int64)
+    vals = np.array([10, 20, 30, 40], dtype=np.int64)
+    from dpark_tpu import Columns
+    got = dict(ctx.parallelize(Columns(keys, vals), 2)
+               .reduceByKey(lambda a, b: a + b).collect())
+    assert got == {1: 40, 2: 20, 3: 40}
+    single = ctx.parallelize(np.arange(5), 2).map(lambda x: x * 2).collect()
+    assert single == [0, 2, 4, 6, 8]
