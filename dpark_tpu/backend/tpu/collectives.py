@@ -25,8 +25,25 @@ from dpark_tpu.utils.phash import phash_device
 
 def _sentinel(dtype):
     """Max value of the key dtype — padding rows sort last.  ingest()
-    rejects real keys equal to this value (host fallback)."""
+    rejects int keys equal to this value (host fallback); float keys use
+    +inf (real +inf keys are a documented range-sort limitation)."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        return jnp.asarray(jnp.inf, dtype)
     return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+
+
+def hash_dst(key, n_dst, valid):
+    """Destination partition by portable hash (HashPartitioner)."""
+    dst = (phash_device(key) % jnp.uint32(n_dst)).astype(jnp.int32)
+    return jnp.where(valid, dst, n_dst)
+
+
+def range_dst(key, bounds, ascending, n_dst, valid):
+    """Destination partition by sorted bounds (RangePartitioner): the
+    device twin of host bisect_left over the sampled bounds."""
+    idx = jnp.searchsorted(bounds, key, side="left").astype(jnp.int32)
+    dst = idx if ascending else (n_dst - 1 - idx)
+    return jnp.where(valid, dst, n_dst)
 
 
 def _take(leaves, idx):
@@ -61,7 +78,7 @@ def compact(leaves, mask):
     return list(sorted_ops[1:]), jnp.sum(mask).astype(jnp.int32)
 
 
-def bucketize(key, leaves, n, n_dst):
+def bucketize(key, leaves, n, n_dst, dst=None):
     """Sort one device's rows by destination partition.
 
     Returns (sorted_leaves, counts[n_dst], offsets[n_dst]).  Invalid rows
@@ -69,8 +86,8 @@ def bucketize(key, leaves, n, n_dst):
     """
     cap = key.shape[0]
     valid = jnp.arange(cap) < n
-    dst = (phash_device(key) % jnp.uint32(n_dst)).astype(jnp.int32)
-    dst = jnp.where(valid, dst, n_dst)
+    if dst is None:
+        dst = hash_dst(key, n_dst, valid)
     order = jnp.argsort(dst, stable=True)
     sorted_leaves = _take(leaves, order)
     counts = jnp.bincount(dst, length=n_dst + 1)[:n_dst].astype(jnp.int32)
@@ -168,7 +185,7 @@ def segmented_combine(starts, val_leaves, merge_leaves):
 
 
 def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves,
-                      monoid=None):
+                      monoid=None, dst=None):
     """Map-side pre-combine (the classic combiner optimization): sort one
     device's rows by (destination, key), merge equal keys within each
     destination run, compact.  Cuts exchange volume to O(#distinct keys per
@@ -179,8 +196,8 @@ def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves,
     """
     cap = key.shape[0]
     valid = jnp.arange(cap) < n
-    dst = (phash_device(key) % jnp.uint32(n_dst)).astype(jnp.int32)
-    dst = jnp.where(valid, dst, n_dst)
+    if dst is None:
+        dst = hash_dst(key, n_dst, valid)
     k = jnp.where(valid, key, _sentinel(key.dtype))
     # one lexicographic (dst, key) sort carrying all value leaves
     sorted_ops = _lex_sort((dst, k) + tuple(val_leaves), 2)

@@ -62,54 +62,78 @@ class JAXExecutor:
     def _sharding(self):
         return NamedSharding(self.mesh, P(AXIS))
 
+    def _epilogue_merge(self, plan):
+        """(merge_fn, monoid) for a combining shuffle write, or
+        (None, None) for the no-combine (list-aggregator) mode."""
+        dep = plan.epilogue[1]
+        if fuse.is_list_agg(dep.aggregator):
+            return None, None
+        try:
+            nval = len(plan.out_specs) - 1
+            merge_fn = fuse._leaves_merge_fn(
+                dep.aggregator.merge_combiners, nval)
+            structs = fuse._batched_spec_struct(plan.out_specs[1:])
+            jax.eval_shape(lambda *v: merge_fn(list(v), list(v)),
+                           *structs)
+            monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
+            return merge_fn, monoid
+        except Exception:
+            return None, None      # exchange raw created combiners
+
+    @staticmethod
+    def _epilogue_block(plan, lv, n, n_dst, merge_fn, monoid, bounds):
+        """Shared shuffle-write tail: destination assignment (hash or
+        range bounds) + bucketize[-combine]."""
+        k = lv[0]
+        if plan.epi_spec is not None and plan.epi_spec[0] == "range":
+            valid = jnp.arange(k.shape[0]) < n
+            dst = collectives.range_dst(k, bounds, plan.epi_spec[1],
+                                        n_dst, valid)
+        else:
+            dst = None
+        if merge_fn is not None:
+            k2, v2, cnts, offs = collectives.bucketize_combine(
+                k, lv[1:], n, n_dst, merge_fn, monoid=monoid, dst=dst)
+        else:
+            sorted_lv, cnts, offs = collectives.bucketize(
+                k, lv, n, n_dst, dst=dst)
+            k2, v2 = sorted_lv[0], sorted_lv[1:]
+        return (cnts, offs, k2) + tuple(v2)
+
     def _compile_narrow(self, plan, cap, nleaves_in):
-        """Program A: (in_leaves, counts) -> ops -> result or bucketized
-        shuffle output.  Shapes are (ndev, cap, ...) sharded on dim 0."""
+        """Program A: (counts, [bounds,] in_leaves) -> ops -> result or
+        bucketized shuffle output.  Shapes (ndev, cap, ...), dim 0
+        sharded."""
         key = ("narrow", plan.program_key, cap, nleaves_in)
         if key in self._compiled:
             return self._compiled[key]
         ops = plan.ops
         epilogue = plan.epilogue
         n_dst = self.ndev
-        merge_fn = None
-        monoid = None
+        has_bounds = plan.epi_bounds is not None
+        merge_fn = monoid = None
         if epilogue is not None:
-            dep = epilogue[1]
-            try:
-                nval = len(plan.out_specs) - 1
-                merge_fn = fuse._leaves_merge_fn(
-                    dep.aggregator.merge_combiners, nval)
-                structs = fuse._batched_spec_struct(plan.out_specs[1:])
-                jax.eval_shape(lambda *v: merge_fn(list(v), list(v)),
-                               *structs)
-                monoid = fuse.classify_merge(
-                    dep.aggregator.merge_combiners)
-            except Exception:
-                merge_fn = None       # exchange raw created combiners
+            merge_fn, monoid = self._epilogue_merge(plan)
 
-        def per_device(counts, *leaves):
+        def per_device(counts, *rest):
             n = counts[0]
+            bounds = rest[0][0] if has_bounds else None
+            leaves = rest[1:] if has_bounds else rest
             lv = [l[0] for l in leaves]          # squeeze mesh dim
             for op in ops:
                 lv, n = op.apply(lv, n)
             if epilogue is None:
                 return (jnp.expand_dims(n, 0),) + tuple(
                     jnp.expand_dims(l, 0) for l in lv)
-            k, vs = lv[0], lv[1:]
-            if merge_fn is not None:
-                k2, v2, cnts, offs = collectives.bucketize_combine(
-                    k, vs, n, n_dst, merge_fn, monoid=monoid)
-            else:
-                sorted_lv, cnts, offs = collectives.bucketize(
-                    k, lv, n, n_dst)
-                k2, v2 = sorted_lv[0], sorted_lv[1:]
-            out = (cnts, offs, k2) + tuple(v2)
+            out = self._epilogue_block(plan, lv, n, n_dst, merge_fn,
+                                       monoid, bounds)
             return tuple(jnp.expand_dims(o, 0) for o in out)
 
+        n_in = 1 + nleaves_in + (1 if has_bounds else 0)
         n_out = (1 + len(plan.out_specs)) if epilogue is None \
             else (2 + len(plan.out_specs))
         fn = _shard_map(per_device, self.mesh,
-                        in_specs=(P(AXIS),) * (1 + nleaves_in),
+                        in_specs=(P(AXIS),) * n_in,
                         out_specs=(P(AXIS),) * n_out)
         jitted = jax.jit(fn)
         self._compiled[key] = jitted
@@ -136,38 +160,34 @@ class JAXExecutor:
         return jitted
 
     def _compile_reduce(self, plan, rounds, slot, nleaves):
-        """Program B: (recv buffers over `rounds`, recv counts) ->
-        flatten -> segment reduce -> ops -> result or bucketize."""
+        """Program B: ([bounds,] recv counts, recv buffers over `rounds`)
+        -> flatten -> segment reduce (or key-sort for no-combine) -> ops
+        -> result or bucketize."""
         key = ("reduce", plan.program_key, rounds, slot, nleaves)
         if key in self._compiled:
             return self._compiled[key]
         dep = plan.source[1]
-        nval = len(plan.in_specs) - 1
-        merge_fn = fuse._leaves_merge_fn(
-            dep.aggregator.merge_combiners, nval)
-        try:
-            monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
-        except Exception:
-            monoid = None
+        merge_fn = monoid = None
+        if plan.src_combine:
+            nval = len(plan.in_specs) - 1
+            merge_fn = fuse._leaves_merge_fn(
+                dep.aggregator.merge_combiners, nval)
+            try:
+                monoid = fuse.classify_merge(
+                    dep.aggregator.merge_combiners)
+            except Exception:
+                monoid = None
         ops = plan.ops
         epilogue = plan.epilogue
         n_dst = self.ndev
-        out_merge_fn = None
-        out_monoid = None
+        has_bounds = plan.epi_bounds is not None
+        out_merge_fn = out_monoid = None
         if epilogue is not None:
-            out_nval = len(plan.out_specs) - 1
-            try:
-                out_merge_fn = fuse._leaves_merge_fn(
-                    epilogue[1].aggregator.merge_combiners, out_nval)
-                structs = fuse._batched_spec_struct(plan.out_specs[1:])
-                jax.eval_shape(
-                    lambda *v: out_merge_fn(list(v), list(v)), *structs)
-                out_monoid = fuse.classify_merge(
-                    epilogue[1].aggregator.merge_combiners)
-            except Exception:
-                out_merge_fn = None
+            out_merge_fn, out_monoid = self._epilogue_merge(plan)
 
         def per_device(*args):
+            bounds = args[0][0] if has_bounds else None
+            args = args[1:] if has_bounds else args
             cnts = [c[0] for c in args[:rounds]]
             buf_args = args[rounds:]
             recvs = []
@@ -175,26 +195,26 @@ class JAXExecutor:
                 recvs.append([buf_args[r * nleaves + li][0]
                               for li in range(nleaves)])
             flat, mask = collectives.flatten_received(recvs, cnts)
-            k, vs, n = collectives.segment_reduce(
-                flat[0], flat[1:], mask, merge_fn, monoid=monoid)
-            lv = [k] + list(vs)
+            if merge_fn is not None:
+                k, vs, n = collectives.segment_reduce(
+                    flat[0], flat[1:], mask, merge_fn, monoid=monoid)
+                lv = [k] + list(vs)
+            else:
+                # no-combine repartition: sort rows by key, valid first
+                packed = collectives._lex_sort(
+                    (flat[0],) + tuple(flat[1:]), 1)
+                lv = list(packed)
+                n = jnp.sum(mask).astype(jnp.int32)
             for op in ops:
                 lv, n = op.apply(lv, n)
             if epilogue is None:
                 return (jnp.expand_dims(n, 0),) + tuple(
                     jnp.expand_dims(l, 0) for l in lv)
-            kk, vv = lv[0], lv[1:]
-            if out_merge_fn is not None:
-                k2, v2, cnts2, offs2 = collectives.bucketize_combine(
-                    kk, vv, n, n_dst, out_merge_fn, monoid=out_monoid)
-            else:
-                sorted_lv, cnts2, offs2 = collectives.bucketize(
-                    kk, lv, n, n_dst)
-                k2, v2 = sorted_lv[0], sorted_lv[1:]
-            out = (cnts2, offs2, k2) + tuple(v2)
+            out = self._epilogue_block(plan, lv, n, n_dst, out_merge_fn,
+                                       out_monoid, bounds)
             return tuple(jnp.expand_dims(o, 0) for o in out)
 
-        n_in = rounds + rounds * nleaves
+        n_in = rounds + rounds * nleaves + (1 if has_bounds else 0)
         n_out = (1 + len(plan.out_specs)) if epilogue is None \
             else (2 + len(plan.out_specs))
         fn = _shard_map(per_device, self.mesh,
@@ -203,6 +223,15 @@ class JAXExecutor:
         jitted = jax.jit(fn)
         self._compiled[key] = jitted
         return jitted
+
+    def _bounds_arg(self, plan):
+        """plan.epi_bounds tiled per device and sharded, or None."""
+        if plan.epi_bounds is None:
+            return None
+        tiled = np.tile(plan.epi_bounds, (self.ndev, 1)) \
+            if plan.epi_bounds.size else np.zeros(
+                (self.ndev, 0), plan.epi_bounds.dtype)
+        return jax.device_put(tiled, self._sharding())
 
     # ------------------------------------------------------------------
     # running
@@ -213,11 +242,16 @@ class JAXExecutor:
         Returns ("result", list_of_row_lists) or ("shuffle", sid)."""
         if plan.source[0] == "ingest":
             pc = plan.source[1]
+            # any shuffle write pads with the key sentinel; a real key
+            # equal to it must force the host path (silent-drop hazard)
             key_leaf = 0 if plan.epilogue is not None else None
             batch = layout.ingest(self.mesh, pc._slices, plan.in_treedef,
                                   plan.in_specs, key_leaf=key_leaf)
             jitted = self._compile_narrow(plan, batch.cap, len(batch.cols))
-            outs = jitted(batch.counts, *batch.cols)
+            bounds = self._bounds_arg(plan)
+            args = (batch.counts,) + ((bounds,) if bounds is not None
+                                      else ()) + tuple(batch.cols)
+            outs = jitted(*args)
         else:
             outs = self._run_exchange_and_reduce(plan)
         return self._finish_stage(plan, outs)
@@ -226,7 +260,19 @@ class JAXExecutor:
         if plan.epilogue is None:
             counts, leaves = outs[0], list(outs[1:])
             batch = layout.Batch(plan.out_treedef, leaves, counts)
-            return ("result", layout.egest(batch))
+            rows_per_part = layout.egest(batch)
+            if plan.group_output:
+                # bare groupByKey: rows arrive key-sorted; group runs
+                # into (k, [v]) host-side
+                import itertools as _it
+                grouped = []
+                for rows in rows_per_part:
+                    parts = []
+                    for k, grp in _it.groupby(rows, key=lambda r: r[0]):
+                        parts.append((k, [r[1] for r in grp]))
+                    grouped.append(parts)
+                rows_per_part = grouped
+            return ("result", rows_per_part)
         dep = plan.epilogue[1]
         cnts, offs = outs[0], outs[1]
         leaves = list(outs[2:])
@@ -238,6 +284,7 @@ class JAXExecutor:
             "offsets": offs,             # (ndev, R)
             "out_treedef": plan.out_treedef,
             "out_specs": plan.out_specs,
+            "no_combine": fuse.is_list_agg(dep.aggregator),
             "nbytes": nbytes,
         }
         self._store_order.append(sid)
@@ -295,7 +342,8 @@ class JAXExecutor:
                 raise RuntimeError("shuffle exchange did not converge")
         rounds = len(recv_rounds)
         reduce_fn = self._compile_reduce(plan, rounds, slot, nleaves)
-        args = list(cnt_rounds)
+        bounds = self._bounds_arg(plan)
+        args = ([bounds] if bounds is not None else []) + list(cnt_rounds)
         for r in range(rounds):
             args.extend(recv_rounds[r])
         return reduce_fn(*args)
@@ -323,9 +371,15 @@ class JAXExecutor:
                 lax.slice_in_dim(l, map_id, map_id + 1, axis=0)
             ))[0, off:off + cnt] for l in store["leaves"]]
             lists = [m.tolist() for m in mats]
+            wrap = store.get("no_combine", False)
             for i in range(cnt):
-                rows.append(jax.tree_util.tree_unflatten(
-                    treedef, [pl[i] for pl in lists]))
+                rec = jax.tree_util.tree_unflatten(
+                    treedef, [pl[i] for pl in lists])
+                if wrap:
+                    # no-combine rows are raw (k, v); the host merge
+                    # contract expects (k, combiner=[v])
+                    rec = (rec[0], [rec[1]])
+                rows.append(rec)
         return rows
 
     def drop_shuffle(self, sid):

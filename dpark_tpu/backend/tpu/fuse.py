@@ -18,12 +18,37 @@ import jax
 import jax.numpy as jnp
 
 from dpark_tpu.backend.tpu import layout
+from dpark_tpu.dependency import HashPartitioner, RangePartitioner
 from dpark_tpu.rdd import (
-    FilteredRDD, KeyedRDD, MappedRDD, MappedValuesRDD, ParallelCollection,
-    ShuffledRDD)
+    FilteredRDD, FlatMappedValuesRDD, KeyedRDD, MapPartitionsRDD,
+    MappedRDD, MappedValuesRDD, ParallelCollection, ShuffledRDD,
+    _SortPartFn, _append, _extend, _identity, _mk_list)
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("tpu.fuse")
+
+
+def is_list_agg(agg):
+    """The identity list-aggregator trio used by groupByKey/partitionBy:
+    values need repartitioning but no combining (no-combine shuffle)."""
+    return (agg.create_combiner is _mk_list
+            and agg.merge_value is _append
+            and agg.merge_combiners is _extend)
+
+
+def partitioner_spec(part):
+    """Device destination function spec for a partitioner, or None."""
+    if isinstance(part, HashPartitioner):
+        return ("hash",)
+    if isinstance(part, RangePartitioner):
+        try:
+            bounds = np.asarray(part.bounds)
+        except Exception:
+            return None
+        if bounds.dtype == object or bounds.dtype.kind in "USO":
+            return None
+        return ("range", bool(part.ascending))
+    return None
 
 
 def _spec_struct(specs):
@@ -106,6 +131,36 @@ class MapOp:
         return list(out), n
 
 
+class SortOp:
+    """Per-partition sort by the key leaf (backs sortByKey's final
+    mapPartitions(_SortPartFn) on device)."""
+
+    def __init__(self, ascending):
+        self.ascending = ascending
+        self.key = ("sort", ascending)
+
+    def probe(self, treedef, specs):
+        dt, shape = specs[0]
+        if shape != () or dt.kind not in "if":
+            raise TypeError("sort needs a numeric scalar key leaf")
+        return treedef, specs
+
+    def apply(self, leaves, n):
+        from dpark_tpu.backend.tpu import collectives
+        cap = leaves[0].shape[0]
+        valid = jnp.arange(cap) < n
+        k = jnp.where(valid, leaves[0],
+                      collectives._sentinel(leaves[0].dtype))
+        packed = collectives._lex_sort((k,) + tuple(leaves[1:]), 1)
+        out = [packed[0]] + list(packed[1:])
+        if not self.ascending:
+            # reverse the valid prefix, keep padding in place
+            idx = jnp.arange(cap)
+            ridx = jnp.where(idx < n, n - 1 - idx, idx)
+            out = [l[ridx] for l in out]
+        return out, n
+
+
 class FilterOp:
     def __init__(self, f, key=None):
         self.f = f
@@ -177,11 +232,20 @@ def _keyby_as_record_fn(f):
 
 def extract_chain(top):
     """Walk narrow one-parent links from the stage's top RDD to its source.
-    Returns (source_rdd, ops list root->top) or None."""
+    Returns (source_rdd, ops list root->top, passthrough) or None.
+    `passthrough` is True when the chain unwrapped partitionBy's
+    FlatMappedValues(identity) over a no-combine shuffle (rows stay flat
+    (k, v) on device; no lists ever exist)."""
     ops = []
     cur = top
+    passthrough = False
     while True:
-        if isinstance(cur, MappedValuesRDD):
+        if isinstance(cur, FlatMappedValuesRDD) and cur.f is _identity \
+                and isinstance(cur.prev, ShuffledRDD) \
+                and is_list_agg(cur.prev.aggregator):
+            passthrough = True
+            cur = cur.prev
+        elif isinstance(cur, MappedValuesRDD):
             ops.append(MapOp(_mapvalue_as_record_fn(cur.f),
                              ("mapvalue", fn_key(cur.f))))
             cur = cur.prev
@@ -195,9 +259,13 @@ def extract_chain(top):
         elif isinstance(cur, FilteredRDD):
             ops.append(FilterOp(cur.f))
             cur = cur.prev
+        elif isinstance(cur, MapPartitionsRDD) \
+                and isinstance(cur.f, _SortPartFn) and not cur.with_index:
+            ops.append(SortOp(cur.f.ascending))
+            cur = cur.prev
         elif isinstance(cur, (ParallelCollection, ShuffledRDD)):
             ops.reverse()
-            return cur, ops
+            return cur, ops, passthrough
         else:
             return None
 
@@ -230,17 +298,28 @@ def _leaves_merge_fn(merge, nleaves):
     return merged
 
 
+def _numeric_key(specs):
+    """Key leaf 0 is a numeric scalar (int or float) — enough for range
+    repartitioning and sorting (hash shuffles additionally need int,
+    checked via layout.key_leaf_index)."""
+    if not specs:
+        return False
+    dt, shape = specs[0]
+    return shape == () and dt.kind in "if"
+
+
 def analyze_stage(stage, ndev, hbm_sids):
     """Decide whether `stage` can run on the array path; build its plan.
 
-    hbm_sids: set of shuffle ids whose map outputs are HBM-resident.
+    hbm_sids: dict of shuffle ids whose map outputs are HBM-resident.
     Returns StagePlan or None (host fallback).
     """
     top = stage.rdd
     extracted = extract_chain(top)
     if extracted is None:
         return None
-    source_rdd, ops = extracted
+    source_rdd, ops, passthrough = extracted
+    group_output = False
 
     # -- source record spec ---------------------------------------------
     if isinstance(source_rdd, ParallelCollection):
@@ -257,26 +336,35 @@ def analyze_stage(stage, ndev, hbm_sids):
             if dt == np.dtype(object) or dt.kind in "USO":
                 return None
         source = ("ingest", source_rdd)
+        src_combine = False
     elif isinstance(source_rdd, ShuffledRDD):
         dep = source_rdd.dep
         if dep.shuffle_id not in hbm_sids:
             return None                  # parent shuffle lives on host
         if dep.partitioner.num_partitions != ndev:
             return None
-        # record spec after combine: (key, combiner) — registered by the
-        # executor when the map side ran
+        # record spec of the stored rows — registered when the map ran
         meta = hbm_sids[dep.shuffle_id]
         treedef, specs = meta["out_treedef"], meta["out_specs"]
-        try:
-            merge_fn = _leaves_merge_fn(
-                dep.aggregator.merge_combiners, len(specs) - 1)
-            # probe merge on batched value leaves (merge is vmapped)
-            vstructs = _batched_spec_struct(specs[1:])
-            jax.eval_shape(
-                lambda *v: merge_fn(list(v), list(v)), *vstructs)
-        except Exception as e:
-            logger.debug("merge_combiners not traceable: %s", e)
-            return None
+        if is_list_agg(dep.aggregator):
+            # no-combine shuffle (partitionBy/groupByKey): rows pass
+            # through flat; bare groupByKey groups at egest time
+            src_combine = False
+            if not passthrough:
+                if ops or stage.is_shuffle_map:
+                    return None          # (k, [v]) records: host only
+                group_output = True
+        else:
+            src_combine = True
+            try:
+                merge_fn = _leaves_merge_fn(
+                    dep.aggregator.merge_combiners, len(specs) - 1)
+                vstructs = _batched_spec_struct(specs[1:])
+                jax.eval_shape(
+                    lambda *v: merge_fn(list(v), list(v)), *vstructs)
+            except Exception as e:
+                logger.debug("merge_combiners not traceable: %s", e)
+                return None
         source = ("hbm", dep)
     else:
         return None
@@ -293,25 +381,46 @@ def analyze_stage(stage, ndev, hbm_sids):
 
     # -- epilogue --------------------------------------------------------
     epilogue = None
+    epi_spec = None
+    epi_bounds = None
     if stage.is_shuffle_map:
         dep = stage.shuffle_dep
         if dep.partitioner.num_partitions != ndev:
             return None
-        # shuffle write needs an int scalar key and a traceable
-        # create_combiner
-        if layout.key_leaf_index(cur_treedef, cur_specs) is None:
+        epi_spec = partitioner_spec(dep.partitioner)
+        if epi_spec is None:
             return None
-        create = dep.aggregator.create_combiner
-        try:
-            op = MapOp(lambda rec: (rec[0], create(rec[1])))
-            cur_treedef, cur_specs = op.probe(cur_treedef, cur_specs)
-            ops.append(op)
-        except Exception as e:
-            logger.debug("create_combiner not traceable: %s", e)
-            return None
-        if layout.key_leaf_index(cur_treedef, cur_specs) is None:
-            return None
+        if epi_spec[0] == "hash":
+            if layout.key_leaf_index(cur_treedef, cur_specs) is None:
+                return None
+        else:
+            if not _numeric_key(cur_specs):
+                return None
+            epi_bounds = np.asarray(
+                dep.partitioner.bounds,
+                dtype=np.dtype(cur_specs[0][0]))
+        if is_list_agg(dep.aggregator):
+            pass                         # no-combine write: rows as-is
+        else:
+            create = dep.aggregator.create_combiner
+            try:
+                op = MapOp(lambda rec: (rec[0], create(rec[1])))
+                cur_treedef, cur_specs = op.probe(cur_treedef, cur_specs)
+                ops.append(op)
+            except Exception as e:
+                logger.debug("create_combiner not traceable: %s", e)
+                return None
+            if epi_spec[0] == "hash" and layout.key_leaf_index(
+                    cur_treedef, cur_specs) is None:
+                return None
         epilogue = ("shuffle_write", dep)
 
-    return StagePlan(source, ops, epilogue, treedef, specs,
+    plan = StagePlan(source, ops, epilogue, treedef, specs,
                      cur_treedef, cur_specs, stage)
+    plan.src_combine = src_combine
+    plan.group_output = group_output
+    plan.epi_spec = epi_spec
+    plan.epi_bounds = epi_bounds
+    plan.program_key = plan.program_key + (
+        src_combine, group_output, epi_spec)
+    return plan

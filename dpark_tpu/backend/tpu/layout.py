@@ -123,8 +123,13 @@ def ingest(mesh, partitions, treedef, specs, key_leaf=None):
         for li, (dt, shape) in enumerate(specs):
             cols[li][d, :counts[d]] = np.asarray(leaf_lists[li], dtype=dt)
     if key_leaf is not None and cols[key_leaf].size:
-        if int(cols[key_leaf].max()) == int(KEY_SENTINEL):
-            raise ValueError("key equal to the device sentinel (2**63-1); "
+        kc = cols[key_leaf]
+        if np.issubdtype(kc.dtype, np.floating):
+            if np.isinf(kc).any() or np.isnan(kc).any():
+                raise ValueError("inf/nan float key collides with device "
+                                 "padding; taking the host path")
+        elif int(kc.max()) == int(np.iinfo(kc.dtype).max):
+            raise ValueError("key equal to the device sentinel; "
                              "taking the host path")
     sharding = NamedSharding(mesh, P(AXIS))
     dev_cols = [jax.device_put(c, sharding) for c in cols]

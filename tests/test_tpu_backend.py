@@ -214,3 +214,65 @@ def test_columnar_parallelize_object_path_parity(ctx):
     assert got == {1: 40, 2: 20, 3: 40}
     single = ctx.parallelize(np.arange(5), 2).map(lambda x: x * 2).collect()
     assert single == [0, 2, 4, 6, 8]
+
+
+def test_sortbykey_on_device(tctx):
+    import random
+    from dpark_tpu import DparkContext
+    rng = random.Random(9)
+    pairs = [(rng.randint(-10000, 10000), i) for i in range(4000)]
+    r = tctx.parallelize(pairs, 8)
+    got = r.sortByKey(numSplits=8).collect()
+    assert [k for k, _ in got] == sorted(k for k, _ in pairs)
+    assert _used_array_path(tctx)
+    got_desc = r.sortByKey(ascending=False, numSplits=8).collect()
+    assert [k for k, _ in got_desc] == sorted(
+        (k for k, _ in pairs), reverse=True)
+
+
+def test_sortbykey_float_keys_device(tctx):
+    import random
+    rng = random.Random(4)
+    pairs = [(rng.random() * 100 - 50, i) for i in range(2000)]
+    got = tctx.parallelize(pairs, 8).sortByKey(numSplits=8).collect()
+    ks = [k for k, _ in got]
+    assert all(abs(a - b) < 1e-4 for a, b in
+               zip(ks, sorted(k for k, _ in pairs)))
+
+
+def test_groupbykey_on_device(tctx):
+    pairs = [(i % 7, i) for i in range(700)]
+    got = dict(tctx.parallelize(pairs, 8).groupByKey(8).collect())
+    assert set(got) == set(range(7))
+    for k in range(7):
+        assert sorted(got[k]) == [i for i in range(700) if i % 7 == k]
+    assert _used_array_path(tctx)
+
+
+def test_partition_by_device_then_host_op(tctx):
+    """partitionBy on device, then an untraceable op via the HBM bridge."""
+    pairs = [(i, i * 2) for i in range(400)]
+    r = tctx.parallelize(pairs, 8).partitionBy(8) \
+            .mapPartitions(lambda it: [len(list(it))])
+    counts = r.collect()
+    assert sum(counts) == 400
+
+
+def test_distinct_on_device(tctx):
+    data = [i % 50 for i in range(2000)]
+    got = sorted(tctx.parallelize(data, 8).distinct(8).collect())
+    assert got == list(range(50))
+
+
+def test_sentinel_key_in_range_sort_falls_back(tctx):
+    """INT64_MAX key must not be silently dropped by device sortByKey."""
+    pairs = [(i, i) for i in range(100, 1000)] + [(2**63 - 1, 111)]
+    got = tctx.parallelize(pairs, 8).sortByKey(numSplits=8).collect()
+    assert got[-1] == (2**63 - 1, 111)
+    assert len(got) == len(pairs)
+
+
+def test_inf_float_key_falls_back(tctx):
+    pairs = [(float(i), i) for i in range(50)] + [(float("inf"), -1)]
+    got = tctx.parallelize(pairs, 8).sortByKey(numSplits=8).collect()
+    assert got[-1] == (float("inf"), -1)
