@@ -38,6 +38,9 @@ parser.add_argument("-M", "--mem", type=float, default=None,
 parser.add_argument("--profile", action="store_true",
                     help="profile task execution")
 parser.add_argument("--conf", default=None, help="path to conf file")
+parser.add_argument("--webui", nargs="?", const="127.0.0.1:0",
+                    default=None, metavar="HOST:PORT",
+                    help="serve a live progress UI")
 
 optParser = parser          # reference-parity alias
 
@@ -94,6 +97,13 @@ class DparkContext:
             raise ValueError("unknown master %r (local/process/tpu)"
                              % self.master)
         self.scheduler.start()
+        webui = self.options.webui or os.environ.get("DPARK_WEBUI")
+        if webui:
+            from dpark_tpu.web import start_ui
+            host, _, port = str(webui).partition(":")
+            self._web, url = start_ui(self.scheduler, host or "127.0.0.1",
+                                      int(port or 0))
+            print("dpark_tpu web ui: %s" % url, file=sys.stderr)
         self.started = True
         atexit.register(self.stop)
 
@@ -101,6 +111,11 @@ class DparkContext:
         if not self.started:
             return
         self.started = False
+        web = getattr(self, "_web", None)
+        if web is not None:
+            web.shutdown()
+            web.server_close()
+            self._web = None
         if self.scheduler:
             prof = getattr(self.scheduler, "profile", None)
             if prof is not None:

@@ -1,0 +1,63 @@
+"""Multi-host bootstrap: DCN coordination for the tpu master.
+
+Reference parity: the reference's Mesos control plane + zmq tracker
+(SURVEY.md section 2.8) — its TPU-era equivalent is jax.distributed (one
+jax process per host, devices glued into one global mesh over ICI/DCN)
+plus the TCP tracker (dpark_tpu/tracker.py) as the metadata plane.
+
+Topology:
+  host 0: driver — DparkContext('tpu'), TrackerServer, jax coordinator;
+  host k: `mrun -n N python -m dpark_tpu.distributed` (or any program
+          calling init()) joins the mesh; the TPUScheduler then sees
+          jax.devices() spanning all hosts and shard_map collectives ride
+          ICI within a host and DCN across hosts.
+
+Single-host processes may call init() with num_processes=1 (no-op
+coordinator) so the same program runs unchanged everywhere.
+"""
+
+import os
+
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("distributed")
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None):
+    """Join (or create) the multi-host jax world.
+
+    Defaults come from the mrun/SLURM-style env vars:
+      MRUN_RANK/RANK, MRUN_SIZE/WORLD_SIZE, DPARK_COORDINATOR.
+    Returns (process_id, num_processes).
+    """
+    import jax
+
+    if num_processes is None:
+        num_processes = int(os.environ.get("MRUN_SIZE")
+                            or os.environ.get("WORLD_SIZE") or 1)
+    if process_id is None:
+        process_id = int(os.environ.get("MRUN_RANK")
+                         or os.environ.get("RANK") or 0)
+    if coordinator_address is None:
+        coordinator_address = os.environ.get(
+            "DPARK_COORDINATOR", "127.0.0.1:8476")
+
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+        logger.info("joined jax world %d/%d via %s",
+                    process_id, num_processes, coordinator_address)
+    return process_id, num_processes
+
+
+def start_tracker_if_driver(process_id=0, port=0):
+    """On the driver host, start the TCP tracker (metadata plane) and
+    return its address; workers connect with TrackerClient."""
+    from dpark_tpu.tracker import TrackerServer
+    if process_id != 0:
+        return None
+    srv = TrackerServer(port=port)
+    srv.start()
+    return srv

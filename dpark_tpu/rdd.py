@@ -1242,23 +1242,23 @@ class TextFileRDD(RDD):
 
     @staticmethod
     def _expand(path):
-        if os.path.isdir(path):
-            out = []
-            for root, _, names in os.walk(path):
-                for n in sorted(names):
-                    if n.startswith("."):
-                        continue
-                    p = os.path.join(root, n)
-                    out.append((p, os.path.getsize(p)))
-            return out
-        return [(path, os.path.getsize(path))]
+        """Walk via the file_manager layer so DFS schemes (the MooseFS
+        analog) plug in transparently (SURVEY.md section 2.4)."""
+        from dpark_tpu import file_manager
+        return list(file_manager.walk(path))
 
     def _make_splits(self):
         return [TextSplit(i, p, b, e)
                 for i, (p, b, e) in enumerate(self._file_splits)]
 
+    def preferred_locations(self, split):
+        from dpark_tpu import file_manager
+        return file_manager.locations(split.path, split.begin,
+                                      split.end - split.begin)
+
     def compute(self, split):
-        with open(split.path, "rb") as f:
+        from dpark_tpu import file_manager
+        with file_manager.open_file(split.path) as f:
             if split.begin > 0:
                 f.seek(split.begin - 1)
                 byte = f.read(1)

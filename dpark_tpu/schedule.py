@@ -84,6 +84,8 @@ class DAGScheduler:
         # host health (trivial on single-host masters; the multi-host DCN
         # dispatcher consults is_blacklisted/offer_choice)
         self.host_manager = TaskHostManager()
+        self.history = []              # job records for the web UI
+        self._next_job_id = 0
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -132,19 +134,32 @@ class DAGScheduler:
             partitions = list(range(len(final_rdd.splits)))
         if not partitions:
             return
+        import time as _time
         # allowLocal fast path (reference: runJob allowLocal) — single
         # partition, no shuffle parents: compute inline, no tasks.
         final_stage = self.new_stage(final_rdd, None)
         if (allow_local and len(partitions) == 1 and not final_stage.parents):
-            yield func(final_rdd.iterator(final_rdd.splits[partitions[0]]))
+            record = self._new_job_record(final_rdd, 1, stages=0)
+            t0 = _time.time()
+            try:
+                yield func(final_rdd.iterator(
+                    final_rdd.splits[partitions[0]]))
+                record["finished"] = 1
+                record["state"] = "done"
+            except GeneratorExit:
+                record["state"] = "partial"    # take/first stopped early
+                raise
+            except BaseException:
+                record["state"] = "aborted"
+                raise
+            finally:
+                record["seconds"] = round(_time.time() - t0, 3)
             return
 
         output_parts = list(partitions)
         part_index = {p: i for i, p in enumerate(output_parts)}
         finished = [False] * len(output_parts)
         results = [None] * len(output_parts)
-        num_finished = 0
-        next_to_yield = 0
 
         # job-scoped event queue: tasks submitted by THIS job report here,
         # so a generator abandoned mid-iteration (take/iterate) can never
@@ -160,6 +175,9 @@ class DAGScheduler:
         pending_tasks = {}      # stage -> set of partition ids not yet done
         failures = {}           # task partition retry counters per stage
         progress = Progress(final_rdd.scope_name, len(output_parts))
+
+        record = self._new_job_record(final_rdd, len(output_parts))
+        job_t0 = _time.time()
 
         stage_of = {}
 
@@ -195,7 +213,38 @@ class DAGScheduler:
             self.submit_tasks(stage, tasks, report)
 
         submit_stage(final_stage)
+        record["stages"] = len(stage_of)
 
+        try:
+            yield from self._event_loop(
+                output_parts, finished, results, events, in_flight,
+                waiting, running, pending_tasks, failures, progress,
+                stage_of, submit_stage, submit_missing_tasks, record,
+                report)
+        except GeneratorExit:
+            # consumer stopped early (take/first/iterate) — by design
+            record["state"] = "partial"
+            raise
+        finally:
+            if record["state"] == "running":
+                record["state"] = "done" if all(finished) else "aborted"
+            record["seconds"] = round(_time.time() - job_t0, 3)
+
+    def _new_job_record(self, final_rdd, parts, stages=1):
+        self._next_job_id += 1
+        record = {"id": self._next_job_id, "scope": final_rdd.scope_name,
+                  "parts": parts, "finished": 0, "stages": stages,
+                  "seconds": 0.0, "state": "running"}
+        self.history.append(record)
+        del self.history[:-100]
+        return record
+
+    def _event_loop(self, output_parts, finished, results, events,
+                    in_flight, waiting, running, pending_tasks, failures,
+                    progress, stage_of, submit_stage,
+                    submit_missing_tasks, record, report):
+        num_finished = 0
+        next_to_yield = 0
         while num_finished < len(output_parts):
             try:
                 task, status, payload = events.get(
@@ -228,6 +277,7 @@ class DAGScheduler:
                         finished[idx] = True
                         results[idx] = result
                         num_finished += 1
+                        record["finished"] = num_finished
                         progress.tick()
                     while (next_to_yield < len(output_parts)
                            and finished[next_to_yield]):

@@ -1,0 +1,72 @@
+"""Minimal web UI: live job/stage progress over stdlib http.server.
+
+Reference parity: dpark/web/ (optional flask app showing stages and
+progress, SURVEY.md section 2.5).  flask is not in this image, so the
+same capability ships on http.server: an HTML overview at / and JSON at
+/api/jobs, fed by the scheduler's event history.
+"""
+
+import http.server
+import json
+import threading
+
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("web")
+
+_PAGE = """<!doctype html>
+<html><head><title>dpark_tpu</title>
+<style>
+ body { font-family: monospace; margin: 2em; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #999; padding: 4px 10px; text-align: left; }
+ .done { color: #2a2; } .run { color: #d80; }
+</style></head>
+<body>
+<h2>dpark_tpu jobs</h2>
+<table id="t"><tr><th>job</th><th>scope</th><th>parts</th>
+<th>finished</th><th>stages</th><th>seconds</th><th>state</th></tr></table>
+<script>
+async function tick() {
+  const r = await fetch('/api/jobs'); const jobs = await r.json();
+  const t = document.getElementById('t');
+  while (t.rows.length > 1) t.deleteRow(1);
+  for (const j of jobs) {
+    const row = t.insertRow();
+    for (const v of [j.id, j.scope, j.parts, j.finished, j.stages,
+                     j.seconds, j.state])
+      row.insertCell().textContent = v;
+    row.className = j.state === 'done' ? 'done' : 'run';
+  }
+}
+setInterval(tick, 1000); tick();
+</script></body></html>"""
+
+
+def start_ui(scheduler, host="127.0.0.1", port=0):
+    """Serve the scheduler's job history; returns (server, url)."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.startswith("/api/jobs"):
+                body = json.dumps(
+                    list(getattr(scheduler, "history", []))).encode()
+                ctype = "application/json"
+            else:
+                body = _PAGE.encode()
+                ctype = "text/html"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    url = "http://%s:%d/" % server.server_address
+    logger.info("web ui at %s", url)
+    return server, url

@@ -2,6 +2,7 @@
 nested groupby (reference: per-component unit tests, SURVEY.md section 4).
 """
 
+import os
 import time
 
 import pytest
@@ -180,3 +181,96 @@ def test_tracker_mutation_dedup():
         c.close()
     finally:
         srv.stop()
+
+
+def test_file_manager_walk_and_locations(tmp_path):
+    from dpark_tpu import file_manager as fm
+    (tmp_path / "a.txt").write_text("x")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "b.txt").write_text("yy")
+    files = dict(fm.walk(str(tmp_path)))
+    assert set(os.path.basename(p) for p in files) == {"a.txt", "b.txt"}
+    assert fm.file_size(str(tmp_path / "a.txt")) == 1
+    assert fm.locations(str(tmp_path / "a.txt"))  # non-empty host list
+    assert fm.chunks_of(str(tmp_path / "sub" / "b.txt")) == [(0, 2)]
+
+
+def test_file_manager_scheme_registry(tmp_path):
+    from dpark_tpu import file_manager as fm
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        fm.get_filesystem("nosuch://x")
+    fs, p = fm.get_filesystem("file://" + str(tmp_path))
+    assert p == str(tmp_path)
+
+
+def test_web_ui_serves_history(ctx):
+    import json
+    import urllib.request
+    from dpark_tpu.web import start_ui
+    ctx.parallelize(range(10), 2).count()
+    server, url = start_ui(ctx.scheduler)
+    try:
+        jobs = json.loads(urllib.request.urlopen(url + "api/jobs",
+                                                 timeout=5).read())
+        assert jobs and jobs[-1]["state"] == "done"
+        assert jobs[-1]["finished"] == 2
+        html = urllib.request.urlopen(url, timeout=5).read()
+        assert b"dpark_tpu" in html
+    finally:
+        server.shutdown()
+
+
+def test_distributed_init_single():
+    from dpark_tpu.distributed import init
+    pid, n = init(num_processes=1, process_id=0)
+    assert (pid, n) == (0, 1)
+
+
+def test_drun_tool(tmp_path):
+    import subprocess, sys
+    out = subprocess.run(
+        [sys.executable, "tools/drun", "-n", "3",
+         sys.executable, "-c",
+         "import os; print('slot', os.environ['DRUN_SLOT'])"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0
+    assert sorted(out.stdout.split()) .count("slot") == 3
+
+
+def test_mrun_tool():
+    import subprocess, sys
+    out = subprocess.run(
+        [sys.executable, "tools/mrun", "-n", "2",
+         sys.executable, "-c",
+         "import os; print('rank', os.environ['MRUN_RANK'])"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0
+    assert "[rank 0]" in out.stdout and "[rank 1]" in out.stdout
+
+
+def test_textfile_missing_path_raises(ctx):
+    with pytest.raises(FileNotFoundError):
+        ctx.textFile("/no/such/file_xyz.txt").count()
+
+
+def test_walk_requalifies_scheme(tmp_path):
+    from dpark_tpu import file_manager as fm
+
+    class FakeFS(fm.LocalFileSystem):
+        scheme = "fake"
+    fm.register_filesystem("fake", FakeFS())
+    (tmp_path / "f.txt").write_text("hello\n")
+    files = list(fm.walk("fake://" + str(tmp_path)))
+    assert files and files[0][0].startswith("fake://")
+    # and per-file calls route back through the fake scheme
+    assert fm.file_size(files[0][0]) == 6
+
+
+def test_take_job_recorded_as_partial(ctx):
+    ctx.parallelize(range(100), 10).take(3)
+    states = [j["state"] for j in ctx.scheduler.history]
+    assert "aborted" not in states
+    ctx.parallelize(range(100), 10).collect()
+    assert ctx.scheduler.history[-1]["state"] == "done"
