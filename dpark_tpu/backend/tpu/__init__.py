@@ -76,10 +76,84 @@ class TPUScheduler(DAGScheduler):
                 logger.warning(
                     "array path failed for %s (%s); object fallback",
                     stage, e)
-        # object path: run tasks inline on the driver (golden semantics)
-        for task in tasks:
-            status, payload = _run_task_inline(task)
-            report(task, status, payload)
+        # object path: run tasks inline on the driver (golden semantics);
+        # cogroup stages first pre-materialize their CoGroupedRDD via the
+        # device exchange so only the group-merge runs in Python
+        precomputed = None
+        try:
+            precomputed = self._precompute_cogroup(stage)
+        except Exception as e:
+            logger.debug("cogroup precompute skipped: %s", e)
+        try:
+            for task in tasks:
+                status, payload = _run_task_inline(task)
+                report(task, status, payload)
+        finally:
+            if precomputed is not None:
+                # free the seeded partitions: later retries recompute
+                # through the export bridge instead of leaking the whole
+                # cogrouped dataset in driver memory
+                cg, nparts = precomputed
+                from dpark_tpu.env import env
+                env.cache.drop(cg.id, nparts)
+                cg.should_cache = False
+
+    def _precompute_cogroup(self, stage):
+        """If this stage reads a CoGroupedRDD whose inputs are all
+        HBM-resident no-combine shuffles, run the exchanges on device
+        (sorted rows per partition), merge the sorted runs on host, and
+        seed the partition cache so the object path never touches the
+        per-bucket export bridge."""
+        from dpark_tpu.backend.tpu import fuse
+        from dpark_tpu.dependency import ShuffleDependency
+        from dpark_tpu.env import env
+        from dpark_tpu.rdd import CoGroupedRDD
+
+        # find the nearest CoGroupedRDD through narrow deps
+        seen = set()
+        cg = None
+        frontier = [stage.rdd]
+        while frontier:
+            r = frontier.pop()
+            if id(r) in seen:
+                continue
+            seen.add(id(r))
+            if isinstance(r, CoGroupedRDD):
+                cg = r
+                break
+            for d in r.dependencies:
+                if not isinstance(d, ShuffleDependency):
+                    frontier.append(d.rdd)
+        if cg is None:
+            return None
+        if getattr(cg, "_tpu_precomputed", False):
+            return None
+        deps = []
+        for kind, obj in cg._dep_kinds:
+            if kind != "shuffle":
+                return None              # narrow co-partitioned side: host
+            if not fuse.is_list_agg(obj.aggregator):
+                return None
+            if not self.executor.has_shuffle(obj.shuffle_id):
+                return None
+            deps.append(obj)
+        per_source = [self.executor.gather_rows(dep) for dep in deps]
+        nsrc = len(per_source)
+        nparts = cg.partitioner.num_partitions
+        for p in range(nparts):
+            slots = {}
+            for si in range(nsrc):
+                for k, v in per_source[si][p]:
+                    slot = slots.get(k)
+                    if slot is None:
+                        slot = slots[k] = tuple([] for _ in range(nsrc))
+                    slot[si].append(v)
+            env.cache.put((cg.id, p), list(slots.items()), disk=False)
+        cg.should_cache = True
+        cg._tpu_precomputed = True
+        logger.debug("cogroup %d precomputed on device (%d sources)",
+                     cg.id, nsrc)
+        return cg, nparts
 
     def _run_array_stage(self, stage, tasks, plan, report):
         kind, result = self.executor.run_stage(plan)

@@ -349,6 +349,37 @@ class JAXExecutor:
         return reduce_fn(*args)
 
     # ------------------------------------------------------------------
+    # cogroup support: exchange one dep's rows to their reduce partitions
+    # and return them key-sorted per partition (no combining)
+    # ------------------------------------------------------------------
+    def gather_rows(self, dep):
+        """Device exchange + key sort for one no-combine shuffle dep;
+        returns per-partition sorted row lists (host)."""
+        store = self.shuffle_store[dep.shuffle_id]
+
+        class _GatherPlan:
+            source = ("hbm", dep)
+            ops = []
+            epilogue = None
+            src_combine = False
+            group_output = False
+            epi_spec = None
+            epi_bounds = None
+            in_treedef = store["out_treedef"]
+            in_specs = store["out_specs"]
+            out_treedef = store["out_treedef"]
+            out_specs = store["out_specs"]
+            stage = None
+            program_key = ("gather",
+                           tuple((str(dt), shape)
+                                 for dt, shape in store["out_specs"]))
+
+        outs = self._run_exchange_and_reduce(_GatherPlan)
+        counts, leaves = outs[0], list(outs[1:])
+        batch = layout.Batch(store["out_treedef"], leaves, counts)
+        return layout.egest(batch)
+
+    # ------------------------------------------------------------------
     # host bridge
     # ------------------------------------------------------------------
     def has_shuffle(self, sid):

@@ -19,6 +19,14 @@ MEM_PER_TASK = 200.0          # MB per task (process/mesos masters)
 MAX_TASK_FAILURES = 4         # retries before a job aborts
 SCHEDULER_STALL_TIMEOUT = 60  # s between event-queue deadlock checks; a
                               # check only aborts when NO task is in flight
+
+# speculative re-launch of stragglers (reference: dpark/job.py): once
+# SPECULATION_QUANTILE of a stage's tasks finished, any task running
+# longer than SPECULATION_MULTIPLIER x the median duration gets a
+# duplicate; first completion wins
+SPECULATION = True
+SPECULATION_QUANTILE = 0.75
+SPECULATION_MULTIPLIER = 2.0
 MAX_TASK_MEMORY = 15 << 10    # MB hard ceiling when escalating retries
 
 # shuffle behaviour (the reference's `rddconf`)

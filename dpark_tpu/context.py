@@ -17,6 +17,7 @@ import os
 import sys
 
 import importlib
+import itertools
 
 _accumulator = importlib.import_module("dpark_tpu.accumulator")
 import dpark_tpu.rdd as _rdd
@@ -132,9 +133,13 @@ class DparkContext:
         self.stop()
 
     # -- ids / config ----------------------------------------------------
+    _global_rdd_id = itertools.count(1)
+
     def new_rdd_id(self):
-        self._next_rdd_id += 1
-        return self._next_rdd_id
+        # process-global, not per-context: the partition cache and HBM
+        # stores key by rdd id, and multiple contexts (e.g. streaming
+        # recovery) share those singletons in one process
+        return next(DparkContext._global_rdd_id)
 
     @property
     def default_parallelism(self):

@@ -28,6 +28,7 @@ class StreamingContext:
         if isinstance(ctx, str):
             ctx = DparkContext(ctx)
         self.ctx = ctx
+        self._master = ctx.master
         self.batch_duration = float(batchDuration)
         self.zero_time = None
         self.output_streams = []
@@ -36,6 +37,79 @@ class StreamingContext:
         self._stopped = threading.Event()
         self._thread = None
         self.checkpoint_interval = 10     # batches
+        self.checkpoint_path = None
+        self._batches_done = 0
+        self._checkpoint_now = False
+        self.last_checkpoint_t = None
+
+    # -- checkpoint / recovery (reference: StreamingContext recovery from
+    #    a checkpoint dir, SURVEY.md 5.4) --------------------------------
+    def checkpoint(self, directory):
+        os.makedirs(directory, exist_ok=True)
+        self.checkpoint_path = directory
+        self.ctx.setCheckpointDir(directory)
+        return self
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        for k in ("ctx", "_thread", "_timer", "_stopped"):
+            d[k] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._stopped = threading.Event()
+
+    def _save_metadata(self, t):
+        from dpark_tpu import serialize
+        from dpark_tpu.utils import atomic_file
+        self.last_checkpoint_t = t
+        path = os.path.join(self.checkpoint_path, "metadata")
+        with atomic_file(path) as f:
+            f.write(serialize.dumps(self))
+
+    @classmethod
+    def getOrCreate(cls, directory, create_fn):
+        """Recover the stream graph + state from `directory`, or build a
+        fresh context via create_fn() and enable checkpointing into it.
+        Recovery resumes state streams from their last checkpointed batch;
+        queue/socket input consumed after that checkpoint is not replayed
+        (at-most-once, as in the reference's data-loss caveats)."""
+        import os as _os
+        from dpark_tpu import serialize
+        path = _os.path.join(directory, "metadata")
+        if _os.path.exists(path):
+            with open(path, "rb") as f:
+                ssc = serialize.loads(f.read())
+            ssc._restore(directory)
+            return ssc
+        ssc = create_fn()
+        ssc.checkpoint(directory)
+        return ssc
+
+    def _restore(self, directory):
+        from dpark_tpu.context import DparkContext
+        self.ctx = DparkContext(self._master)
+        self.ctx.setCheckpointDir(directory)
+        self.checkpoint_path = directory
+        for stream in self._all_streams():
+            stream.ssc = self
+            for rdd in list(stream.generated.values()):
+                if rdd is not None:
+                    _fix_rdd_ctx(rdd, self.ctx)
+
+    def _all_streams(self):
+        out = []
+        seen = set()
+        frontier = list(self.output_streams) + list(self.input_streams)
+        while frontier:
+            s = frontier.pop()
+            if id(s) in seen:
+                continue
+            seen.add(id(s))
+            out.append(s)
+            frontier.extend(s.parents)
+        return out
 
     batchDuration = property(lambda self: self.batch_duration)
 
@@ -67,8 +141,9 @@ class StreamingContext:
         for ins in self.input_streams:
             ins.start()
         bd = self.batch_duration
-        now = t0 if t0 is not None else _time.time()
-        self.zero_time = now - (now % bd)
+        if self.zero_time is None or t0 is not None:
+            now = t0 if t0 is not None else _time.time()
+            self.zero_time = now - (now % bd)
         self._stopped.clear()
         self._thread = threading.Thread(target=self._run_loop, daemon=True)
         self._thread.start()
@@ -91,10 +166,16 @@ class StreamingContext:
         """Generate and run one batch's jobs (called by the timer loop; in
         tests it can be driven manually for determinism)."""
         t = round(t, 6)
+        self._batches_done += 1
+        self._checkpoint_now = (
+            self.checkpoint_path is not None
+            and self._batches_done % self.checkpoint_interval == 0)
         for out in self.output_streams:
             out.generate_job(t)
         for out in self.output_streams:
             out.forget_old(t)
+        if self._checkpoint_now:
+            self._save_metadata(t)
 
     def awaitTermination(self, timeout=None):
         if self._thread:
@@ -116,7 +197,6 @@ class DStream:
         self.ssc = ssc
         self.generated = {}            # time -> rdd (or None)
         self.must_checkpoint = False
-        self._batches_seen = 0
 
     @property
     def slide_duration(self):
@@ -143,13 +223,20 @@ class DStream:
             return self.generated[t]
         rdd = self.compute(t)
         self.generated[t] = rdd
-        if rdd is not None and self.must_checkpoint:
-            self._batches_seen += 1
-            if (self.ssc.ctx.checkpoint_dir
-                    and self._batches_seen
-                    % self.ssc.checkpoint_interval == 0):
-                rdd.checkpoint()
+        if rdd is not None and self.must_checkpoint \
+                and self.ssc.ctx.checkpoint_dir \
+                and getattr(self.ssc, "_checkpoint_now", False):
+            rdd.checkpoint()
         return rdd
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        # only checkpointed RDDs survive serialization (their lineage is
+        # truncated to on-disk partitions); everything else recomputes
+        d["generated"] = {
+            t: r for t, r in self.generated.items()
+            if r is not None and r._checkpoint_rdd is not None}
+        return d
 
     def forget_old(self, t, keep=None):
         keep = keep if keep is not None else self._remember_duration()
@@ -270,6 +357,32 @@ class DStream:
         """Test/utility output: append (time, list) per non-empty batch."""
         return self.foreachRDD(
             lambda rdd, t: sink.append((t, rdd.collect())))
+
+
+def _fix_rdd_ctx(rdd, ctx):
+    """Re-attach the live context to a recovered RDD graph (RDD pickling
+    drops ctx)."""
+    seen = set()
+    frontier = [rdd]
+    while frontier:
+        r = frontier.pop()
+        if id(r) in seen or r is None:
+            continue
+        seen.add(id(r))
+        if getattr(r, "ctx", None) is None:
+            r.ctx = ctx
+        for attr in ("prev", "parent", "_checkpoint_rdd", "rdd1", "rdd2"):
+            nxt = getattr(r, attr, None)
+            if nxt is not None and hasattr(nxt, "dependencies"):
+                frontier.append(nxt)
+        for attr in ("rdds",):
+            for nxt in getattr(r, attr, []) or []:
+                if hasattr(nxt, "dependencies"):
+                    frontier.append(nxt)
+        for dep in getattr(r, "dependencies", []) or []:
+            nxt = getattr(dep, "rdd", None)
+            if nxt is not None:
+                frontier.append(nxt)
 
 
 def _rdd_op(name, *args):
@@ -719,6 +832,19 @@ class SocketInputDStream(InputDStream):
         if self._thread:
             self._thread.join(3)
             self._thread = None
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        for k in ("lock", "_stop", "_thread"):
+            d[k] = None
+        d["buffer"] = []
+        d["generated"] = {}
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.lock = threading.Lock()
+        self._stop = threading.Event()
 
     def compute(self, t):
         with self.lock:

@@ -194,6 +194,8 @@ class DAGScheduler:
                 for p in missing:
                     submit_stage(p)
 
+        submitted_at = {}       # (stage_id, partition) -> last submit time
+
         def submit_missing_tasks(stage):
             tasks = []
             if stage.is_shuffle_map:
@@ -208,9 +210,24 @@ class DAGScheduler:
                             stage.id, final_rdd, func, p, part_index[p]))
             pending_tasks.setdefault(stage, set()).update(
                 t.partition for t in tasks)
+            now = _time.time()
+            for t in tasks:
+                submitted_at[(stage.id, t.partition)] = now
             logger.debug("submit stage %s with %d tasks", stage, len(tasks))
             in_flight[0] += len(tasks)
             self.submit_tasks(stage, tasks, report)
+
+        def spawn_duplicate(stage, p):
+            """Speculative copy of a straggling task (first result wins)."""
+            if stage.is_shuffle_map:
+                t = ShuffleMapTask(stage.id, stage.rdd,
+                                   stage.shuffle_dep, p)
+            else:
+                t = ResultTask(stage.id, final_rdd, func, p, part_index[p])
+            in_flight[0] += 1
+            record["speculated"] = record.get("speculated", 0) + 1
+            logger.info("speculatively re-launching %r", t)
+            self.submit_tasks(stage, [t], report)
 
         submit_stage(final_stage)
         record["stages"] = len(stage_of)
@@ -220,7 +237,7 @@ class DAGScheduler:
                 output_parts, finished, results, events, in_flight,
                 waiting, running, pending_tasks, failures, progress,
                 stage_of, submit_stage, submit_missing_tasks, record,
-                report)
+                report, submitted_at, spawn_duplicate)
         except GeneratorExit:
             # consumer stopped early (take/first/iterate) — by design
             record["state"] = "partial"
@@ -239,25 +256,63 @@ class DAGScheduler:
         del self.history[:-100]
         return record
 
+    @staticmethod
+    def _check_speculation(running, pending_tasks, durations,
+                           submitted_at, speculated, spawn_duplicate):
+        """Straggler re-launch (reference: dpark/job.py speculation)."""
+        import time as _time
+        now = _time.time()
+        for stage in list(running):
+            pend = pending_tasks.get(stage)
+            done = durations.get(stage.id, [])
+            if not pend or not done:
+                continue
+            total = len(pend) + len(done)
+            if len(done) / total < conf.SPECULATION_QUANTILE:
+                continue
+            med = sorted(done)[len(done) // 2]
+            threshold = max(conf.SPECULATION_MULTIPLIER * med, 0.5)
+            for p in list(pend):
+                key = (stage.id, p)
+                started = submitted_at.get(key)
+                if (started is not None and key not in speculated
+                        and now - started > threshold):
+                    speculated.add(key)
+                    spawn_duplicate(stage, p)
+
     def _event_loop(self, output_parts, finished, results, events,
                     in_flight, waiting, running, pending_tasks, failures,
                     progress, stage_of, submit_stage,
-                    submit_missing_tasks, record, report):
+                    submit_missing_tasks, record, report, submitted_at,
+                    spawn_duplicate):
+        import time as _time
         num_finished = 0
         next_to_yield = 0
+        durations = {}          # stage_id -> completed task durations
+        speculated = set()
+        poll = 1.0 if conf.SPECULATION else conf.SCHEDULER_STALL_TIMEOUT
         while num_finished < len(output_parts):
             try:
-                task, status, payload = events.get(
-                    timeout=conf.SCHEDULER_STALL_TIMEOUT)
+                task, status, payload = events.get(timeout=poll)
             except queue.Empty:
-                if in_flight[0] > 0:
-                    continue        # a long task is legitimately running
-                raise RuntimeError(
-                    "scheduler deadlock: no tasks in flight and no events "
-                    "(waiting=%r running=%r finished=%d/%d)"
-                    % (waiting, running, num_finished, len(output_parts)))
+                if in_flight[0] <= 0:
+                    raise RuntimeError(
+                        "scheduler deadlock: no tasks in flight and no "
+                        "events (waiting=%r running=%r finished=%d/%d)"
+                        % (waiting, running, num_finished,
+                           len(output_parts)))
+                if conf.SPECULATION:
+                    self._check_speculation(
+                        running, pending_tasks, durations, submitted_at,
+                        speculated, spawn_duplicate)
+                continue        # a long task is legitimately running
             in_flight[0] -= 1
             stage = stage_of.get(task.stage_id)
+            tkey = (task.stage_id, task.partition)
+            started = submitted_at.pop(tkey, None)
+            if started is not None and status == "success":
+                durations.setdefault(task.stage_id, []).append(
+                    _time.time() - started)
             if status == "success":
                 result, acc_updates, md_updates = payload
                 self.host_manager.task_succeed_on(env.host)
@@ -272,6 +327,9 @@ class DAGScheduler:
                     from dpark_tpu import mutable_dict
                     mutable_dict.merge_on_driver(md_updates)
                 if isinstance(task, ResultTask):
+                    pend = pending_tasks.get(stage)
+                    if pend is not None:
+                        pend.discard(task.partition)
                     idx = task.output_id
                     if not finished[idx]:
                         finished[idx] = True
@@ -323,6 +381,14 @@ class DAGScheduler:
                     submit_stage(parent)
             else:       # failure
                 self.host_manager.task_failed_on(env.host)
+                # losing duplicate of a partition another attempt already
+                # completed: ignore (speculation/retry race), don't count
+                if isinstance(task, ResultTask):
+                    if finished[task.output_id]:
+                        continue
+                elif stage is not None \
+                        and stage.output_locs[task.partition] is not None:
+                    continue
                 key = (task.stage_id, task.partition)
                 failures[key] = failures.get(key, 0) + 1
                 if failures[key] >= conf.MAX_TASK_FAILURES:
@@ -334,6 +400,7 @@ class DAGScheduler:
                                task, failures[key], str(payload)[:200])
                 task.tried += 1
                 in_flight[0] += 1
+                submitted_at[tkey] = _time.time()
                 self.submit_tasks(stage, [task], report)
 
     # -- master-specific -------------------------------------------------

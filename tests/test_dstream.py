@@ -211,3 +211,42 @@ def test_socket_text_stream(ctx):
     server.close()
     flat = [x for _, v in out for x in v]
     assert flat == ["hello", "world"]
+
+
+def test_checkpoint_recovery(ctx, tmp_path):
+    """Crash/restore: state stream resumes from the checkpointed batch
+    (reference: StreamingContext recovery, SURVEY.md 5.4)."""
+    import operator
+    from dpark_tpu.dstream import StreamingContext
+    ckdir = str(tmp_path / "stream_ck")
+
+    out1 = []
+
+    def create():
+        ssc = StreamingContext(ctx, 1.0)
+        ssc.checkpoint_interval = 2       # checkpoint every 2 batches
+        q = ssc.queueStream([[("a", 1)], [("a", 2)], [("a", 4)]])
+        q.updateStateByKey(
+            lambda vs, prev: sum(vs) + (prev or 0)).collect_batches(out1)
+        return ssc
+
+    ssc = StreamingContext.getOrCreate(ckdir, create)
+    assert ssc.checkpoint_path == ckdir
+    ssc.ctx.start()
+    ssc.zero_time = 1000.0
+    for k in (1, 2):                       # two batches -> checkpoint at 2
+        ssc.run_batch(1000.0 + k)
+    assert dict(out1[-1][1]) == {"a": 3}
+    assert ssc.last_checkpoint_t == 1002.0
+
+    # "crash": recover a NEW context from disk
+    ssc2 = StreamingContext.getOrCreate(ckdir, create)
+    assert ssc2 is not ssc                 # restored, not re-created
+    assert ssc2.last_checkpoint_t == 1002.0
+    out2 = []
+    # rewire the restored output to a fresh sink we can observe
+    ssc2.output_streams[0].func = lambda rdd, t: out2.append(
+        (t, rdd.collect()))
+    ssc2.ctx.start()
+    ssc2.run_batch(1003.0)                 # continues with queued batch 3
+    assert dict(out2[-1][1]) == {"a": 7}   # 1+2 restored, +4

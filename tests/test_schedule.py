@@ -95,3 +95,28 @@ def test_disk_spill_merger(ctx):
         m.merge([(k, 1) for k in range(25)])
     got = dict(m)
     assert got == {k: 20 for k in range(25)}
+
+
+def test_speculative_relaunch(pctx):
+    """One straggler among fast tasks triggers a speculative duplicate;
+    results stay correct and the duplicate is recorded."""
+    from dpark_tpu import conf
+
+    def slow_partition(i, it):
+        import time as _t
+        items = list(it)
+        if i == 0:
+            _t.sleep(4)
+        return [sum(items)]
+
+    old = (conf.SPECULATION_MULTIPLIER, conf.SPECULATION_QUANTILE)
+    conf.SPECULATION_MULTIPLIER = 1.5
+    conf.SPECULATION_QUANTILE = 0.5
+    try:
+        r = pctx.parallelize(list(range(100)), 10) \
+                .mapPartitionsWithIndex(slow_partition)
+        got = r.collect()
+        assert sum(got) == 4950
+        assert pctx.scheduler.history[-1].get("speculated", 0) >= 1
+    finally:
+        conf.SPECULATION_MULTIPLIER, conf.SPECULATION_QUANTILE = old

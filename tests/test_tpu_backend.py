@@ -276,3 +276,27 @@ def test_inf_float_key_falls_back(tctx):
     pairs = [(float(i), i) for i in range(50)] + [(float("inf"), -1)]
     got = tctx.parallelize(pairs, 8).sortByKey(numSplits=8).collect()
     assert got[-1] == (float("inf"), -1)
+
+
+def test_cogroup_device_exchange(tctx):
+    a = tctx.parallelize([(i % 20, i) for i in range(400)], 8)
+    b = tctx.parallelize([(i % 20, i * 3) for i in range(200)], 8)
+    got = dict(a.cogroup(b, numSplits=8).collect())
+    assert set(got) == set(range(20))
+    for k in range(20):
+        assert sorted(got[k][0]) == [i for i in range(400) if i % 20 == k]
+        assert sorted(got[k][1]) == [i * 3 for i in range(200)
+                                     if i % 20 == k]
+
+
+def test_join_device_exchange_matches_local(tctx):
+    from dpark_tpu import DparkContext
+    a_pairs = [(i % 30, i) for i in range(300)]
+    b_pairs = [(i % 30, -i) for i in range(150)]
+    a = tctx.parallelize(a_pairs, 8)
+    b = tctx.parallelize(b_pairs, 8)
+    got = sorted(a.join(b, 8).collect())
+    lctx = DparkContext("local")
+    expect = sorted(lctx.parallelize(a_pairs, 8)
+                    .join(lctx.parallelize(b_pairs, 8), 8).collect())
+    assert got == expect
