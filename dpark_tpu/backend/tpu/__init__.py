@@ -90,13 +90,14 @@ class TPUScheduler(DAGScheduler):
                 report(task, status, payload)
         finally:
             if precomputed is not None:
-                # free the seeded partitions: later retries recompute
-                # through the export bridge instead of leaking the whole
-                # cogrouped dataset in driver memory
-                cg, nparts = precomputed
-                from dpark_tpu.env import env
-                env.cache.drop(cg.id, nparts)
-                cg.should_cache = False
+                # free the seeded partitions (unless the USER cached this
+                # cogroup): later retries recompute through the export
+                # bridge instead of leaking the dataset in driver memory
+                cg, nparts, was_cached = precomputed
+                if not was_cached:
+                    from dpark_tpu.env import env
+                    env.cache.drop(cg.id, nparts)
+                    cg.should_cache = False
 
     def _precompute_cogroup(self, stage):
         """If this stage reads a CoGroupedRDD whose inputs are all
@@ -149,11 +150,12 @@ class TPUScheduler(DAGScheduler):
                         slot = slots[k] = tuple([] for _ in range(nsrc))
                     slot[si].append(v)
             env.cache.put((cg.id, p), list(slots.items()), disk=False)
+        was_cached = cg.should_cache
         cg.should_cache = True
         cg._tpu_precomputed = True
         logger.debug("cogroup %d precomputed on device (%d sources)",
                      cg.id, nsrc)
-        return cg, nparts
+        return cg, nparts, was_cached
 
     def _run_array_stage(self, stage, tasks, plan, report):
         kind, result = self.executor.run_stage(plan)

@@ -64,7 +64,6 @@ class DparkContext:
         self.options = options
         self.scheduler = None
         self.started = False
-        self._next_rdd_id = 0
         self.checkpoint_dir = None
         self._parallel = kw.get("parallel", options.parallel)
         DparkContext._active = self
@@ -133,13 +132,20 @@ class DparkContext:
         self.stop()
 
     # -- ids / config ----------------------------------------------------
-    _global_rdd_id = itertools.count(1)
+    # process-global, not per-context: the partition cache and HBM stores
+    # key by rdd id, and multiple contexts (e.g. streaming recovery)
+    # share those singletons in one process
+    _rdd_id_counter = [0]
 
     def new_rdd_id(self):
-        # process-global, not per-context: the partition cache and HBM
-        # stores key by rdd id, and multiple contexts (e.g. streaming
-        # recovery) share those singletons in one process
-        return next(DparkContext._global_rdd_id)
+        DparkContext._rdd_id_counter[0] += 1
+        return DparkContext._rdd_id_counter[0]
+
+    @classmethod
+    def advance_rdd_ids(cls, minimum):
+        """Recovery: never re-mint ids at or below a restored high-water
+        mark (checkpoint dirs are keyed rdd-<id> in a persistent dir)."""
+        cls._rdd_id_counter[0] = max(cls._rdd_id_counter[0], int(minimum))
 
     @property
     def default_parallelism(self):

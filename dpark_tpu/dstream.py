@@ -62,8 +62,13 @@ class StreamingContext:
 
     def _save_metadata(self, t):
         from dpark_tpu import serialize
+        from dpark_tpu.context import DparkContext
         from dpark_tpu.utils import atomic_file
         self.last_checkpoint_t = t
+        # persist the rdd-id high-water mark: checkpoint dirs are keyed
+        # rdd-<id> in a persistent dir, so a recovered process must not
+        # re-mint lower ids
+        self._rdd_id_hwm = DparkContext._rdd_id_counter[0]
         path = os.path.join(self.checkpoint_path, "metadata")
         with atomic_file(path) as f:
             f.write(serialize.dumps(self))
@@ -92,11 +97,38 @@ class StreamingContext:
         self.ctx = DparkContext(self._master)
         self.ctx.setCheckpointDir(directory)
         self.checkpoint_path = directory
+        DparkContext.advance_rdd_ids(getattr(self, "_rdd_id_hwm", 0))
+        self._recovered = True
         for stream in self._all_streams():
             stream.ssc = self
-            for rdd in list(stream.generated.values()):
-                if rdd is not None:
-                    _fix_rdd_ctx(rdd, self.ctx)
+            for rdd in self._stream_rdds(stream):
+                _fix_rdd_ctx(rdd, self.ctx)
+
+    @staticmethod
+    def _stream_rdds(stream):
+        """Every RDD a stream holds: generated batches plus RDDs embedded
+        in input streams (constant rdd, queued items, defaults)."""
+        out = [r for r in stream.generated.values() if r is not None]
+        for attr in ("rdd", "defaultRDD"):
+            r = getattr(stream, attr, None)
+            if hasattr(r, "dependencies"):
+                out.append(r)
+        for item in getattr(stream, "queue", []) or []:
+            if hasattr(item, "dependencies"):
+                out.append(item)
+        return out
+
+    def _rebase_timeline(self, new_zero):
+        """After recovery, restart the clock at `new_zero`: each stream's
+        latest checkpointed batch becomes the batch at new_zero so the
+        first new batch (new_zero + batch) finds its predecessor state."""
+        for stream in self._all_streams():
+            if stream.generated:
+                last_t = max(stream.generated)
+                last_rdd = stream.generated[last_t]
+                stream.generated = {round(new_zero, 6): last_rdd}
+        self.zero_time = new_zero
+        self._recovered = False
 
     def _all_streams(self):
         out = []
@@ -141,7 +173,13 @@ class StreamingContext:
         for ins in self.input_streams:
             ins.start()
         bd = self.batch_duration
-        if self.zero_time is None or t0 is not None:
+        if getattr(self, "_recovered", False):
+            # recovered context: restart the clock NOW, carrying each
+            # state stream's checkpointed batch over as the predecessor
+            # (no replay storm over the downtime gap)
+            now = t0 if t0 is not None else _time.time()
+            self._rebase_timeline(now - (now % bd))
+        elif self.zero_time is None or t0 is not None:
             now = t0 if t0 is not None else _time.time()
             self.zero_time = now - (now % bd)
         self._stopped.clear()

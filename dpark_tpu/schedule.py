@@ -256,16 +256,26 @@ class DAGScheduler:
         del self.history[:-100]
         return record
 
-    @staticmethod
-    def _check_speculation(running, pending_tasks, durations,
+    def max_concurrency(self):
+        """How many tasks can execute at once (None = unbounded/inline).
+        Speculation only considers tasks that are actually RUNNING, which
+        on a saturated pool means at most this many."""
+        return None
+
+    def _check_speculation(self, running, pending_tasks, durations,
                            submitted_at, speculated, spawn_duplicate):
         """Straggler re-launch (reference: dpark/job.py speculation)."""
         import time as _time
         now = _time.time()
+        cap = self.max_concurrency()
         for stage in list(running):
             pend = pending_tasks.get(stage)
             done = durations.get(stage.id, [])
             if not pend or not done:
+                continue
+            if cap is not None and len(pend) > cap:
+                # some pending tasks are still queue-waiting, not slow —
+                # their submit-time age would trigger mass duplicates
                 continue
             total = len(pend) + len(done)
             if len(done) / total < conf.SPECULATION_QUANTILE:
@@ -551,4 +561,7 @@ class MultiProcessScheduler(DAGScheduler):
                 callback=on_done, error_callback=on_error)
 
     def default_parallelism(self):
+        return self.num_workers
+
+    def max_concurrency(self):
         return self.num_workers

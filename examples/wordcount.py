@@ -5,13 +5,13 @@ Usage: python examples/wordcount.py <path> [-m local|process|tpu]
 
 import sys
 
-from dpark_tpu import DparkContext, parse_options
+from dpark_tpu import DparkContext
 
 
 def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("-")]
-    path = args[0] if args else __file__
-    options = parse_options()
+    from dpark_tpu import optParser
+    options, rest = optParser.parse_known_args()
+    path = rest[0] if rest else __file__
     ctx = DparkContext(options.master)
     counts = (ctx.textFile(path)
               .flatMap(lambda line: line.split())

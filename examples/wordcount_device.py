@@ -1,0 +1,48 @@
+"""Wordcount with string keys on the device (SURVEY.md 7.2 item 3).
+
+Strings cannot ride the TPU shuffle directly, so the host dictionary-
+encodes tokens to dense int64 ids with the C++ TokenDict
+(dpark_tpu/native), the device reduces ids columnar-ly, and the top
+results decode back to words.  Contrast with examples/wordcount.py,
+whose string path runs on the host object path.
+
+Usage: python examples/wordcount_device.py <path> [-m tpu]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from dpark_tpu import Columns, DparkContext
+from dpark_tpu.native import TokenDict
+
+
+def main():
+    from dpark_tpu import optParser
+    options, rest = optParser.parse_known_args()
+    path = rest[0] if rest else __file__
+    ctx = DparkContext(options.master or "tpu")
+
+    t0 = time.perf_counter()
+    d = TokenDict()
+    with open(path, "rb") as f:
+        ids = d.encode(f.read())
+    t_encode = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ones = np.ones(len(ids), dtype=np.int64)
+    counts = (ctx.parallelize(Columns(ids, ones))
+              .reduceByKey(lambda a, b: a + b))
+    top = counts.top(10, key=lambda kv: kv[1])
+    t_count = time.perf_counter() - t0
+
+    for tid, n in top:
+        print("%10d  %s" % (n, d.decode(int(tid))))
+    print("# %d tokens, %d distinct; encode %.3fs, count %.3fs"
+          % (len(ids), len(d), t_encode, t_count), file=sys.stderr)
+    ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -250,3 +250,34 @@ def test_checkpoint_recovery(ctx, tmp_path):
     ssc2.ctx.start()
     ssc2.run_batch(1003.0)                 # continues with queued batch 3
     assert dict(out2[-1][1]) == {"a": 7}   # 1+2 restored, +4
+
+
+def test_recovery_timeline_rebase(ctx, tmp_path):
+    """start() after recovery rebases the clock: no replay storm over the
+    downtime gap, state carried as the new predecessor batch."""
+    from dpark_tpu.dstream import StreamingContext
+    ckdir = str(tmp_path / "rebase_ck")
+    sink = []
+
+    def create():
+        ssc = StreamingContext(ctx, 1.0)
+        ssc.checkpoint_interval = 1
+        q = ssc.queueStream([[("k", 1)], [("k", 10)]])
+        q.updateStateByKey(
+            lambda vs, prev: sum(vs) + (prev or 0)).collect_batches(sink)
+        return ssc
+
+    ssc = StreamingContext.getOrCreate(ckdir, create)
+    ssc.ctx.start()
+    ssc.zero_time = 1000.0
+    ssc.run_batch(1001.0)
+    assert dict(sink[-1][1]) == {"k": 1}
+
+    ssc2 = StreamingContext.getOrCreate(ckdir, create)
+    assert getattr(ssc2, "_recovered", False)
+    ssc2.ctx.start()
+    ssc2._rebase_timeline(50000.0)       # hours later, new clock
+    ssc2.output_streams[0].func = lambda rdd, t: sink.append(
+        (t, rdd.collect()))
+    ssc2.run_batch(50001.0)
+    assert dict(sink[-1][1]) == {"k": 11}    # state carried across gap
