@@ -65,7 +65,7 @@ class TPUScheduler(DAGScheduler):
             # task would redo the whole stage
             try:
                 plan = fuse.analyze_stage(stage, self.executor.ndev,
-                                          self.executor.shuffle_store)
+                                          self.executor)
             except Exception as e:
                 logger.debug("analysis failed for %s: %s", stage, e)
         if plan is not None:

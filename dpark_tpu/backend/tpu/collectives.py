@@ -32,17 +32,23 @@ def _sentinel(dtype):
     return jnp.asarray(jnp.iinfo(dtype).max, dtype)
 
 
-def hash_dst(key, n_dst, valid):
-    """Destination partition by portable hash (HashPartitioner)."""
-    dst = (phash_device(key) % jnp.uint32(n_dst)).astype(jnp.int32)
+def hash_dst(key, n_dst, valid, r=None):
+    """Destination partition by portable hash (HashPartitioner).
+
+    `r` is the logical partition count (<= n_dst, the mesh size): dst in
+    [0, r), padding rows get the sentinel bucket n_dst; devices >= r
+    simply receive nothing."""
+    r = n_dst if r is None else r
+    dst = (phash_device(key) % jnp.uint32(r)).astype(jnp.int32)
     return jnp.where(valid, dst, n_dst)
 
 
-def range_dst(key, bounds, ascending, n_dst, valid):
+def range_dst(key, bounds, ascending, n_dst, valid, r=None):
     """Destination partition by sorted bounds (RangePartitioner): the
     device twin of host bisect_left over the sampled bounds."""
+    r = n_dst if r is None else r
     idx = jnp.searchsorted(bounds, key, side="left").astype(jnp.int32)
-    dst = idx if ascending else (n_dst - 1 - idx)
+    dst = idx if ascending else (r - 1 - idx)
     return jnp.where(valid, dst, n_dst)
 
 
@@ -78,7 +84,7 @@ def compact(leaves, mask):
     return list(sorted_ops[1:]), jnp.sum(mask).astype(jnp.int32)
 
 
-def bucketize(key, leaves, n, n_dst, dst=None):
+def bucketize(key, leaves, n, n_dst, dst=None, r=None):
     """Sort one device's rows by destination partition.
 
     Returns (sorted_leaves, counts[n_dst], offsets[n_dst]).  Invalid rows
@@ -87,7 +93,7 @@ def bucketize(key, leaves, n, n_dst, dst=None):
     cap = key.shape[0]
     valid = jnp.arange(cap) < n
     if dst is None:
-        dst = hash_dst(key, n_dst, valid)
+        dst = hash_dst(key, n_dst, valid, r)
     order = jnp.argsort(dst, stable=True)
     sorted_leaves = _take(leaves, order)
     counts = jnp.bincount(dst, length=n_dst + 1)[:n_dst].astype(jnp.int32)
@@ -185,7 +191,7 @@ def segmented_combine(starts, val_leaves, merge_leaves):
 
 
 def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves,
-                      monoid=None, dst=None):
+                      monoid=None, dst=None, r=None):
     """Map-side pre-combine (the classic combiner optimization): sort one
     device's rows by (destination, key), merge equal keys within each
     destination run, compact.  Cuts exchange volume to O(#distinct keys per
@@ -197,7 +203,7 @@ def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves,
     cap = key.shape[0]
     valid = jnp.arange(cap) < n
     if dst is None:
-        dst = hash_dst(key, n_dst, valid)
+        dst = hash_dst(key, n_dst, valid, r)
     k = jnp.where(valid, key, _sentinel(key.dtype))
     # one lexicographic (dst, key) sort carrying all value leaves
     sorted_ops = _lex_sort((dst, k) + tuple(val_leaves), 2)

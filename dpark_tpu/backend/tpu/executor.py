@@ -48,9 +48,15 @@ class JAXExecutor:
         self.mesh = layout.make_mesh(devices)
         self.ndev = int(self.mesh.devices.size)
         self.shuffle_store = {}       # sid -> stored map output metadata
-        self._store_order = []        # LRU for HBM eviction
         self._store_bytes = 0
+        self.result_cache = {}        # rdd id -> HBM-resident Batch meta
+        self._result_bytes = 0
+        self._hbm_seq = 0             # global LRU clock across both tiers
         self._compiled = {}
+        # let rdd.unpersist() reach device-resident caches
+        from dpark_tpu import cache as cache_mod
+        cache_mod.DEVICE_CACHES[id(self)] = self.drop_result
+        self._cache_key = id(self)
         # register the host bridge so file-path stages can read HBM shuffles
         from dpark_tpu import shuffle as shuffle_mod
         shuffle_mod.HBM_EXPORTERS[id(self)] = self.export_bucket
@@ -83,20 +89,23 @@ class JAXExecutor:
     @staticmethod
     def _epilogue_block(plan, lv, n, n_dst, merge_fn, monoid, bounds):
         """Shared shuffle-write tail: destination assignment (hash or
-        range bounds) + bucketize[-combine]."""
+        range bounds over the LOGICAL partition count r <= mesh size) +
+        bucketize[-combine]."""
         k = lv[0]
+        r = plan.epilogue[1].partitioner.num_partitions
         if plan.epi_spec is not None and plan.epi_spec[0] == "range":
             valid = jnp.arange(k.shape[0]) < n
             dst = collectives.range_dst(k, bounds, plan.epi_spec[1],
-                                        n_dst, valid)
+                                        n_dst, valid, r=r)
         else:
             dst = None
         if merge_fn is not None:
             k2, v2, cnts, offs = collectives.bucketize_combine(
-                k, lv[1:], n, n_dst, merge_fn, monoid=monoid, dst=dst)
+                k, lv[1:], n, n_dst, merge_fn, monoid=monoid, dst=dst,
+                r=r)
         else:
             sorted_lv, cnts, offs = collectives.bucketize(
-                k, lv, n, n_dst, dst=dst)
+                k, lv, n, n_dst, dst=dst, r=r)
             k2, v2 = sorted_lv[0], sorted_lv[1:]
         return (cnts, offs, k2) + tuple(v2)
 
@@ -240,13 +249,22 @@ class JAXExecutor:
         """Execute the whole stage for all partitions at once.
 
         Returns ("result", list_of_row_lists) or ("shuffle", sid)."""
-        if plan.source[0] == "ingest":
-            pc = plan.source[1]
-            # any shuffle write pads with the key sentinel; a real key
-            # equal to it must force the host path (silent-drop hazard)
-            key_leaf = 0 if plan.epilogue is not None else None
-            batch = layout.ingest(self.mesh, pc._slices, plan.in_treedef,
-                                  plan.in_specs, key_leaf=key_leaf)
+        if plan.source[0] in ("ingest", "cached"):
+            if plan.source[0] == "cached":
+                meta = self.result_cache[plan.source[1].id]
+                meta["seq"] = self._next_seq()       # LRU touch
+                batch = layout.Batch(meta["treedef"], meta["leaves"],
+                                     meta["counts"])
+                if plan.epilogue is not None:
+                    self._check_cached_keys(batch)
+            else:
+                pc = plan.source[1]
+                # any shuffle write pads with the key sentinel; a real
+                # key equal to it must force the host path
+                key_leaf = 0 if plan.epilogue is not None else None
+                batch = layout.ingest(self.mesh, pc._slices,
+                                      plan.in_treedef, plan.in_specs,
+                                      key_leaf=key_leaf)
             jitted = self._compile_narrow(plan, batch.cap, len(batch.cols))
             bounds = self._bounds_arg(plan)
             args = (batch.counts,) + ((bounds,) if bounds is not None
@@ -256,10 +274,67 @@ class JAXExecutor:
             outs = self._run_exchange_and_reduce(plan)
         return self._finish_stage(plan, outs)
 
+    # -- HBM result cache (rdd.cache() on the device path) --------------
+    def result_cache_ids(self):
+        return self.result_cache.keys()
+
+    def result_cache_meta(self, rdd_id):
+        return self.result_cache[rdd_id]
+
+    def _next_seq(self):
+        self._hbm_seq += 1
+        return self._hbm_seq
+
+    def store_result(self, rdd_id, batch):
+        if rdd_id in self.result_cache:
+            self.drop_result(rdd_id)        # re-store: no double count
+        nbytes = sum(int(l.nbytes) for l in batch.cols)
+        self.result_cache[rdd_id] = {
+            "treedef": batch.treedef, "leaves": batch.cols,
+            "counts": batch.counts, "nbytes": nbytes,
+            "seq": self._next_seq(),
+            "specs": [(np.dtype(l.dtype), tuple(l.shape[2:]))
+                      for l in batch.cols],
+        }
+        self._result_bytes += nbytes
+        self._evict_hbm(keep_rdd=rdd_id)
+
+    def drop_result(self, rdd_id):
+        meta = self.result_cache.pop(rdd_id, None)
+        if meta:
+            self._result_bytes -= meta["nbytes"]
+
+    def _evict_hbm(self, keep_sid=None, keep_rdd=None):
+        """One budget across BOTH HBM tiers (shuffle outputs + cached
+        results): evict the globally least-recently-used entry until under
+        conf.SHUFFLE_HBM_BUDGET.  Evicted shuffles recover via FetchFailed
+        lineage recomputation; evicted results recompute on next use."""
+        budget = conf.SHUFFLE_HBM_BUDGET
+        while self._store_bytes + self._result_bytes > budget:
+            cands = [(meta["seq"], "sid", sid)
+                     for sid, meta in self.shuffle_store.items()
+                     if sid != keep_sid]
+            cands += [(meta["seq"], "rdd", rid)
+                      for rid, meta in self.result_cache.items()
+                      if rid != keep_rdd]
+            if not cands:
+                break
+            _, kind, victim = min(cands)
+            if kind == "sid":
+                logger.debug("evicting HBM shuffle %d", victim)
+                self.drop_shuffle(victim)
+            else:
+                logger.debug("evicting HBM cached result %d", victim)
+                self.drop_result(victim)
+
     def _finish_stage(self, plan, outs):
         if plan.epilogue is None:
             counts, leaves = outs[0], list(outs[1:])
             batch = layout.Batch(plan.out_treedef, leaves, counts)
+            if plan.stage is not None \
+                    and getattr(plan.stage.rdd, "should_cache", False) \
+                    and not plan.group_output:
+                self.store_result(plan.stage.rdd.id, batch)
             rows_per_part = layout.egest(batch)
             if plan.group_output:
                 # bare groupByKey: rows arrive key-sorted; group runs
@@ -278,6 +353,8 @@ class JAXExecutor:
         leaves = list(outs[2:])
         sid = dep.shuffle_id
         nbytes = sum(int(l.nbytes) for l in leaves)
+        if sid in self.shuffle_store:
+            self.drop_shuffle(sid)          # re-run: no double count
         self.shuffle_store[sid] = {
             "leaves": leaves,            # (ndev, cap, ...) dst-sorted
             "counts": cnts,              # (ndev, R)
@@ -286,34 +363,16 @@ class JAXExecutor:
             "out_specs": plan.out_specs,
             "no_combine": fuse.is_list_agg(dep.aggregator),
             "nbytes": nbytes,
+            "seq": self._next_seq(),
         }
-        self._store_order.append(sid)
         self._store_bytes += nbytes
-        self._evict(keep=sid)
+        self._evict_hbm(keep_sid=sid)
         return ("shuffle", sid)
-
-    def _evict(self, keep):
-        """LRU-evict HBM shuffle outputs beyond conf.SHUFFLE_HBM_BUDGET.
-        An evicted shuffle still registered in the map-output tracker
-        surfaces as FetchFailed -> lineage recomputes the parent stage."""
-        budget = conf.SHUFFLE_HBM_BUDGET
-        while (self._store_bytes > budget and len(self._store_order) > 1):
-            victim = self._store_order[0]
-            if victim == keep:
-                break
-            self._store_order.pop(0)
-            store = self.shuffle_store.pop(victim, None)
-            if store:
-                self._store_bytes -= store["nbytes"]
-                logger.debug("evicted HBM shuffle %d (%d bytes)",
-                             victim, store["nbytes"])
 
     def _run_exchange_and_reduce(self, plan):
         dep = plan.source[1]
         store = self.shuffle_store[dep.shuffle_id]
-        if dep.shuffle_id in self._store_order:      # LRU touch
-            self._store_order.remove(dep.shuffle_id)
-            self._store_order.append(dep.shuffle_id)
+        store["seq"] = self._next_seq()              # LRU touch
         leaves = store["leaves"]
         counts = store["counts"]
         offsets = store["offsets"]
@@ -417,10 +476,29 @@ class JAXExecutor:
         store = self.shuffle_store.pop(sid, None)
         if store:
             self._store_bytes -= store["nbytes"]
-            if sid in self._store_order:
-                self._store_order.remove(sid)
+
+    @staticmethod
+    def _check_cached_keys(batch):
+        """Cached batches feeding a shuffle get the same sentinel guard as
+        ingest: a real key equal to the padding sentinel (or inf/nan)
+        would be silently dropped by the reduce — force host fallback."""
+        import jax.numpy as jnp
+        keys = batch.cols[0]
+        counts = batch.counts
+        valid = jnp.arange(keys.shape[1])[None, :] < counts[:, None]
+        if jnp.issubdtype(keys.dtype, jnp.floating):
+            bad = jnp.any(valid & (jnp.isinf(keys) | jnp.isnan(keys)))
+        else:
+            sent = jnp.iinfo(keys.dtype).max
+            bad = jnp.any(valid & (keys == sent))
+        if bool(jax.device_get(bad)):
+            raise ValueError("cached key equals the device sentinel; "
+                             "taking the host path")
 
     def stop(self):
+        from dpark_tpu import cache as cache_mod
         from dpark_tpu import shuffle as shuffle_mod
         shuffle_mod.HBM_EXPORTERS.pop(self._exporter_key, None)
+        cache_mod.DEVICE_CACHES.pop(self._cache_key, None)
         self.shuffle_store.clear()
+        self.result_cache.clear()

@@ -230,16 +230,20 @@ def _keyby_as_record_fn(f):
     return fn
 
 
-def extract_chain(top):
+def extract_chain(top, cached_ids=()):
     """Walk narrow one-parent links from the stage's top RDD to its source.
     Returns (source_rdd, ops list root->top, passthrough) or None.
     `passthrough` is True when the chain unwrapped partitionBy's
     FlatMappedValues(identity) over a no-combine shuffle (rows stay flat
-    (k, v) on device; no lists ever exist)."""
+    (k, v) on device; no lists ever exist).  A chain node whose batch is
+    HBM-cached terminates the walk (source = that node)."""
     ops = []
     cur = top
     passthrough = False
     while True:
+        if cur.id in cached_ids:
+            ops.reverse()
+            return cur, ops, passthrough
         if isinstance(cur, FlatMappedValuesRDD) and cur.f is _identity \
                 and isinstance(cur.prev, ShuffledRDD) \
                 and is_list_agg(cur.prev.aggregator):
@@ -308,21 +312,30 @@ def _numeric_key(specs):
     return shape == () and dt.kind in "if"
 
 
-def analyze_stage(stage, ndev, hbm_sids):
+def analyze_stage(stage, ndev, executor_or_store):
     """Decide whether `stage` can run on the array path; build its plan.
 
-    hbm_sids: dict of shuffle ids whose map outputs are HBM-resident.
-    Returns StagePlan or None (host fallback).
+    executor_or_store: the JAXExecutor (HBM shuffle store + result cache)
+    or a bare shuffle-store dict.  Returns StagePlan or None (fallback).
     """
+    hbm_sids = getattr(executor_or_store, "shuffle_store",
+                       executor_or_store)
+    cached_ids = getattr(executor_or_store, "result_cache_ids",
+                         lambda: ())()
     top = stage.rdd
-    extracted = extract_chain(top)
+    extracted = extract_chain(top, cached_ids)
     if extracted is None:
         return None
     source_rdd, ops, passthrough = extracted
     group_output = False
 
     # -- source record spec ---------------------------------------------
-    if isinstance(source_rdd, ParallelCollection):
+    if source_rdd.id in cached_ids:
+        meta = executor_or_store.result_cache_meta(source_rdd.id)
+        treedef, specs = meta["treedef"], meta["specs"]
+        source = ("cached", source_rdd)
+        src_combine = False
+    elif isinstance(source_rdd, ParallelCollection):
         if source_rdd._slices is None or len(source_rdd._slices) != ndev:
             return None
         sample = _sample_record(source_rdd)
@@ -341,8 +354,8 @@ def analyze_stage(stage, ndev, hbm_sids):
         dep = source_rdd.dep
         if dep.shuffle_id not in hbm_sids:
             return None                  # parent shuffle lives on host
-        if dep.partitioner.num_partitions != ndev:
-            return None
+        if dep.partitioner.num_partitions > ndev:
+            return None                  # R <= ndev: extra devices idle
         # record spec of the stored rows — registered when the map ran
         meta = hbm_sids[dep.shuffle_id]
         treedef, specs = meta["out_treedef"], meta["out_specs"]
@@ -385,8 +398,8 @@ def analyze_stage(stage, ndev, hbm_sids):
     epi_bounds = None
     if stage.is_shuffle_map:
         dep = stage.shuffle_dep
-        if dep.partitioner.num_partitions != ndev:
-            return None
+        if dep.partitioner.num_partitions > ndev:
+            return None                  # R <= ndev: extra devices idle
         epi_spec = partitioner_spec(dep.partitioner)
         if epi_spec is None:
             return None

@@ -15,6 +15,10 @@ import threading
 
 from dpark_tpu.utils import atomic_file, compress, decompress
 
+# device-resident caches register an eviction callback here so
+# rdd.unpersist() reaches HBM as well as the host tiers
+DEVICE_CACHES = {}
+
 
 class Cache:
     def __init__(self, workdir):

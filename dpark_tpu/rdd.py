@@ -314,6 +314,8 @@ class RDD:
         from dpark_tpu.env import env
         if env.cache is not None and self._splits is not None:
             env.cache.drop(self.id, len(self._splits))
+        for drop in list(_cache.DEVICE_CACHES.values()):
+            drop(self.id)
         return self
 
     def checkpoint(self, path=None):

@@ -1,0 +1,57 @@
+"""k-means (reference: examples/kmeans.py), written jnp-first: the
+assignment map is jnp-traceable, so on the tpu master each iteration's
+assign+partial-sum runs as one fused device program over the mesh.
+
+Usage: python examples/kmeans.py [-m local|process|tpu] [-k K]
+"""
+
+import random
+import sys
+
+from dpark_tpu import DparkContext, optParser
+
+
+def make_assign(centers):
+    import jax.numpy as jnp
+    cx = jnp.asarray([c[0] for c in centers])
+    cy = jnp.asarray([c[1] for c in centers])
+
+    def assign(p):
+        x, y = p
+        d = (x - cx) ** 2 + (y - cy) ** 2
+        k = jnp.argmin(d)
+        return (k, (x, y, 1))
+    return assign
+
+
+def merge(a, b):
+    return (a[0] + b[0], a[1] + b[1], a[2] + b[2])
+
+
+def main():
+    optParser.add_argument("-k", "--clusters", type=int, default=4)
+    options, _ = optParser.parse_known_args()
+    ctx = DparkContext(options.master)
+    k = options.clusters
+
+    rng = random.Random(7)
+    true_centers = [(0.0, 0.0), (10.0, 0.0), (0.0, 10.0), (10.0, 10.0)]
+    points = [(tc[0] + rng.gauss(0, 1.0), tc[1] + rng.gauss(0, 1.0))
+              for _ in range(5000) for tc in true_centers[:k]]
+    rdd = ctx.parallelize(points).cache()
+
+    centers = points[:k]
+    for it in range(8):
+        stats = dict(rdd.map(make_assign(centers))
+                     .reduceByKey(merge, k).collect())
+        centers = [
+            (float(sx) / n, float(sy) / n)
+            for ki, (sx, sy, n) in sorted(
+                (int(kk), vv) for kk, vv in stats.items())]
+        print("iter %d: %s" % (it, [(round(x, 2), round(y, 2))
+                                    for x, y in centers]))
+    ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Logistic regression by gradient descent (reference: examples/lr.py),
+jnp-first so each iteration's gradient map+sum fuses on the tpu master.
+
+Usage: python examples/logistic_regression.py [-m local|process|tpu]
+"""
+
+import random
+
+from dpark_tpu import DparkContext, optParser
+
+
+def make_grad(w0, w1, b):
+    import jax.numpy as jnp
+
+    def grad(row):
+        x0, x1, label = row
+        z = w0 * x0 + w1 * x1 + b
+        p = 1.0 / (1.0 + jnp.exp(-z))
+        err = p - label
+        # key 0: single global reduce of the gradient triple
+        return (0, (err * x0, err * x1, err))
+    return grad
+
+
+def add3(a, b):
+    return (a[0] + b[0], a[1] + b[1], a[2] + b[2])
+
+
+def main():
+    options, _ = optParser.parse_known_args()
+    ctx = DparkContext(options.master)
+
+    rng = random.Random(3)
+    data = []
+    for _ in range(20000):
+        x0, x1 = rng.gauss(0, 1), rng.gauss(0, 1)
+        label = 1.0 if 2 * x0 - x1 + 0.5 + rng.gauss(0, 0.3) > 0 else 0.0
+        data.append((x0, x1, label))
+    rdd = ctx.parallelize(data).cache()
+    n = float(len(data))
+
+    w0 = w1 = b = 0.0
+    lr = 2.0
+    for it in range(15):
+        (_, (g0, g1, gb)), = rdd.map(make_grad(w0, w1, b)) \
+                                .reduceByKey(add3, 1).collect()
+        w0 -= lr * float(g0) / n
+        w1 -= lr * float(g1) / n
+        b -= lr * float(gb) / n
+    print("weights: w0=%.3f w1=%.3f b=%.3f (true direction 2,-1,0.5)"
+          % (w0, w1, b))
+    correct = rdd.filter(
+        lambda row: (2 * row[0] - row[1] + 0.5 > 0) == (row[2] > 0.5)
+    ).count()
+    print("consistency with true boundary: %.1f%%" % (100 * correct / n))
+    ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

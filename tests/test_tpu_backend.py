@@ -300,3 +300,69 @@ def test_join_device_exchange_matches_local(tctx):
     expect = sorted(lctx.parallelize(a_pairs, 8)
                     .join(lctx.parallelize(b_pairs, 8), 8).collect())
     assert got == expect
+
+
+def test_hbm_result_cache(tctx):
+    """A cached device result is reused: the second action consumes the
+    HBM batch instead of re-ingesting, and downstream stages chain off
+    it."""
+    pairs = [(i % 9, 1) for i in range(900)]
+    r = tctx.parallelize(pairs, 8).reduceByKey(lambda a, b: a + b, 8) \
+            .cache()
+    assert dict(r.collect()) == {k: 100 for k in range(9)}
+    ex = tctx.scheduler.executor
+    assert r.id in set(ex.result_cache_ids())
+    # downstream of the cached batch
+    doubled = dict(r.map(lambda kv: (kv[0], kv[1] * 2)).collect())
+    assert doubled == {k: 200 for k in range(9)}
+    assert r.count() == 9
+    r.unpersist()
+    assert r.id not in set(ex.result_cache_ids())
+    # still correct after unpersist (recompute)
+    assert r.count() == 9
+
+
+def test_fewer_reduce_partitions_than_devices(tctx):
+    """R < ndev rides the mesh (extra devices idle), exact results."""
+    pairs = [(i % 6, 1) for i in range(600)]
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 3).collect())
+    assert got == {k: 100 for k in range(6)}
+    assert _used_array_path(tctx)
+    srt = tctx.parallelize([(9 - i, i) for i in range(10)] * 10, 8) \
+              .sortByKey(numSplits=4).collect()
+    assert [k for k, _ in srt] == sorted(k for k in
+                                         [9 - i for i in range(10)] * 10)
+
+
+def test_cached_sentinel_key_falls_back(tctx):
+    """A cached RDD containing the sentinel key still shuffles correctly
+    (host path), not silently dropping the row."""
+    pairs = [(2**63 - 1, 1), (5, 1)] * 4
+    r = tctx.parallelize(pairs, 8).cache()
+    assert sorted(r.collect()) == sorted(pairs)
+    got = dict(r.reduceByKey(lambda a, b: a + b, 8).collect())
+    assert got == {2**63 - 1: 4, 5: 4}
+
+
+def test_hbm_budget_shared_across_tiers(tctx):
+    from dpark_tpu import conf
+    ex = tctx.scheduler.executor
+    old = conf.SHUFFLE_HBM_BUDGET
+    conf.SHUFFLE_HBM_BUDGET = 1
+    try:
+        r1 = tctx.parallelize([(i % 4, 1) for i in range(400)], 8) \
+                 .reduceByKey(lambda a, b: a + b, 8).cache()
+        assert dict(r1.collect()) == {k: 100 for k in range(4)}
+        r2 = tctx.parallelize([(i % 2, 1) for i in range(100)], 8) \
+                 .reduceByKey(lambda a, b: a + b, 8).cache()
+        assert dict(r2.collect()) == {0: 50, 1: 50}
+        total = ex._store_bytes + ex._result_bytes
+        # over-budget entries were evicted down to the newest survivors
+        assert len(ex.shuffle_store) + len(ex.result_cache) <= 2
+        # double-collect must not double-count bytes
+        before = ex._result_bytes
+        assert dict(r2.collect()) == {0: 50, 1: 50}
+        assert ex._result_bytes == before
+    finally:
+        conf.SHUFFLE_HBM_BUDGET = old
