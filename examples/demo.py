@@ -1,0 +1,64 @@
+"""API tour (reference: examples/demo.py) — one small example per major
+capability, runnable on any master.
+
+Usage: python examples/demo.py [-m local|process|tpu]
+"""
+
+import operator
+import os
+import tempfile
+
+from dpark_tpu import DparkContext, optParser
+
+
+def main():
+    options, _ = optParser.parse_known_args()
+    ctx = DparkContext(options.master)
+
+    # transformations + actions
+    nums = ctx.parallelize(range(100), 4)
+    print("sum:", nums.reduce(operator.add))
+    print("evens:", nums.filter(lambda x: x % 2 == 0).count())
+    print("squares:", nums.map(lambda x: x * x).take(5))
+
+    # key/value: shuffle, join, sort
+    pairs = ctx.parallelize([(i % 5, i) for i in range(50)], 4)
+    print("reduceByKey:", sorted(pairs.reduceByKey(operator.add)
+                                 .collect()))
+    names = ctx.parallelize([(k, "g%d" % k) for k in range(5)], 2)
+    print("join sample:", sorted(pairs.join(names).collect())[:3])
+    print("sorted keys:", [k for k, _ in
+                           pairs.sortByKey(numSplits=3).collect()][:10])
+
+    # accumulators + broadcast
+    acc = ctx.accumulator(0)
+    lookup = ctx.broadcast({i: i * 10 for i in range(5)})
+    out = pairs.map(lambda kv: (acc.add(1), lookup.value[kv[0]])[1]) \
+               .collect()
+    print("accumulated %d tasks-worth of records; first mapped: %s"
+          % (acc.value, out[:3]))
+
+    # caching + checkpoint
+    cached = nums.map(lambda x: x + 1).cache()
+    cached.count()
+    print("cached re-count:", cached.count())
+
+    # text IO round-trip
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "out")
+        ctx.parallelize(["line %d" % i for i in range(10)], 2) \
+           .saveAsTextFile(path)
+        print("text round-trip:", ctx.textFile(path).count())
+
+    # table DSL
+    t = ctx.parallelize([("north", 3, 1.5), ("south", 5, 1.4),
+                         ("north", 2, 2.0)], 2) \
+           .asTable("region qty price", name="sales")
+    for row in t.groupBy("region", "sum(qty) as total").collect():
+        print("table:", row.region, row.total)
+
+    ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Parity fuzzer (SURVEY.md 7.1 step 11): random RDD programs must
+produce identical results on the tpu master and the local master — the
+local master is the golden model, whatever path (array or object) the
+tpu master picks per stage."""
+
+import operator
+import random
+
+import pytest
+
+
+OPS = ["map_affine", "filter_mod", "map_swap", "reduce_sum", "reduce_min",
+       "reduce_max", "group", "sort", "distinct_keys", "count_tail"]
+
+
+def build_program(rng, depth=4):
+    """A random pipeline as a list of (op, params); applied identically
+    to both contexts."""
+    prog = []
+    shuffled = False
+    for _ in range(depth):
+        op = rng.choice(OPS)
+        if op == "map_affine":
+            prog.append(("map_affine", rng.randint(1, 5),
+                         rng.randint(-10, 10)))
+        elif op == "filter_mod":
+            prog.append(("filter_mod", rng.randint(2, 5),
+                         rng.randint(0, 1)))
+        elif op == "map_swap":
+            prog.append(("map_swap", rng.randint(1, 7)))
+        elif op in ("reduce_sum", "reduce_min", "reduce_max", "group",
+                    "sort", "distinct_keys"):
+            if shuffled and rng.random() < 0.5:
+                continue                 # limit chained shuffles a bit
+            prog.append((op, rng.choice([2, 4, 8])))
+            shuffled = True
+    if not prog:
+        prog = [("map_affine", 2, 1)]
+    return prog
+
+
+def apply_program(ctx, data, prog):
+    r = ctx.parallelize(data, 8)
+    for step in prog:
+        op = step[0]
+        if op == "map_affine":
+            _, a, b = step
+            r = r.map(lambda kv, a=a, b=b: (kv[0], kv[1] * a + b))
+        elif op == "filter_mod":
+            _, m, want = step
+            r = r.filter(lambda kv, m=m, w=want: kv[0] % m == w)
+        elif op == "map_swap":
+            _, m = step
+            r = r.map(lambda kv, m=m: (kv[1] % m, kv[0]))
+        elif op == "reduce_sum":
+            r = r.reduceByKey(operator.add, step[1])
+        elif op == "reduce_min":
+            r = r.reduceByKey(lambda a, b: a if a < b else b, step[1])
+        elif op == "reduce_max":
+            r = r.reduceByKey(lambda a, b: a if a > b else b, step[1])
+        elif op == "group":
+            r = r.groupByKey(step[1]) \
+                 .mapValue(lambda vs: sum(vs) if isinstance(vs, list)
+                           else vs)
+        elif op == "sort":
+            r = r.sortByKey(numSplits=step[1])
+        elif op == "distinct_keys":
+            r = r.map(lambda kv: (kv[0], 0)).reduceByKey(
+                lambda a, b: 0, step[1])
+    return r
+
+
+def canonical(rows):
+    return sorted((int(k), int(v)) for k, v in rows)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_random_program_parity(seed):
+    from dpark_tpu import DparkContext
+    rng = random.Random(seed)
+    n = rng.choice([100, 1000, 4096])
+    kspace = rng.choice([3, 17, 256, 10_000])
+    data = [(rng.randint(-kspace, kspace), rng.randint(-1000, 1000))
+            for _ in range(n)]
+    prog = build_program(rng)
+
+    tctx = DparkContext("tpu")
+    lctx = DparkContext("local")
+    try:
+        got = canonical(apply_program(tctx, data, prog).collect())
+        expect = canonical(apply_program(lctx, data, prog).collect())
+        assert got == expect, "parity violation for program %r" % (prog,)
+    finally:
+        tctx.stop()
+        lctx.stop()

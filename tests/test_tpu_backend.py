@@ -366,3 +366,25 @@ def test_hbm_budget_shared_across_tiers(tctx):
         assert ex._result_bytes == before
     finally:
         conf.SHUFFLE_HBM_BUDGET = old
+
+
+def test_dstream_batches_reuse_compiled_programs(tctx):
+    """Per-batch jobs hit the structural jit cache: after batch 1, later
+    batches compile nothing new (SURVEY.md 7.2 item 5)."""
+    import operator
+    from dpark_tpu.dstream import StreamingContext
+    ssc = StreamingContext(tctx, 1.0)
+    out = []
+    batches = [[(i % 5, 1) for i in range(64)] for _ in range(4)]
+    q = ssc.queueStream(batches)
+    q.reduceByKey(operator.add, 8).collect_batches(out)
+    tctx.start()
+    ssc.zero_time = 0.0
+    ssc.run_batch(1.0)
+    compiled_after_first = len(tctx.scheduler.executor._compiled)
+    for k in (2, 3, 4):
+        ssc.run_batch(float(k))
+    assert len(out) == 4
+    expect = {j: 13 if j < 4 else 12 for j in range(5)}
+    assert all(dict(v) == expect for _, v in out)
+    assert len(tctx.scheduler.executor._compiled) == compiled_after_first
