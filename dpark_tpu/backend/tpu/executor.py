@@ -249,6 +249,8 @@ class JAXExecutor:
         """Execute the whole stage for all partitions at once.
 
         Returns ("result", list_of_row_lists) or ("shuffle", sid)."""
+        if plan.source[0] == "ingest" and self._should_stream(plan):
+            return self._run_streamed_shuffle(plan)
         if plan.source[0] in ("ingest", "cached"):
             if plan.source[0] == "cached":
                 meta = self.result_cache[plan.source[1].id]
@@ -265,14 +267,28 @@ class JAXExecutor:
                 batch = layout.ingest(self.mesh, pc._slices,
                                       plan.in_treedef, plan.in_specs,
                                       key_leaf=key_leaf)
-            jitted = self._compile_narrow(plan, batch.cap, len(batch.cols))
-            bounds = self._bounds_arg(plan)
-            args = (batch.counts,) + ((bounds,) if bounds is not None
-                                      else ()) + tuple(batch.cols)
-            outs = jitted(*args)
+            outs = self._run_narrow(plan, batch)
+        elif plan.source[0] == "hbm" and self.shuffle_store[
+                plan.source[1].shuffle_id].get("pre_reduced"):
+            # streamed shuffle already exchanged+combined: device d holds
+            # reduce partition d — just run the stage's narrow tail
+            store = self.shuffle_store[plan.source[1].shuffle_id]
+            store["seq"] = self._next_seq()
+            batch = layout.Batch(store["out_treedef"], store["leaves"],
+                                 store["counts"])
+            outs = self._run_narrow(plan, batch)
         else:
             outs = self._run_exchange_and_reduce(plan)
         return self._finish_stage(plan, outs)
+
+    def _run_narrow(self, plan, batch, bounds=None):
+        """Compile + invoke the narrow stage program on one batch."""
+        jitted = self._compile_narrow(plan, batch.cap, len(batch.cols))
+        if bounds is None:
+            bounds = self._bounds_arg(plan)
+        args = (batch.counts,) + ((bounds,) if bounds is not None
+                                  else ()) + tuple(batch.cols)
+        return jitted(*args)
 
     # -- HBM result cache (rdd.cache() on the device path) --------------
     def result_cache_ids(self):
@@ -374,21 +390,95 @@ class JAXExecutor:
         store = self.shuffle_store[dep.shuffle_id]
         store["seq"] = self._next_seq()              # LRU touch
         leaves = store["leaves"]
-        counts = store["counts"]
-        offsets = store["offsets"]
+        nleaves = len(leaves)
+        recv_rounds, cnt_rounds, slot = self._exchange_all(
+            leaves, store["counts"], store["offsets"])
+        rounds = len(recv_rounds)
+        reduce_fn = self._compile_reduce(plan, rounds, slot, nleaves)
+        bounds = self._bounds_arg(plan)
+        args = ([bounds] if bounds is not None else []) + list(cnt_rounds)
+        for r in range(rounds):
+            args.extend(recv_rounds[r])
+        return reduce_fn(*args)
+
+    # ------------------------------------------------------------------
+    # out-of-core streaming shuffle (SURVEY.md 7.2 item 4): monoid
+    # reduces over columnar input bigger than a chunk run in
+    # ingest -> combine -> exchange -> merge-into-state waves, so HBM
+    # holds one chunk + the combined state instead of the whole dataset
+    # ------------------------------------------------------------------
+    def _should_stream(self, plan):
+        if plan.epilogue is None:
+            return False
+        from dpark_tpu.rdd import _ColumnarSlice
+        slices = plan.source[1]._slices
+        if not all(isinstance(s, _ColumnarSlice) for s in slices):
+            return False
+        if max((len(s) for s in slices), default=0) \
+                <= conf.STREAM_CHUNK_ROWS:
+            return False
+        dep = plan.epilogue[1]
+        if fuse.is_list_agg(dep.aggregator):
+            return False                # repartition can't shrink: no win
+        monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
+        return monoid is not None
+
+    def _run_streamed_shuffle(self, plan):
+        from dpark_tpu.rdd import _ColumnarSlice
+        dep = plan.epilogue[1]
+        monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
+        merge_fn = fuse._leaves_merge_fn(
+            dep.aggregator.merge_combiners, len(plan.out_specs) - 1)
+        slices = plan.source[1]._slices
+        chunk = conf.STREAM_CHUNK_ROWS
+        nchunks = (max(len(s) for s in slices) + chunk - 1) // chunk
+        state = None                    # (leaves, counts) combined so far
+        bounds = self._bounds_arg(plan)      # loop-invariant
+        for c in range(nchunks):
+            parts = [
+                _ColumnarSlice([col[c * chunk:(c + 1) * chunk]
+                                for col in s.columns])
+                for s in slices]
+            batch = layout.ingest(self.mesh, parts, plan.in_treedef,
+                                  plan.in_specs, key_leaf=0)
+            outs = self._run_narrow(plan, batch, bounds=bounds)
+            cnts, offs = outs[0], outs[1]
+            leaves = list(outs[2:])
+            recv = self._exchange_all(leaves, cnts, offs)
+            state = self._merge_into_state(plan, state, recv, merge_fn,
+                                           monoid)
+            logger.debug("streamed chunk %d/%d", c + 1, nchunks)
+        leaves, counts = state
+        sid = dep.shuffle_id
+        if sid in self.shuffle_store:
+            self.drop_shuffle(sid)
+        nbytes = sum(int(l.nbytes) for l in leaves)
+        self.shuffle_store[sid] = {
+            "leaves": leaves, "counts": counts,
+            "pre_reduced": True,        # device d holds reduce part d
+            "out_treedef": plan.out_treedef,
+            "out_specs": plan.out_specs,
+            "no_combine": False,
+            "nbytes": nbytes, "seq": self._next_seq(),
+        }
+        self._store_bytes += nbytes
+        self._evict_hbm(keep_sid=sid)
+        return ("shuffle", sid)
+
+    def _exchange_all(self, leaves, counts, offsets):
+        """Run exchange rounds for already-bucketized buffers; returns
+        (recv_rounds, cnt_rounds, slot)."""
         nleaves = len(leaves)
         cap = leaves[0].shape[1]
-        # slot sizing: 2x the mean per-(src,dst) volume, clamped to the max
-        # run length; skewed keys overflow into extra rounds
         host_counts = np.asarray(jax.device_get(counts))
         max_run = int(host_counts.max()) if host_counts.size else 1
         mean = int(host_counts.sum()) // max(1, host_counts.size)
-        slot = layout.round_capacity(min(max(64, 2 * mean), max(1, max_run)))
+        slot = layout.round_capacity(min(max(64, 2 * mean),
+                                         max(1, max_run)))
         exchange = self._compile_exchange(
             tuple(str(l.dtype) for l in leaves), nleaves, slot, cap)
-        sharding = self._sharding()
         sent = jax.device_put(
-            np.zeros((self.ndev, self.ndev), np.int32), sharding)
+            np.zeros((self.ndev, self.ndev), np.int32), self._sharding())
         recv_rounds, cnt_rounds = [], []
         while True:
             outs = exchange(offsets, counts, sent, *leaves)
@@ -399,13 +489,69 @@ class JAXExecutor:
                 break
             if len(recv_rounds) > 512:
                 raise RuntimeError("shuffle exchange did not converge")
+        return recv_rounds, cnt_rounds, slot
+
+    def _merge_into_state(self, plan, state, recv, merge_fn, monoid):
+        """Combine received rows (and the running state) into the new
+        per-device unique-key state."""
+        recv_rounds, cnt_rounds, slot = recv
         rounds = len(recv_rounds)
-        reduce_fn = self._compile_reduce(plan, rounds, slot, nleaves)
-        bounds = self._bounds_arg(plan)
-        args = ([bounds] if bounds is not None else []) + list(cnt_rounds)
+        nleaves = len(recv_rounds[0])
+        has_state = state is not None
+        state_cap = state[0][0].shape[1] if has_state else 0
+        key = ("stream_merge", plan.program_key, rounds, slot, nleaves,
+               state_cap)
+        if key not in self._compiled:
+            def per_device(*args):
+                i = 0
+                if has_state:
+                    st_leaves = [a[0] for a in args[:nleaves]]
+                    st_n = args[nleaves][0]
+                    i = nleaves + 1
+                cnts = [c[0] for c in args[i:i + rounds]]
+                bufs = args[i + rounds:]
+                recvs = []
+                for r in range(rounds):
+                    recvs.append([bufs[r * nleaves + li][0]
+                                  for li in range(nleaves)])
+                flat, mask = collectives.flatten_received(recvs, cnts)
+                if has_state:
+                    stv = jnp.arange(state_cap) < st_n
+                    kcol = jnp.where(
+                        stv, st_leaves[0],
+                        collectives._sentinel(st_leaves[0].dtype))
+                    flat = [jnp.concatenate([kcol, flat[0]])] + [
+                        jnp.concatenate([sl, fl])
+                        for sl, fl in zip(st_leaves[1:], flat[1:])]
+                    mask = jnp.concatenate([stv, mask])
+                k, vs, n = collectives.segment_reduce(
+                    flat[0], flat[1:], mask, merge_fn, monoid=monoid)
+                out = (jnp.expand_dims(n, 0),
+                       jnp.expand_dims(k, 0)) + tuple(
+                    jnp.expand_dims(v, 0) for v in vs)
+                return out
+
+            n_in = (nleaves + 1 if has_state else 0) \
+                + rounds + rounds * nleaves
+            fn = _shard_map(per_device, self.mesh,
+                            in_specs=(P(AXIS),) * n_in,
+                            out_specs=(P(AXIS),) * (1 + nleaves))
+            self._compiled[key] = jax.jit(fn)
+        args = []
+        if has_state:
+            args.extend(state[0])
+            args.append(state[1])
+        args.extend(cnt_rounds)
         for r in range(rounds):
             args.extend(recv_rounds[r])
-        return reduce_fn(*args)
+        outs = self._compiled[key](*args)
+        counts, leaves = outs[0], list(outs[1:])
+        # shrink to the next size class to bound state growth
+        host_n = int(np.asarray(jax.device_get(counts)).max() or 1)
+        want_cap = layout.round_capacity(host_n)
+        if leaves[0].shape[1] > want_cap:
+            leaves = [l[:, :want_cap] for l in leaves]
+        return (leaves, counts)
 
     # ------------------------------------------------------------------
     # cogroup support: exchange one dep's rows to their reduce partitions
@@ -450,6 +596,20 @@ class JAXExecutor:
         store = self.shuffle_store.get(sid)
         if store is None:
             raise KeyError("no HBM shuffle %d" % sid)
+        if store.get("pre_reduced"):
+            # device d holds reduce partition d fully combined: expose it
+            # as map 0's bucket (other maps contribute nothing)
+            if map_id != 0:
+                return []
+            counts = np.asarray(jax.device_get(store["counts"]))
+            cnt = int(counts[reduce_id])
+            mats = [np.asarray(jax.device_get(
+                lax.slice_in_dim(l, reduce_id, reduce_id + 1, axis=0)
+            ))[0, :cnt] for l in store["leaves"]]
+            lists = [m.tolist() for m in mats]
+            treedef = store["out_treedef"]
+            return [jax.tree_util.tree_unflatten(
+                treedef, [pl[i] for pl in lists]) for i in range(cnt)]
         counts = np.asarray(jax.device_get(store["counts"]))
         offsets = np.asarray(jax.device_get(store["offsets"]))
         off = int(offsets[map_id, reduce_id])

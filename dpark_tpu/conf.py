@@ -57,6 +57,12 @@ BUCKET_PAD_GRANULARITY = 1024
 # chunked multi-round path kicks in (the "external merge" equivalent).
 SHUFFLE_HBM_BUDGET = 2 << 30
 
+# out-of-core streaming: a monoid reduce over columnar input larger than
+# this many rows per device runs in ingest->combine->exchange waves, so
+# the working set in HBM is one chunk plus the combined state (the >HBM
+# pipeline of SURVEY.md 7.2 item 4)
+STREAM_CHUNK_ROWS = 4 << 20
+
 # default dtype for device-side values
 DEFAULT_DTYPE = "int32"
 

@@ -388,3 +388,50 @@ def test_dstream_batches_reuse_compiled_programs(tctx):
     expect = {j: 13 if j < 4 else 12 for j in range(5)}
     assert all(dict(v) == expect for _, v in out)
     assert len(tctx.scheduler.executor._compiled) == compiled_after_first
+
+
+def test_streamed_shuffle_out_of_core(tctx):
+    """Columnar input above the chunk threshold reduces in waves; result
+    identical to the in-core path."""
+    import numpy as np
+    from dpark_tpu import Columns, conf
+    old = conf.STREAM_CHUNK_ROWS
+    conf.STREAM_CHUNK_ROWS = 1000          # force ~4 waves
+    try:
+        n = 60_000
+        i = np.arange(n, dtype=np.int64)
+        keys = (i * 2654435761) % 37
+        vals = np.ones(n, dtype=np.int64)
+        got = dict(tctx.parallelize(Columns(keys, vals), 8)
+                   .reduceByKey(lambda a, b: a + b, 8).collect())
+        expect = {}
+        for k in np.unique(keys):
+            expect[int(k)] = int((keys == k).sum())
+        assert got == expect
+        sid = list(tctx.scheduler.executor.shuffle_store)[-1]
+        assert tctx.scheduler.executor.shuffle_store[sid].get(
+            "pre_reduced")
+    finally:
+        conf.STREAM_CHUNK_ROWS = old
+
+
+def test_streamed_shuffle_bridge_to_host(tctx):
+    """A host-path stage downstream of a streamed shuffle reads the
+    pre-reduced state through the export bridge."""
+    import numpy as np
+    from dpark_tpu import Columns, conf
+    old = conf.STREAM_CHUNK_ROWS
+    conf.STREAM_CHUNK_ROWS = 500
+    try:
+        n = 4_000
+        i = np.arange(n, dtype=np.int64)
+        keys = i % 11
+        vals = np.ones(n, dtype=np.int64)
+        r = tctx.parallelize(Columns(keys, vals), 8) \
+                .reduceByKey(lambda a, b: a + b, 8) \
+                .mapPartitions(lambda it: [sorted(it)])
+        flat = [kv for part in r.collect() for kv in part]
+        assert dict(flat) == {k: n // 11 + (1 if k < n % 11 else 0)
+                              for k in range(11)}
+    finally:
+        conf.STREAM_CHUNK_ROWS = old
