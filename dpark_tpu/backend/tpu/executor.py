@@ -367,21 +367,25 @@ class JAXExecutor:
         dep = plan.epilogue[1]
         cnts, offs = outs[0], outs[1]
         leaves = list(outs[2:])
-        sid = dep.shuffle_id
-        nbytes = sum(int(l.nbytes) for l in leaves)
-        if sid in self.shuffle_store:
-            self.drop_shuffle(sid)          # re-run: no double count
-        self.shuffle_store[sid] = {
+        return self._register_shuffle(dep, plan, {
             "leaves": leaves,            # (ndev, cap, ...) dst-sorted
             "counts": cnts,              # (ndev, R)
             "offsets": offs,             # (ndev, R)
-            "out_treedef": plan.out_treedef,
-            "out_specs": plan.out_specs,
             "no_combine": fuse.is_list_agg(dep.aggregator),
-            "nbytes": nbytes,
-            "seq": self._next_seq(),
-        }
-        self._store_bytes += nbytes
+        })
+
+    def _register_shuffle(self, dep, plan, store):
+        """Shared HBM shuffle-store bookkeeping (re-run guard, byte
+        accounting, eviction) for the in-core and streamed write paths."""
+        sid = dep.shuffle_id
+        if sid in self.shuffle_store:
+            self.drop_shuffle(sid)          # re-run: no double count
+        store["out_treedef"] = plan.out_treedef
+        store["out_specs"] = plan.out_specs
+        store["nbytes"] = sum(int(l.nbytes) for l in store["leaves"])
+        store["seq"] = self._next_seq()
+        self.shuffle_store[sid] = store
+        self._store_bytes += store["nbytes"]
         self._evict_hbm(keep_sid=sid)
         return ("shuffle", sid)
 
@@ -426,9 +430,9 @@ class JAXExecutor:
     def _run_streamed_shuffle(self, plan):
         from dpark_tpu.rdd import _ColumnarSlice
         dep = plan.epilogue[1]
+        # _should_stream guarantees a classified monoid: the combine runs
+        # entirely through segment scatters, never the user merge fn
         monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
-        merge_fn = fuse._leaves_merge_fn(
-            dep.aggregator.merge_combiners, len(plan.out_specs) - 1)
         slices = plan.source[1]._slices
         chunk = conf.STREAM_CHUNK_ROWS
         nchunks = (max(len(s) for s in slices) + chunk - 1) // chunk
@@ -445,25 +449,14 @@ class JAXExecutor:
             cnts, offs = outs[0], outs[1]
             leaves = list(outs[2:])
             recv = self._exchange_all(leaves, cnts, offs)
-            state = self._merge_into_state(plan, state, recv, merge_fn,
-                                           monoid)
+            state = self._merge_into_state(plan, state, recv, monoid)
             logger.debug("streamed chunk %d/%d", c + 1, nchunks)
         leaves, counts = state
-        sid = dep.shuffle_id
-        if sid in self.shuffle_store:
-            self.drop_shuffle(sid)
-        nbytes = sum(int(l.nbytes) for l in leaves)
-        self.shuffle_store[sid] = {
+        return self._register_shuffle(dep, plan, {
             "leaves": leaves, "counts": counts,
             "pre_reduced": True,        # device d holds reduce part d
-            "out_treedef": plan.out_treedef,
-            "out_specs": plan.out_specs,
             "no_combine": False,
-            "nbytes": nbytes, "seq": self._next_seq(),
-        }
-        self._store_bytes += nbytes
-        self._evict_hbm(keep_sid=sid)
-        return ("shuffle", sid)
+        })
 
     def _exchange_all(self, leaves, counts, offsets):
         """Run exchange rounds for already-bucketized buffers; returns
@@ -491,9 +484,9 @@ class JAXExecutor:
                 raise RuntimeError("shuffle exchange did not converge")
         return recv_rounds, cnt_rounds, slot
 
-    def _merge_into_state(self, plan, state, recv, merge_fn, monoid):
+    def _merge_into_state(self, plan, state, recv, monoid):
         """Combine received rows (and the running state) into the new
-        per-device unique-key state."""
+        per-device unique-key state (monoid scatters only)."""
         recv_rounds, cnt_rounds, slot = recv
         rounds = len(recv_rounds)
         nleaves = len(recv_rounds[0])
@@ -525,7 +518,7 @@ class JAXExecutor:
                         for sl, fl in zip(st_leaves[1:], flat[1:])]
                     mask = jnp.concatenate([stv, mask])
                 k, vs, n = collectives.segment_reduce(
-                    flat[0], flat[1:], mask, merge_fn, monoid=monoid)
+                    flat[0], flat[1:], mask, None, monoid=monoid)
                 out = (jnp.expand_dims(n, 0),
                        jnp.expand_dims(k, 0)) + tuple(
                     jnp.expand_dims(v, 0) for v in vs)
@@ -603,6 +596,8 @@ class JAXExecutor:
                 return []
             counts = np.asarray(jax.device_get(store["counts"]))
             cnt = int(counts[reduce_id])
+            if not cnt:
+                return []
             mats = [np.asarray(jax.device_get(
                 lax.slice_in_dim(l, reduce_id, reduce_id + 1, axis=0)
             ))[0, :cnt] for l in store["leaves"]]

@@ -120,3 +120,22 @@ def test_speculative_relaunch(pctx):
         assert pctx.scheduler.history[-1].get("speculated", 0) >= 1
     finally:
         conf.SPECULATION_MULTIPLIER, conf.SPECULATION_QUANTILE = old
+
+
+def test_worker_crash_recovers(pctx, tmp_path):
+    """A worker process dying mid-task (reference: executor lost) breaks
+    the pool visibly; the pool restarts and retries complete the job."""
+    marker = str(tmp_path / "crashed_once")
+
+    def volatile(i, it):
+        import os as _os
+        items = list(it)
+        if i == 0 and not _os.path.exists(marker):
+            open(marker, "w").close()
+            _os._exit(1)               # simulate OOM-kill / segfault
+        return [sum(items)]
+
+    got = pctx.parallelize(list(range(40)), 4) \
+              .mapPartitionsWithIndex(volatile).collect()
+    assert sum(got) == sum(range(40))
+    assert os.path.exists(marker)
