@@ -16,7 +16,7 @@ import os
 import sys
 import time
 
-N_PAIRS = int(os.environ.get("BENCH_PAIRS", 4_000_000))
+N_PAIRS = int(os.environ.get("BENCH_PAIRS", 16_000_000))
 N_KEYS = int(os.environ.get("BENCH_KEYS", 65_536))
 BYTES = N_PAIRS * 8            # two int32 columns
 

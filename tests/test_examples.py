@@ -1,0 +1,81 @@
+"""Examples double as smoke tests (reference: SURVEY.md section 4 —
+examples/demo.py, wordcount, pi, pagerank, kmeans, LR)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(name, *args, timeout=240):
+    env = dict(os.environ)
+    env.update({
+        "DPARK_PROGRESS": "0",
+        "DPARK_TPU_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    p = tmp_path_factory.mktemp("corpus") / "text.txt"
+    with open(p, "w") as f:
+        for i in range(2000):
+            f.write("alpha beta gamma alpha %d\n" % i)
+    return str(p)
+
+
+def test_demo_local():
+    out = run_example("demo.py")
+    assert "sum: 4950" in out
+    assert "text round-trip: 10" in out
+
+
+def test_wordcount_both_masters(corpus):
+    host = run_example("wordcount.py", corpus)
+    tpu = run_example("wordcount.py", corpus, "-m", "tpu")
+    # top(10) tie-breaks on unspecified order; compare order-free
+    assert host.splitlines()[0] == tpu.splitlines()[0]          # alpha
+    assert set(host.splitlines()[1:3]) == set(tpu.splitlines()[1:3])
+    assert host.splitlines()[0].split()[0] == "4000"   # alpha count
+
+
+def test_wordcount_device(corpus):
+    out = run_example("wordcount_device.py", corpus)
+    assert out.splitlines()[0].split() == ["4000", "alpha"]
+
+
+def test_pi():
+    out = run_example("pi.py")
+    assert "Pi is roughly 3." in out
+
+
+def test_pagerank():
+    out = run_example("pagerank.py")
+    assert "total rank: 1.0000" in out
+
+
+def test_kmeans_tpu():
+    out = run_example("kmeans.py", "-m", "tpu", timeout=400)
+    assert "iter 7" in out
+
+
+def test_streaming():
+    out = run_example("streaming_wordcount.py")
+    assert "('the', 4)" in out
+
+
+def test_logistic_regression_tpu():
+    out = run_example("logistic_regression.py", "-m", "tpu", timeout=400)
+    assert "consistency with true boundary" in out
+    pct = float(out.split("boundary:")[1].strip().rstrip("%"))
+    assert pct > 85.0
