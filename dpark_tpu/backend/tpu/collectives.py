@@ -122,11 +122,32 @@ def exchange_round(axis, leaves, offsets, counts, sent, slot):
         g = leaf[idx]                                          # (R, slot, ..)
         g = jnp.where(_bcast(mask, g), g, jnp.zeros((), g.dtype))
         send.append(g)
-    recv = [lax.all_to_all(g, axis, 0, 0, tiled=True) for g in send]
+    recv = _grouped_all_to_all(send, axis)
     recv_cnt = lax.all_to_all(sendable, axis, 0, 0, tiled=True)
     new_sent = sent + sendable
     overflow = lax.psum(jnp.sum(counts - new_sent), axis)
     return recv, recv_cnt, new_sent, overflow
+
+
+def _grouped_all_to_all(buffers, axis):
+    """Exchange the per-destination buffers with as few collectives as
+    possible: scalar leaves of the same dtype stack into one all_to_all
+    (one ICI launch instead of one per column)."""
+    groups = {}
+    for i, g in enumerate(buffers):
+        key = (str(g.dtype), g.shape) if g.ndim == 2 else ("solo%d" % i,)
+        groups.setdefault(key, []).append(i)
+    out = [None] * len(buffers)
+    for key, idxs in groups.items():
+        if len(idxs) == 1 or key[0].startswith("solo"):
+            for i in idxs:
+                out[i] = lax.all_to_all(buffers[i], axis, 0, 0, tiled=True)
+            continue
+        packed = jnp.stack([buffers[i] for i in idxs], axis=-1)
+        exchanged = lax.all_to_all(packed, axis, 0, 0, tiled=True)
+        for pos, i in enumerate(idxs):
+            out[i] = exchanged[..., pos]
+    return out
 
 
 def flatten_received(recv_rounds, cnt_rounds, key_index=0):

@@ -195,6 +195,13 @@ class DparkContext:
             rdd_or_path = self.tableFile(rdd_or_path)
         return TableRDD(rdd_or_path, fields)
 
+    def sql(self, query, /, **tables):
+        """Minimal SELECT front over TableRDDs:
+        ctx.sql("select region, sum(qty) as q from t group by region",
+                t=my_table)."""
+        from dpark_tpu.table import execute
+        return execute(query, tables)
+
     def beansdb(self, path, raw=False, check_crc=True):
         from dpark_tpu.beansdb import BeansdbFileRDD
         return BeansdbFileRDD(self, path, raw, check_crc)

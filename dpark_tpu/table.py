@@ -265,6 +265,99 @@ class TableRDD:
         return "<TableRDD %s(%s)>" % (self.name, ", ".join(self.fields))
 
 
+_SQL_RE = re.compile(
+    r"^\s*select\s+(?P<cols>.+?)\s+from\s+(?P<table>\w+)"
+    r"(?:\s+where\s+(?P<where>.+?))?"
+    r"(?:\s+group\s+by\s+(?P<group>.+?))?"
+    r"(?:\s+order\s+by\s+(?P<order>.+?)(?P<desc>\s+desc)?)?"
+    r"(?:\s+limit\s+(?P<limit>\d+))?\s*$",
+    re.I | re.S)
+
+
+def _mask_literals(sql):
+    """Same-length copy of `sql` with quoted-string contents blanked, so
+    clause keywords inside literals don't split the query."""
+    out = list(sql)
+    i = 0
+    while i < len(out):
+        q = out[i]
+        if q in "'\"":
+            i += 1
+            while i < len(out) and out[i] != q:
+                out[i] = "x"
+                i += 1
+        i += 1
+    return "".join(out)
+
+
+def execute(sql, tables):
+    """Minimal SQL-ish front over TableRDD (reference: dpark table's
+    `execute` [SURVEY.md 2.3, low-confidence item]).  Supports
+    SELECT cols FROM t [WHERE expr] [GROUP BY keys] [ORDER BY col [DESC]]
+    [LIMIT n]; column expressions and aggregates use the DSL's syntax.
+
+    `tables`: dict name -> TableRDD.  Returns a TableRDD, or a row list
+    when LIMIT is given.
+    """
+    m = _SQL_RE.match(_mask_literals(sql))
+    if not m:
+        raise ValueError("unsupported SQL: %r" % sql)
+
+    def part(name):
+        span = m.span(name)
+        return sql[span[0]:span[1]] if span != (-1, -1) else None
+
+    t = tables.get(m.group("table"))
+    if t is None:
+        raise ValueError("unknown table %r" % m.group("table"))
+    if part("where"):
+        t = t.where(part("where"))
+
+    order = (part("order") or "").strip()
+    desc = bool(m.group("desc"))
+    cols = part("cols").strip()
+
+    if part("group"):
+        group_keys = _split_cols((part("group"),))
+        sel = _split_cols((cols,))
+        aggs, out_names = [], []
+        key_names = [re.sub(r"\W+", "_", k).strip("_") or ("k%d" % i)
+                     for i, k in enumerate(group_keys)]
+        for c in sel:
+            am = _AS_RE.match(c)
+            expr = am.group(1) if am else c
+            if _AGG_RE.match(expr):
+                aggs.append(c)
+                out_names.append(_parse_column(c, t.fields, 0)[0])
+            elif c.strip() in group_keys:
+                out_names.append(
+                    key_names[group_keys.index(c.strip())])
+            else:
+                raise ValueError(
+                    "non-aggregate select column %r is not a group key"
+                    % c)
+        t = t.groupBy(group_keys, *aggs)
+        if order:
+            t = t.sort(order, reverse=desc)
+            order = ""
+        t = t.select(*out_names)
+    else:
+        # ORDER BY may reference source columns the projection drops:
+        # sort wherever the column lives
+        if order and cols != "*" and order not in \
+                [_AS_RE.sub(r"\2", c).strip() for c in
+                 _split_cols((cols,))]:
+            t = t.sort(order, reverse=desc)
+            order = ""
+        if cols != "*":
+            t = t.select(cols)
+        if order:
+            t = t.sort(order, reverse=desc)
+    if m.group("limit"):
+        return t.take(int(m.group("limit")))
+    return t
+
+
 def _split_cols(cols):
     out = []
     for c in cols:

@@ -111,3 +111,47 @@ def test_ctx_table_roundtrip(ctx, tmp_path):
     assert t.count() == 100
     got = t.where("a == 7").collect()
     assert got[0].b == 49
+
+
+def test_sql_execute(ctx, sales):
+    got = ctx.sql(
+        "select region, sum(qty) as total from sales group by region",
+        sales=sales).collect()
+    assert sorted((r.region, r.total) for r in got) == [
+        ("north", 5), ("south", 8)]
+
+    rows = ctx.sql(
+        "select item, qty from sales where region == 'south' "
+        "order by qty desc limit 2", sales=sales)
+    assert [r.qty for r in rows] == [5, 2]
+
+    allrows = ctx.sql("select * from sales", sales=sales).collect()
+    assert len(allrows) == 5
+
+    with pytest.raises(ValueError):
+        ctx.sql("delete from sales", sales=sales)
+    with pytest.raises(ValueError):
+        ctx.sql("select * from nope", sales=sales)
+
+
+def test_sql_edge_cases(ctx, sales):
+    # ORDER BY a column the projection drops
+    rows = ctx.sql("select item from sales order by qty desc limit 2",
+                   sales=sales)
+    assert [r.item for r in rows] == ["apple", "apple"]
+    # SELECT order respected in GROUP BY output
+    got = ctx.sql(
+        "select sum(qty) as q, region from sales group by region",
+        sales=sales).collect()
+    assert got[0]._fields == ("q", "region")
+    # clause keyword inside a string literal
+    none = ctx.sql(
+        "select * from sales where item == 'a group by b'",
+        sales=sales).collect()
+    assert none == []
+    # table named like the positional parameter
+    assert ctx.sql("select * from query", query=sales).count() == 5
+    # non-aggregate select column that is not a group key
+    with pytest.raises(ValueError):
+        ctx.sql("select price, sum(qty) from sales group by region",
+                sales=sales)
