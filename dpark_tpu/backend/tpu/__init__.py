@@ -81,9 +81,14 @@ class TPUScheduler(DAGScheduler):
         # device exchange so only the group-merge runs in Python
         precomputed = None
         try:
-            precomputed = self._precompute_cogroup(stage)
+            precomputed = self._precompute_join(stage)
         except Exception as e:
-            logger.debug("cogroup precompute skipped: %s", e)
+            logger.debug("device join skipped: %s", e)
+        if precomputed is None:
+            try:
+                precomputed = self._precompute_cogroup(stage)
+            except Exception as e:
+                logger.debug("cogroup precompute skipped: %s", e)
         try:
             for task in tasks:
                 status, payload = _run_task_inline(task)
@@ -98,6 +103,57 @@ class TPUScheduler(DAGScheduler):
                     from dpark_tpu.env import env
                     env.cache.drop(cg.id, nparts)
                     cg.should_cache = False
+
+    def _resident_nocombine_deps(self, cg):
+        """All of a CoGroupedRDD's inputs as HBM-resident no-combine
+        shuffle deps, or None (narrow side / host-resident / combining)."""
+        from dpark_tpu.backend.tpu import fuse
+        deps = []
+        for kind, obj in cg._dep_kinds:
+            if kind != "shuffle" or not fuse.is_list_agg(obj.aggregator) \
+                    or not self.executor.has_shuffle(obj.shuffle_id):
+                return None
+            deps.append(obj)
+        return deps
+
+    def _precompute_join(self, stage):
+        """Full device join: when the stage's top RDD is exactly
+        a.join(b) over two HBM-resident no-combine shuffles, expand the
+        key-matched pairs on device and seed the join RDD's partitions."""
+        from dpark_tpu.backend.tpu import fuse
+        from dpark_tpu.env import env
+        from dpark_tpu.rdd import (CoGroupedRDD, FlatMappedValuesRDD,
+                                   _join_values)
+        top = stage.rdd
+        if not (isinstance(top, FlatMappedValuesRDD)
+                and top.f is _join_values
+                and isinstance(top.prev, CoGroupedRDD)
+                and len(top.prev.rdds) == 2):
+            return None
+        if getattr(top, "_tpu_precomputed", False):
+            return None
+        cg = top.prev
+        deps = self._resident_nocombine_deps(cg)
+        if deps is None:
+            return None
+        # join kernels require plain (k, v) records with a scalar int key
+        import jax.tree_util as jtu
+        for dep in deps:
+            store = self.executor.shuffle_store[dep.shuffle_id]
+            sample = jtu.tree_unflatten(
+                store["out_treedef"],
+                list(range(len(store["out_specs"]))))
+            if not (isinstance(sample, tuple) and len(sample) == 2
+                    and sample[0] == 0):
+                return None
+        rows_per_part = self.executor.run_device_join(deps[0], deps[1])
+        for p, rows in enumerate(rows_per_part):
+            env.cache.put((top.id, p), rows, disk=False)
+        was_cached = top.should_cache
+        top.should_cache = True
+        top._tpu_precomputed = True
+        logger.debug("join %d expanded on device", top.id)
+        return top, len(rows_per_part), was_cached
 
     def _precompute_cogroup(self, stage):
         """If this stage reads a CoGroupedRDD whose inputs are all
@@ -129,15 +185,9 @@ class TPUScheduler(DAGScheduler):
             return None
         if getattr(cg, "_tpu_precomputed", False):
             return None
-        deps = []
-        for kind, obj in cg._dep_kinds:
-            if kind != "shuffle":
-                return None              # narrow co-partitioned side: host
-            if not fuse.is_list_agg(obj.aggregator):
-                return None
-            if not self.executor.has_shuffle(obj.shuffle_id):
-                return None
-            deps.append(obj)
+        deps = self._resident_nocombine_deps(cg)
+        if deps is None:
+            return None
         per_source = [self.executor.gather_rows(dep) for dep in deps]
         nsrc = len(per_source)
         nparts = cg.partitioner.num_partitions

@@ -554,6 +554,18 @@ class JAXExecutor:
         """Device exchange + key sort for one no-combine shuffle dep;
         returns per-partition sorted row lists (host)."""
         store = self.shuffle_store[dep.shuffle_id]
+        counts, leaves = self._exchange_sorted(dep, store)
+        batch = layout.Batch(store["out_treedef"], leaves, counts)
+        return layout.egest(batch)
+
+    # ------------------------------------------------------------------
+    # device join: two exchanged+sorted sides expand to key-matched pairs
+    # entirely on device (two-phase: count totals, then a static-capacity
+    # gather program) — replaces the host merge for a.join(b)
+    # ------------------------------------------------------------------
+    def _exchange_sorted(self, dep, store):
+        """No-combine exchange leaving the result ON DEVICE: per-device
+        key-sorted rows as (counts, leaves...) global arrays."""
 
         class _GatherPlan:
             source = ("hbm", dep)
@@ -573,8 +585,83 @@ class JAXExecutor:
                                  for dt, shape in store["out_specs"]))
 
         outs = self._run_exchange_and_reduce(_GatherPlan)
+        return outs[0], list(outs[1:])          # counts, leaves
+
+    def run_device_join(self, dep_a, dep_b):
+        """Per-partition inner join of two HBM-resident no-combine
+        shuffles; returns per-partition host rows (k, (va, vb))."""
+        store_a = self.shuffle_store[dep_a.shuffle_id]
+        store_b = self.shuffle_store[dep_b.shuffle_id]
+        cnt_a, lv_a = self._exchange_sorted(dep_a, store_a)
+        cnt_b, lv_b = self._exchange_sorted(dep_b, store_b)
+        na, nb = len(lv_a), len(lv_b)
+        cap_a, cap_b = lv_a[0].shape[1], lv_b[0].shape[1]
+
+        count_key = ("join_count", cap_a, cap_b, na, nb,
+                     tuple(str(l.dtype) for l in lv_a + lv_b))
+        if count_key not in self._compiled:
+            def count_dev(ca, cb, ka, kb):
+                a, b, A, B = ca[0], cb[0], ka[0], kb[0]
+                sent = collectives._sentinel(A.dtype)
+                A = jnp.where(jnp.arange(cap_a) < a, A, sent)
+                B = jnp.where(jnp.arange(cap_b) < b, B, sent)
+                lo = jnp.searchsorted(B, A, side="left")
+                hi = jnp.searchsorted(B, A, side="right")
+                per = jnp.where(jnp.arange(cap_a) < a, hi - lo, 0)
+                return (jnp.expand_dims(jnp.sum(per), 0),)
+            fn = _shard_map(count_dev, self.mesh,
+                            in_specs=(P(AXIS),) * 4,
+                            out_specs=(P(AXIS),))
+            self._compiled[count_key] = jax.jit(fn)
+        (totals,) = self._compiled[count_key](cnt_a, cnt_b,
+                                              lv_a[0], lv_b[0])
+        cap_out = layout.round_capacity(
+            int(np.asarray(jax.device_get(totals)).max() or 1))
+
+        exp_key = ("join_expand", cap_a, cap_b, cap_out, na, nb,
+                   tuple(str(l.dtype) for l in lv_a + lv_b))
+        if exp_key not in self._compiled:
+            def expand_dev(ca, cb, *leaves):
+                a, b = ca[0], cb[0]
+                A = [l[0] for l in leaves[:na]]
+                B = [l[0] for l in leaves[na:]]
+                ka, kb = A[0], B[0]
+                sent = collectives._sentinel(ka.dtype)
+                ka = jnp.where(jnp.arange(cap_a) < a, ka, sent)
+                kb = jnp.where(jnp.arange(cap_b) < b, kb, sent)
+                lo = jnp.searchsorted(kb, ka, side="left")
+                hi = jnp.searchsorted(kb, ka, side="right")
+                per = jnp.where(jnp.arange(cap_a) < a, hi - lo, 0)
+                offs = jnp.cumsum(per) - per          # exclusive
+                total = jnp.sum(per)
+                t = jnp.arange(cap_out)
+                # source A row for each output slot
+                i = jnp.clip(
+                    jnp.searchsorted(offs + per, t, side="right"),
+                    0, cap_a - 1)
+                j = t - offs[i]
+                bi = jnp.clip(lo[i] + j, 0, cap_b - 1)
+                out = [A[0][i]] + [x[i] for x in A[1:]] \
+                    + [x[bi] for x in B[1:]]
+                return (jnp.expand_dims(total, 0),) + tuple(
+                    jnp.expand_dims(o, 0) for o in out)
+            n_out = 1 + 1 + (na - 1) + (nb - 1)
+            fn = _shard_map(expand_dev, self.mesh,
+                            in_specs=(P(AXIS),) * (2 + na + nb),
+                            out_specs=(P(AXIS),) * n_out)
+            self._compiled[exp_key] = jax.jit(fn)
+        outs = self._compiled[exp_key](cnt_a, cnt_b, *lv_a, *lv_b)
         counts, leaves = outs[0], list(outs[1:])
-        batch = layout.Batch(store["out_treedef"], leaves, counts)
+
+        # egest rows (k, va..., vb...) and rebuild (k, (va, vb)) records
+        import jax.tree_util as jtu
+        ta = store_a["out_treedef"]
+        tb = store_b["out_treedef"]
+        sample_a = jtu.tree_unflatten(ta, list(range(na)))
+        sample_b = jtu.tree_unflatten(tb, list(range(nb)))
+        joined_sample = (0, (sample_a[1], sample_b[1]))
+        out_treedef = jtu.tree_structure(joined_sample)
+        batch = layout.Batch(out_treedef, leaves, counts)
         return layout.egest(batch)
 
     # ------------------------------------------------------------------

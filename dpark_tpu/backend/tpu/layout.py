@@ -141,15 +141,27 @@ def egest(batch):
     """Sharded Batch -> list of per-partition row lists (host)."""
     counts = np.asarray(jax.device_get(batch.counts))
     host_cols = [np.asarray(jax.device_get(c)) for c in batch.cols]
-    # fast path: records that are flat tuples of scalars (or bare scalars)
+    # fast paths: scalar records, and arbitrarily-nested TUPLE records
+    # (e.g. join's (k, (a, b))) rebuild with zips instead of a per-row
+    # tree_unflatten
     sample = jax.tree_util.tree_unflatten(
         batch.treedef, list(range(len(batch.cols))))
-    flat_tuple = (isinstance(sample, tuple)
-                  and all(isinstance(x, int) for x in sample)
-                  and list(sample) == list(range(len(batch.cols)))
-                  and all(c.ndim == 2 for c in host_cols))
-    bare_scalar = (len(batch.cols) == 1 and sample == 0
-                   and host_cols[0].ndim == 2)
+    all_2d = all(c.ndim == 2 for c in host_cols)
+
+    def _tuple_only(struct):
+        if isinstance(struct, int):
+            return True
+        return (isinstance(struct, tuple)
+                and all(_tuple_only(x) for x in struct))
+
+    def _zip_build(struct, lists):
+        if isinstance(struct, int):
+            return lists[struct]
+        parts = [_zip_build(x, lists) for x in struct]
+        return list(zip(*parts))
+
+    zipable = all_2d and _tuple_only(sample)
+    bare_scalar = (len(batch.cols) == 1 and sample == 0 and all_2d)
     out = []
     for d in range(batch.ndev):
         n = int(counts[d])
@@ -157,8 +169,9 @@ def egest(batch):
         if n:
             if bare_scalar:
                 rows = host_cols[0][d, :n].tolist()
-            elif flat_tuple:
-                rows = list(zip(*[c[d, :n].tolist() for c in host_cols]))
+            elif zipable:
+                rows = _zip_build(
+                    sample, [c[d, :n].tolist() for c in host_cols])
             else:
                 per_leaf = [c[d, :n].tolist() for c in host_cols]
                 for i in range(n):

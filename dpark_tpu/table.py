@@ -269,7 +269,7 @@ _SQL_RE = re.compile(
     r"^\s*select\s+(?P<cols>.+?)\s+from\s+(?P<table>\w+)"
     r"(?:\s+where\s+(?P<where>.+?))?"
     r"(?:\s+group\s+by\s+(?P<group>.+?))?"
-    r"(?:\s+order\s+by\s+(?P<order>.+?)(?P<desc>\s+desc)?)?"
+    r"(?:\s+order\s+by\s+(?P<order>.+?)(?P<dir>\s+(?:asc|desc))?)?"
     r"(?:\s+limit\s+(?P<limit>\d+))?\s*$",
     re.I | re.S)
 
@@ -284,6 +284,11 @@ def _mask_literals(sql):
         if q in "'\"":
             i += 1
             while i < len(out) and out[i] != q:
+                if out[i] == "\\" and i + 1 < len(out):
+                    out[i] = "x"
+                    out[i + 1] = "x"    # escaped char incl. quote
+                    i += 2
+                    continue
                 out[i] = "x"
                 i += 1
         i += 1
@@ -314,7 +319,7 @@ def execute(sql, tables):
         t = t.where(part("where"))
 
     order = (part("order") or "").strip()
-    desc = bool(m.group("desc"))
+    desc = (m.group("dir") or "").strip().lower() == "desc"
     cols = part("cols").strip()
 
     if part("group"):
@@ -342,13 +347,15 @@ def execute(sql, tables):
             order = ""
         t = t.select(*out_names)
     else:
-        # ORDER BY may reference source columns the projection drops:
-        # sort wherever the column lives
-        if order and cols != "*" and order not in \
-                [_AS_RE.sub(r"\2", c).strip() for c in
-                 _split_cols((cols,))]:
-            t = t.sort(order, reverse=desc)
-            order = ""
+        # ORDER BY may reference either the source columns or a projected
+        # output name: sort on whichever side actually holds it
+        if order and cols != "*":
+            projected = [
+                _parse_column(c, t.fields, i)[0]
+                for i, c in enumerate(_split_cols((cols,)))]
+            if order not in projected:
+                t = t.sort(order, reverse=desc)
+                order = ""
         if cols != "*":
             t = t.select(cols)
         if order:

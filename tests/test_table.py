@@ -155,3 +155,15 @@ def test_sql_edge_cases(ctx, sales):
     with pytest.raises(ValueError):
         ctx.sql("select price, sum(qty) from sales group by region",
                 sales=sales)
+
+
+def test_sql_order_by_variants(ctx, sales):
+    rows = ctx.sql("select qty * 2 as d from sales order by qty asc "
+                   "limit 2", sales=sales)
+    assert [r.d for r in rows] == [2, 4]
+    rows = ctx.sql("select qty * 2 from sales order by qty * 2 desc "
+                   "limit 1", sales=sales)
+    assert rows[0][0] == 10
+    got = ctx.sql(r"select * from sales where item == 'don\'t group by'",
+                  sales=sales).collect()
+    assert got == []

@@ -435,3 +435,57 @@ def test_streamed_shuffle_bridge_to_host(tctx):
                               for k in range(11)}
     finally:
         conf.STREAM_CHUNK_ROWS = old
+
+
+def test_device_join_expansion(tctx):
+    """a.join(b) expands pairs entirely on device, matching local."""
+    from dpark_tpu import DparkContext
+    a_pairs = [(i % 12, i) for i in range(240)]
+    b_pairs = [(i % 12, -i) for i in range(120)]
+    got = sorted(tctx.parallelize(a_pairs, 8)
+                 .join(tctx.parallelize(b_pairs, 8), 8).collect())
+    lctx = DparkContext("local")
+    expect = sorted(lctx.parallelize(a_pairs, 8)
+                    .join(lctx.parallelize(b_pairs, 8), 8).collect())
+    assert got == expect
+    assert len(got) == 240 * 120 // 12
+
+
+def test_device_join_disjoint_and_skew(tctx):
+    a = tctx.parallelize([(1, "no")] * 0 + [(k, k) for k in range(10)], 8)
+    b = tctx.parallelize([(k + 100, k) for k in range(10)], 8)
+    assert a.join(b, 8).collect() == []          # disjoint keys
+    # heavy skew: one key with many matches on both sides
+    aa = tctx.parallelize([(7, i) for i in range(50)] + [(1, 0)], 8)
+    bb = tctx.parallelize([(7, -i) for i in range(40)] + [(2, 0)], 8)
+    got = aa.join(bb, 8).collect()
+    assert len(got) == 50 * 40
+    assert all(k == 7 for k, _ in got)
+
+
+def test_device_join_tuple_values(tctx):
+    a = tctx.parallelize([(i % 5, (i, i * 2)) for i in range(50)], 8)
+    b = tctx.parallelize([(i % 5, float(i)) for i in range(25)], 8)
+    got = sorted(a.join(b, 8).collect())
+    from dpark_tpu import DparkContext
+    lctx = DparkContext("local")
+    expect = sorted(
+        lctx.parallelize([(i % 5, (i, i * 2)) for i in range(50)], 8)
+        .join(lctx.parallelize([(i % 5, float(i)) for i in range(25)], 8),
+              8).collect())
+    assert got == expect
+
+
+def test_tuple_key_join_falls_back(tctx):
+    """Composite (tuple) keys cannot ride the device join; the cogroup/
+    host fallback must still produce exact results."""
+    a = tctx.parallelize([((i % 3, i % 2), i) for i in range(24)], 8)
+    b = tctx.parallelize([((i % 3, i % 2), -i) for i in range(12)], 8)
+    got = sorted(a.join(b, 8).collect())
+    from dpark_tpu import DparkContext
+    lctx = DparkContext("local")
+    expect = sorted(
+        lctx.parallelize([((i % 3, i % 2), i) for i in range(24)], 8)
+        .join(lctx.parallelize([((i % 3, i % 2), -i) for i in range(12)],
+                               8), 8).collect())
+    assert got == expect
