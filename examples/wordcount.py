@@ -3,7 +3,6 @@
 Usage: python examples/wordcount.py <path> [-m local|process|tpu]
 """
 
-import sys
 
 from dpark_tpu import DparkContext
 
