@@ -10,7 +10,8 @@ import pytest
 
 
 OPS = ["map_affine", "filter_mod", "map_swap", "reduce_sum", "reduce_min",
-       "reduce_max", "group", "sort", "distinct_keys", "count_tail"]
+       "reduce_max", "group", "sort", "distinct_keys", "count_tail",
+       "union_extra", "host_partitions"]
 
 
 def build_program(rng, depth=4):
@@ -28,6 +29,12 @@ def build_program(rng, depth=4):
                          rng.randint(0, 1)))
         elif op == "map_swap":
             prog.append(("map_swap", rng.randint(1, 7)))
+        elif op == "union_extra":
+            prog.append(("union_extra", rng.randint(0, 2 ** 30)))
+        elif op == "host_partitions":
+            # an untraceable op: forces THIS stage onto the object path,
+            # exercising the HBM export bridge mid-pipeline
+            prog.append(("host_partitions",))
         elif op in ("reduce_sum", "reduce_min", "reduce_max", "group",
                     "sort", "distinct_keys"):
             if shuffled and rng.random() < 0.5:
@@ -67,6 +74,12 @@ def apply_program(ctx, data, prog):
         elif op == "distinct_keys":
             r = r.map(lambda kv: (kv[0], 0)).reduceByKey(
                 lambda a, b: 0, step[1])
+        elif op == "union_extra":
+            seed2 = step[1]
+            extra = [((seed2 + i) % 97, i % 13) for i in range(64)]
+            r = r.union(ctx.parallelize(extra, 8))
+        elif op == "host_partitions":
+            r = r.mapPartitions(lambda it: list(it))
     return r
 
 
