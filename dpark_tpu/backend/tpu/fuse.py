@@ -59,28 +59,89 @@ def _batched_spec_struct(specs, n=4):
     return [jax.ShapeDtypeStruct((n,) + shape, dt) for dt, shape in specs]
 
 
+_MONOID_DIRECT = None
+_MONOID_TEMPLATES = None
+
+
+def _monoid_tables():
+    """Lazily built lookup tables for exact monoid identification."""
+    global _MONOID_DIRECT, _MONOID_TEMPLATES
+    if _MONOID_DIRECT is None:
+        import operator
+        direct = {operator.add: "add", operator.iadd: "add",
+                  operator.mul: "mul", operator.imul: "mul",
+                  min: "min", max: "max",
+                  np.add: "add", np.multiply: "mul",
+                  np.minimum: "min", np.maximum: "max",
+                  jnp.add: "add", jnp.multiply: "mul",
+                  jnp.minimum: "min", jnp.maximum: "max"}
+        tmpl = {
+            "add": [lambda a, b: a + b, lambda a, b: b + a],
+            "mul": [lambda a, b: a * b, lambda a, b: b * a],
+            "min": [lambda a, b: min(a, b)],
+            "max": [lambda a, b: max(a, b)],
+        }
+        templates = {}
+        for name, fns in tmpl.items():
+            for f in fns:
+                c = f.__code__
+                templates[(c.co_code, c.co_consts, c.co_names)] = name
+        _MONOID_DIRECT, _MONOID_TEMPLATES = direct, templates
+    return _MONOID_DIRECT, _MONOID_TEMPLATES
+
+
 def classify_merge(merge):
-    """Probabilistic algebraic classification of a user merge function:
-    probe it on random int pairs; agreement with +, min, max or * on all
-    probes means (with overwhelming probability for any deterministic
-    function) it IS that monoid, unlocking single-pass segment scatters
-    instead of the generic O(log n)-pass associative scan."""
-    import operator
-    import random
-    rng = random.Random(0xD17A)
-    candidates = [("add", operator.add), ("min", min), ("max", max),
-                  ("mul", operator.mul)]
+    """EXACT algebraic classification of a user merge function.
+
+    A classified monoid unlocks single-pass segment scatters instead of
+    the generic O(log n)-pass associative scan — but a wrong answer here
+    silently replaces the user's function, so only provable matches
+    qualify (round-1 advisor finding: the old 8-random-int-probe
+    classifier could mistake e.g. a saturating add for plain add):
+
+    * a known callable by identity (operator.add, min, np.maximum, ...);
+    * a closure-free 2-arg Python function whose bytecode equals one of
+      the canonical forms ``a+b``, ``b+a``, ``a*b``, ``b*a``,
+      ``min(a,b)``, ``max(a,b)`` — with any referenced global verified
+      to still be the builtin;
+    * an explicit user hint: ``merge.__dpark_monoid__ = "add"`` (for
+      functions that are equivalent to a monoid but written differently).
+
+    Everything else returns None and runs through the traced user
+    function (correct, just not single-pass)."""
+    hint = getattr(merge, "__dpark_monoid__", None)
+    if hint in ("add", "min", "max", "mul"):
+        return hint
+    direct, templates = _monoid_tables()
     try:
-        probes = [(rng.randint(-2 ** 40, 2 ** 40),
-                   rng.randint(-2 ** 40, 2 ** 40)) for _ in range(8)]
-        results = [merge(a, b) for a, b in probes]
-        for name, op in candidates:
-            if all(bool(r == op(a, b))
-                   for (a, b), r in zip(probes, results)):
-                return name
-    except Exception:
-        pass              # tuple/array-valued or otherwise non-scalar
-    return None
+        if merge in direct:
+            return direct[merge]
+    except TypeError:
+        return None                      # unhashable callable
+    code = getattr(merge, "__code__", None)
+    if code is None or getattr(merge, "__closure__", None):
+        return None
+    if code.co_argcount != 2 or code.co_flags & 0x0C:   # *args/**kwargs
+        return None
+    name = templates.get((code.co_code, code.co_consts, code.co_names))
+    if name is None:
+        return None
+    import builtins
+    fglobals = merge.__globals__
+    fbuiltins = fglobals.get("__builtins__", builtins)
+    for g in code.co_names:
+        expected = getattr(builtins, g, None)
+        if expected is None:
+            return None
+        if g in fglobals:                # shadowed min/max: not provable
+            if fglobals[g] is not expected:
+                return None
+        elif isinstance(fbuiltins, dict):
+            if fbuiltins.get(g) is not expected:
+                return None              # custom __builtins__ dict
+        elif getattr(fbuiltins, g, None) is not expected:
+            return None
+    return name
 
 
 def fn_key(f):

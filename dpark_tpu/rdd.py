@@ -14,6 +14,7 @@ traceable op-IR and fuses them per stage into one jitted program
 """
 
 import bz2 as _bz2
+import contextlib
 import csv as _csv
 import gzip as _gzip
 import heapq
@@ -1514,25 +1515,32 @@ class OutputPickleFileRDD(OutputRDDBase):
 
 class MultiOutputTextFileRDD(OutputRDDBase):
     """saveAsTextFileByKey: records are (key, line); each key gets its own
-    subdirectory (reference: MultiOutputTextFileRDD [M])."""
+    subdirectory (reference: MultiOutputTextFileRDD [M]).
+
+    Each part file is written tmp+rename like OutputRDDBase so a
+    speculative duplicate task can never interleave with (or corrupt) the
+    winner's output — last atomic rename wins (round-1 advisor fix)."""
 
     def compute(self, split):
-        files = {}
-        try:
+        part = "part-%05d%s" % (split.index, self.ext)
+        files = {}                      # key -> (file obj or None, target)
+        with contextlib.ExitStack() as stack:
             for k, line in self.prev.iterator(split):
-                f = files.get(k)
+                ent = files.get(k)
+                if ent is None:
+                    target = os.path.join(self.path, str(k), part)
+                    if os.path.exists(target) and not self.overwrite:
+                        ent = (None, target)
+                    else:
+                        ent = (stack.enter_context(atomic_file(target)),
+                               target)
+                    files[k] = ent
+                f = ent[0]
                 if f is None:
-                    d = os.path.join(self.path, str(k))
-                    os.makedirs(d, exist_ok=True)
-                    f = open(os.path.join(
-                        d, "part-%05d%s" % (split.index, self.ext)), "wb")
-                    files[k] = f
+                    continue            # exists and not overwrite: keep
                 if not isinstance(line, (bytes, bytearray)):
                     line = str(line).encode("utf-8")
                 f.write(line)
                 if not line.endswith(b"\n"):
                     f.write(b"\n")
-        finally:
-            for f in files.values():
-                f.close()
-        yield from (f.name for f in files.values())
+        yield from (target for _, target in files.values())

@@ -383,9 +383,12 @@ class DAGScheduler:
                         parent.output_locs[e.map_id] = None
                     elif e.uri:
                         parent.remove_outputs_by_uri(e.uri)
+                    # publish the surviving outputs (only the lost maps
+                    # are None) so in-flight reduces don't treat every
+                    # healthy map as missing and trigger a full parent
+                    # recompute (round-1 advisor fix)
                     env.map_output_tracker.register_outputs(
-                        e.shuffle_id,
-                        [None] * len(parent.output_locs))
+                        e.shuffle_id, list(parent.output_locs))
                     running.discard(stage)
                     waiting.add(stage)
                     submit_stage(parent)

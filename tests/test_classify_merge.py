@@ -1,0 +1,99 @@
+"""Exact monoid classification (round-1 advisor fix, fuse.py classify_merge):
+only provable matches may replace the user's merge function with a segment
+scatter.  A deliberately-misclassifiable merge (saturating add) must stay
+unclassified AND produce the correct, host-parity answer on the tpu master."""
+
+import operator
+
+import numpy as np
+import pytest
+
+from dpark_tpu.backend.tpu.fuse import classify_merge
+
+
+SAT = 10 ** 6
+
+
+def test_direct_callables():
+    assert classify_merge(operator.add) == "add"
+    assert classify_merge(operator.mul) == "mul"
+    assert classify_merge(min) == "min"
+    assert classify_merge(max) == "max"
+    assert classify_merge(np.add) == "add"
+    assert classify_merge(np.maximum) == "max"
+
+
+def test_canonical_lambdas():
+    assert classify_merge(lambda a, b: a + b) == "add"
+    assert classify_merge(lambda x, y: x + y) == "add"       # arg names
+    assert classify_merge(lambda a, b: b + a) == "add"
+    assert classify_merge(lambda a, b: a * b) == "mul"
+    assert classify_merge(lambda a, b: min(a, b)) == "min"
+    assert classify_merge(lambda a, b: max(a, b)) == "max"
+
+    def named(u, v):
+        return u + v
+    assert classify_merge(named) == "add"
+
+
+def test_saturating_add_not_classified():
+    # agrees with + on small values; the old probabilistic probe
+    # classified it as "add" and silently saturated nothing
+    assert classify_merge(lambda a, b: min(a + b, SAT)) is None
+
+
+def test_non_monoid_forms_not_classified():
+    assert classify_merge(lambda a, b: a - b) is None
+    assert classify_merge(lambda a, b: a + b + 1) is None
+    assert classify_merge(lambda a, b, c=0: a + b) is None   # 3 params
+    assert classify_merge(lambda *a: sum(a)) is None
+    assert classify_merge("not callable") is None
+
+    captured = 0
+    assert classify_merge(lambda a, b: a + b + captured) is None
+
+
+def test_shadowed_builtin_not_classified():
+    ns = {"min": lambda a, b: a * b}      # min shadowed: not provable
+    exec("def f(a, b):\n    return min(a, b)", ns)
+    assert classify_merge(ns["f"]) is None
+
+
+def test_custom_builtins_dict_not_classified():
+    # shadowing through a custom __builtins__ dict must also be caught
+    ns = {"__builtins__": {"min": lambda a, b: a * b}}
+    exec("def f(a, b):\n    return min(a, b)", ns)
+    assert ns["f"](3, 4) == 12
+    assert classify_merge(ns["f"]) is None
+
+
+def test_explicit_hint():
+    def weird_but_add(a, b):
+        return sum([a, b])
+    assert classify_merge(weird_but_add) is None
+    weird_but_add.__dpark_monoid__ = "add"
+    assert classify_merge(weird_but_add) == "add"
+
+
+@pytest.fixture()
+def tctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu")
+    c.start()
+    yield c
+    c.stop()
+
+
+def test_saturating_add_correct_on_tpu(tctx):
+    """End-to-end: the misclassifiable merge gets the right answer."""
+    from dpark_tpu import DparkContext
+    sat_add = lambda a, b: min(a + b, SAT)          # noqa: E731
+    pairs = [(i % 5, SAT // 3) for i in range(60)]  # sums would exceed SAT
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(sat_add, 8).collect())
+    lctx = DparkContext("local")
+    expect = dict(lctx.parallelize(pairs, 8)
+                  .reduceByKey(sat_add, 8).collect())
+    lctx.stop()
+    assert got == expect
+    assert all(v <= SAT for v in got.values())

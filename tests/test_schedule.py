@@ -59,6 +59,60 @@ def test_lineage_recovery_fetch_failed(ctx):
     assert dict(r.collect()) == {0: 25, 1: 25, 2: 25, 3: 25}
 
 
+def test_fetch_failed_partial_invalidation(ctx):
+    """Losing ONE map output must not invalidate the healthy ones in the
+    map-output tracker (round-1 advisor fix): the fetch_failed handler
+    registers the surviving locations with only the lost entry nulled."""
+    from dpark_tpu.env import env
+    calls = []
+    orig = env.map_output_tracker.register_outputs
+
+    def spy(sid, locs):
+        calls.append(list(locs))
+        return orig(sid, locs)
+
+    env.map_output_tracker.register_outputs = spy
+    try:
+        r = ctx.parallelize([(i % 4, 1) for i in range(100)], 4) \
+               .reduceByKey(lambda a, b: a + b, 2)
+        assert dict(r.collect()) == {0: 25, 1: 25, 2: 25, 3: 25}
+        victim = None
+        for root, _, files in os.walk(os.path.join(env.workdir,
+                                                   "shuffle")):
+            for f in sorted(files):
+                victim = os.path.join(root, f)
+                break
+            if victim:
+                break
+        os.unlink(victim)
+        assert dict(r.collect()) == {0: 25, 1: 25, 2: 25, 3: 25}
+    finally:
+        env.map_output_tracker.register_outputs = orig
+    # the invalidation registration (the one with holes) must keep the
+    # healthy outputs: exactly one None, never [None]*n
+    partial = [locs for locs in calls if any(l is None for l in locs)]
+    assert partial, "fetch_failed never re-registered the parent outputs"
+    for locs in partial:
+        assert sum(1 for l in locs if l is None) == 1
+
+
+def test_save_by_key_overwrite_and_atomic(ctx, tmp_path):
+    """saveAsTextFileByKey honors overwrite=False, replaces atomically on
+    overwrite=True, and leaves no tmp litter (round-1 advisor fix)."""
+    out = str(tmp_path / "bykey")
+    ctx.parallelize([("a", "v1")], 1).saveAsTextFileByKey(out)
+    part = os.path.join(out, "a", "part-00000")
+    assert open(part).read() == "v1\n"
+    ctx.parallelize([("a", "v2")], 1).saveAsTextFileByKey(out)
+    assert open(part).read() == "v2\n"            # overwrite default
+    ctx.parallelize([("a", "v3")], 1) \
+       .saveAsTextFileByKey(out, overwrite=False)
+    assert open(part).read() == "v2\n"            # kept
+    for root, _, files in os.walk(out):
+        for f in files:
+            assert not f.startswith(".tmp-"), "tmp litter: %s" % f
+
+
 def test_sort_shuffle_conf(ctx):
     from dpark_tpu import conf
     old = conf.SORT_SHUFFLE
