@@ -208,7 +208,10 @@ def main():
     gbps_chip = BYTES / t_tpu / 1e9 / ndev
     gbps_proc = BYTES / t_proc / 1e9
     out = {
-        "metric": "reduceByKey_GBps_per_chip",
+        # a distinct metric name for the emulated fallback: a consumer
+        # keying on the real metric never ingests a CPU-emulation number
+        "metric": ("reduceByKey_GBps_per_chip_EMULATED_CPU" if emulated
+                   else "reduceByKey_GBps_per_chip"),
         "value": round(gbps_chip, 4),
         "unit": "GB/s/chip",
         "vs_baseline": round(t_proc / t_tpu, 2),

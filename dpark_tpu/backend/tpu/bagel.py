@@ -1,0 +1,432 @@
+"""Device-native Bagel: the Pregel superstep as fused XLA programs.
+
+Reference: dpark/bagel.py superstep loop (SURVEY.md 3.2).  The survey's
+[H] TPU mapping is implemented literally: messages ride a hash(dst)
+all_to_all, the message combine is a monoid segment reduction, the
+global aggregator is a psum over the mesh axis, and the halting counters
+come back to the host loop each superstep.
+
+Vertex state is columnar — int64 ids, numeric value leaves, bool active
+flags — sharded over the mesh by hash(id), so hash-routed messages land
+on the device that owns their target.  Edges are stored with their
+SOURCE vertex, making message generation a local gather; the per-edge
+messages are pre-combined per destination (the Combiner optimization)
+before the exchange.  The Python superstep loop stays on the host,
+exactly like the reference; everything between two host iterations is
+three jitted shard_map programs plus the count-exchange rounds.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dpark_tpu import conf
+from dpark_tpu.bagel import (
+    PREGEL_MONOIDS, PregelInputError, as_leaves, monoid_identity,
+    rewrap)
+from dpark_tpu.backend.tpu import collectives, layout
+from dpark_tpu.backend.tpu.executor import _shard_map
+from dpark_tpu.utils.log import get_logger
+from dpark_tpu.utils.phash import phash_np
+
+logger = get_logger("tpu.bagel")
+
+AXIS = conf.MESH_AXIS
+_SENT = np.iinfo(np.int64).max
+
+
+def _local_reduce(kind, x):
+    return {"add": jnp.sum, "min": jnp.min,
+            "max": jnp.max, "mul": jnp.prod}[kind](x)
+
+
+def _axis_reduce(kind, x):
+    """Cross-device reduction of a per-device scalar (the psum of the
+    survey mapping; min/max ride pmin/pmax, mul gathers — it's one
+    scalar per device)."""
+    if kind == "add":
+        return lax.psum(x, AXIS)
+    if kind == "min":
+        return lax.pmin(x, AXIS)
+    if kind == "max":
+        return lax.pmax(x, AXIS)
+    return jnp.prod(lax.all_gather(x, AXIS))
+
+
+class DevicePregel:
+    """One Pregel run over the executor's mesh.  See bagel.run_pregel for
+    the user-facing contract."""
+
+    def __init__(self, executor, ids, values, edges, compute, send,
+                 combine="add", edge_values=None, active=None,
+                 initial_messages=None, aggregator=None,
+                 max_superstep=80):
+        if combine not in PREGEL_MONOIDS:
+            raise ValueError(
+                "combine must be one of %s" % (PREGEL_MONOIDS,))
+        self.ex = executor
+        self.ndev = executor.ndev
+        self.mesh = executor.mesh
+        self.compute = compute
+        self.send = send
+        self.combine = combine
+        self.aggregator = aggregator
+        self.max_superstep = max_superstep
+        self._compiled = {}
+        self._setup(ids, values, edges, edge_values, active,
+                    initial_messages)
+
+    # ------------------------------------------------------------------
+    # host-side setup: partition vertices by hash(id), edges by source
+    # ------------------------------------------------------------------
+    def _setup(self, ids, values, edges, edge_values, active, init_msgs):
+        ndev = self.ndev
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64))
+        n = ids.shape[0]
+        if np.unique(ids).shape[0] != n:
+            raise PregelInputError("vertex ids must be unique")
+        if n and int(ids.max()) == _SENT:
+            raise PregelInputError(
+                "vertex id equals the padding sentinel")
+        vleaves, self.v_tuple = as_leaves(values)
+        vleaves = [np.asarray(l) for l in vleaves]
+        act = (np.ones(n, bool) if active is None
+               else np.asarray(active, bool))
+
+        vdev = (phash_np(ids) % np.uint32(ndev)).astype(np.int64)
+        sid = np.argsort(ids)
+        sorted_ids = ids[sid]
+
+        src, dst = np.asarray(edges[0], np.int64), \
+            np.asarray(edges[1], np.int64)
+        eleaves, self.e_tuple = ((None, False) if edge_values is None
+                                 else as_leaves(edge_values))
+        eleaves = [np.asarray(l) for l in eleaves] if eleaves else []
+        pos = np.searchsorted(sorted_ids, src)
+        pos = np.clip(pos, 0, max(0, n - 1))
+        src_idx = sid[pos] if n else pos
+        if n == 0 or not np.array_equal(ids[src_idx], src):
+            raise PregelInputError("edge source not in vertex ids")
+        deg = np.bincount(src_idx, minlength=n)
+        edev = vdev[src_idx]
+
+        # per-device vertex tables, sorted by id (searchsorted
+        # alignment).  One lexsort by (device, id) gives contiguous
+        # per-device runs — no O(n*ndev) mask scans.
+        vorder = np.lexsort((ids, vdev))
+        vbounds = np.searchsorted(vdev[vorder], np.arange(ndev + 1))
+        vcnt = np.diff(vbounds).astype(np.int32)
+        self.cap_v = layout.round_capacity(int(vcnt.max()) if n else 1)
+        vid = np.full((ndev, self.cap_v), _SENT, np.int64)
+        h_vals = [np.zeros((ndev, self.cap_v) + l.shape[1:], l.dtype)
+                  for l in vleaves]
+        h_act = np.zeros((ndev, self.cap_v), bool)
+        # device-local sorted position of every vertex (for edge gather)
+        local_slot = np.zeros(n, np.int64)
+        local_slot[vorder] = np.arange(n) - vbounds[vdev[vorder]]
+        for d in range(ndev):
+            lo, hi = int(vbounds[d]), int(vbounds[d + 1])
+            c = hi - lo
+            if not c:
+                continue
+            sel = vorder[lo:hi]
+            vid[d, :c] = ids[sel]
+            for hl, l in zip(h_vals, vleaves):
+                hl[d, :c] = l[sel]
+            h_act[d, :c] = act[sel]
+
+        # per-device edge tables, living with their source vertex
+        eorder = np.argsort(edev, kind="stable")
+        ebounds = np.searchsorted(edev[eorder], np.arange(ndev + 1))
+        ecnt = np.diff(ebounds).astype(np.int32)
+        self.cap_e = layout.round_capacity(
+            int(ecnt.max()) if src.size else 1)
+        e_dst = np.full((ndev, self.cap_e), _SENT, np.int64)
+        e_slot = np.zeros((ndev, self.cap_e), np.int32)
+        e_deg = np.ones((ndev, self.cap_e), np.int64)
+        h_evals = [np.zeros((ndev, self.cap_e) + l.shape[1:], l.dtype)
+                   for l in eleaves]
+        for d in range(ndev):
+            lo, hi = int(ebounds[d]), int(ebounds[d + 1])
+            c = hi - lo
+            if not c:
+                continue
+            sel = eorder[lo:hi]
+            e_dst[d, :c] = dst[sel]
+            e_slot[d, :c] = local_slot[src_idx[sel]]
+            e_deg[d, :c] = deg[src_idx[sel]]
+            for hl, l in zip(h_evals, eleaves):
+                hl[d, :c] = l[sel]
+
+        sh = self._sharding()
+        put = lambda a: jax.device_put(a, sh)       # noqa: E731
+        self.vid = put(vid)
+        self.vcnt = put(vcnt)
+        self.values = [put(l) for l in h_vals]
+        self.active = put(h_act)
+        self.e_dst = put(e_dst)
+        self.e_slot = put(e_slot)
+        self.e_deg = put(e_deg)
+        self.e_vals = [put(l) for l in h_evals]
+        self.ecnt = put(ecnt)
+
+        # message leaf specs, discovered by tracing `send` once
+        e_structs = [jax.ShapeDtypeStruct((), l.dtype) for l in eleaves]
+        v_structs = [jax.ShapeDtypeStruct((), l.dtype) for l in vleaves]
+        out = jax.eval_shape(
+            lambda sv, ev, dg: self.send(
+                rewrap(list(sv), self.v_tuple),
+                rewrap(list(ev), self.e_tuple) if eleaves else None, dg),
+            tuple(v_structs), tuple(e_structs),
+            jax.ShapeDtypeStruct((), np.int64))
+        m_leaves, self.m_tuple = as_leaves(out)
+        for s in m_leaves:
+            if s.shape != ():
+                raise PregelInputError("message leaves must be scalars")
+        self.msg_dtypes = [np.dtype(s.dtype) for s in m_leaves]
+
+        # initial messages, routed to their target's device
+        self.init = None
+        if init_msgs is not None:
+            idst = np.asarray(init_msgs[0], np.int64)
+            ivls, _ = as_leaves(init_msgs[1])
+            ivls = [np.asarray(l) for l in ivls]
+            if idst.size:
+                if len(ivls) != len(self.msg_dtypes):
+                    raise PregelInputError(
+                        "initial message leaves mismatch: got %d, send "
+                        "produces %d" % (len(ivls),
+                                         len(self.msg_dtypes)))
+                mdev = (phash_np(idst) % np.uint32(self.ndev)) \
+                    .astype(np.int64)
+                mc = np.bincount(mdev, minlength=ndev)
+                cap_m = layout.round_capacity(int(mc.max() or 1))
+                hm_d = np.full((ndev, cap_m), _SENT, np.int64)
+                hm_v = [np.zeros((ndev, cap_m), dt)
+                        for dt in self.msg_dtypes]
+                mcnt = np.zeros(ndev, np.int32)
+                for d in range(ndev):
+                    m = mdev == d
+                    c = int(m.sum())
+                    mcnt[d] = c
+                    if c:
+                        hm_d[d, :c] = idst[m]
+                        for hl, l in zip(hm_v, ivls):
+                            hl[d, :c] = l[m].astype(hl.dtype)
+                self.init = (put(mcnt), put(hm_d),
+                             [put(l) for l in hm_v])
+
+    def _sharding(self):
+        return NamedSharding(self.mesh, P(AXIS))
+
+    # ------------------------------------------------------------------
+    # the three programs
+    # ------------------------------------------------------------------
+    def _jit(self, key, fn, n_in, n_out):
+        if key not in self._compiled:
+            wrapped = _shard_map(fn, self.mesh,
+                                 in_specs=(P(AXIS),) * n_in,
+                                 out_specs=(P(AXIS),) * n_out)
+            self._compiled[key] = jax.jit(wrapped)
+        return self._compiled[key]
+
+    def _p_init(self):
+        """Bucketize the user's initial messages by hash(dst)."""
+        ndev = self.ndev
+        combine = self.combine
+        nm = len(self.msg_dtypes)
+
+        def per_device(mcnt, mdst, *mvals):
+            m, d = mcnt[0], mdst[0]
+            vs = [v[0] for v in mvals]
+            kk, vv, counts, offsets = collectives.bucketize_combine(
+                d, vs, m, ndev, None, monoid=combine)
+            out = (counts, offsets, kk) + tuple(vv)
+            return tuple(jnp.expand_dims(o, 0) for o in out)
+
+        return self._jit(("init",), per_device, 2 + nm, 3 + nm)
+
+    def _p_gen(self):
+        """Generate per-edge messages from the current vertex state,
+        pre-combine per destination, bucketize by hash(dst)."""
+        ndev = self.ndev
+        cap_e = self.cap_e
+        combine = self.combine
+        nv = len(self.values)
+        ne = len(self.e_vals)
+
+        def per_device(vcnt, act, edst, eslot, edeg, ecnt, *rest):
+            a = act[0]
+            slot = eslot[0]
+            vals = [v[0] for v in rest[:nv]]
+            evs = [v[0] for v in rest[nv:]]
+            ev = jnp.arange(cap_e) < ecnt[0]
+            sv = [v[slot] for v in vals]
+            sa = a[slot] & ev
+            msg = self.send(
+                rewrap(sv, self.v_tuple),
+                rewrap(evs, self.e_tuple) if ne else None, edeg[0])
+            m_leaves, _ = as_leaves(msg)
+            m_leaves = [jnp.broadcast_to(jnp.asarray(l), (cap_e,))
+                        for l in m_leaves]
+            dstk = jnp.where(sa, edst[0], collectives._sentinel(jnp.int64))
+            packed, cnt = collectives.compact([dstk] + m_leaves, sa)
+            kk, vv, counts, offsets = collectives.bucketize_combine(
+                packed[0], packed[1:], cnt, ndev, None, monoid=combine)
+            out = (counts, offsets, kk) + tuple(vv) + (
+                jnp.reshape(cnt, (1,)),)
+            return tuple(jnp.expand_dims(o, 0) for o in out)
+
+        nm = len(self.msg_dtypes)
+        return self._jit(("gen",), per_device, 6 + nv + ne, 4 + nm)
+
+    def _p_step(self, rounds, slot):
+        """Deliver combined messages, run the vertex compute, count the
+        still-active vertices.  aggregated (if any) is computed from the
+        PRE-compute state and psum'd across the mesh."""
+        cap_v = self.cap_v
+        combine = self.combine
+        nv = len(self.values)
+        nm = len(self.msg_dtypes)
+        nleaves = 1 + nm                        # dst key + msg leaves
+
+        def per_device(sstep, vcnt, vid, act, *rest):
+            s = sstep[0]
+            cnt = vcnt[0]
+            ids = vid[0]
+            a = act[0]
+            vals = [v[0] for v in rest[:nv]]
+            valid_v = jnp.arange(cap_v) < cnt
+
+            ag = None
+            if self.aggregator is not None:
+                create, amon = self.aggregator
+                a_leaves, a_tuple = as_leaves(
+                    create(rewrap(vals, self.v_tuple)))
+                glob = []
+                for leaf in a_leaves:
+                    ident = monoid_identity(amon, leaf.dtype)
+                    masked = jnp.where(
+                        collectives._bcast(valid_v, leaf), leaf, ident)
+                    glob.append(_axis_reduce(
+                        amon, _local_reduce(amon, masked)))
+                ag = rewrap(glob, a_tuple)
+
+            if rounds:
+                cnts = [c[0] for c in rest[nv:nv + rounds]]
+                bufs = rest[nv + rounds:]
+                recvs = []
+                for r in range(rounds):
+                    recvs.append([bufs[r * nleaves + li][0]
+                                  for li in range(nleaves)])
+                flat, mask = collectives.flatten_received(recvs, cnts)
+                uk, uv, _ = collectives.segment_reduce(
+                    flat[0], flat[1:], mask, None, monoid=combine)
+                pos = jnp.clip(jnp.searchsorted(uk, ids), 0,
+                               uk.shape[0] - 1)
+                has = (uk[pos] == ids) & valid_v \
+                    & (ids != collectives._sentinel(jnp.int64))
+                msg = [jnp.where(has, u[pos],
+                                 monoid_identity(combine, dt))
+                       for u, dt in zip(uv, self.msg_dtypes)]
+            else:
+                has = jnp.zeros(cap_v, bool)
+                msg = [jnp.full(cap_v, monoid_identity(combine, dt), dt)
+                       for dt in self.msg_dtypes]
+
+            nv_, na_ = self.compute(
+                rewrap(vals, self.v_tuple),
+                rewrap(msg, self.m_tuple), has, a & valid_v, ag, s)
+            new_leaves, _ = as_leaves(nv_)
+            new_act = jnp.broadcast_to(
+                jnp.asarray(na_, bool), (cap_v,)) & valid_v
+            new_leaves = [
+                jnp.where(collectives._bcast(valid_v, l), l,
+                          jnp.zeros((), l.dtype))
+                for l in [jnp.broadcast_to(l, (cap_v,) + l.shape[1:])
+                          for l in new_leaves]]
+            n_active = jnp.sum(new_act).astype(jnp.int32)
+            out = tuple(new_leaves) + (new_act,
+                                       jnp.reshape(n_active, (1,)))
+            return tuple(jnp.expand_dims(o, 0) for o in out)
+
+        n_in = 4 + nv + rounds + rounds * nleaves
+        return self._jit(("step", rounds, slot), per_device,
+                         n_in, nv + 2)
+
+    # ------------------------------------------------------------------
+    def run(self):
+        nv = len(self.values)
+        nm = len(self.msg_dtypes)
+        sh = self._sharding()
+        pending = None            # (counts, offsets, kk, vv) bucketized
+        total_msgs = 0
+        if self.init is not None:
+            mcnt, mdst, mvals = self.init
+            outs = self._p_init()(mcnt, mdst, *mvals)
+            pending = (outs[0], outs[1], outs[2], list(outs[3:]))
+            total_msgs = int(np.asarray(
+                jax.device_get(outs[0])).sum())
+
+        s = 0
+        n_active = None
+        while s < self.max_superstep:
+            sstep = jax.device_put(
+                np.full((self.ndev,), s, np.int32), sh)
+            if pending is not None and total_msgs > 0:
+                counts, offsets, kk, vv = pending
+                recv_rounds, cnt_rounds, slot = self.ex._exchange_all(
+                    [kk] + vv, counts, offsets)
+                rounds = len(recv_rounds)
+                step = self._p_step(rounds, slot)
+                args = [sstep, self.vcnt, self.vid, self.active] \
+                    + self.values + list(cnt_rounds)
+                for r in range(rounds):
+                    args.extend(recv_rounds[r])
+            else:
+                step = self._p_step(0, 0)
+                args = [sstep, self.vcnt, self.vid, self.active] \
+                    + self.values
+            outs = step(*args)
+            self.values = list(outs[:nv])
+            self.active = outs[nv]
+            n_active = int(np.asarray(
+                jax.device_get(outs[nv + 1])).sum())
+
+            gouts = self._p_gen()(
+                self.vcnt, self.active, self.e_dst, self.e_slot,
+                self.e_deg, self.ecnt, *(self.values + self.e_vals))
+            pending = (gouts[0], gouts[1], gouts[2],
+                       list(gouts[3:3 + nm]))
+            total_msgs = int(np.asarray(
+                jax.device_get(gouts[3 + nm])).sum())
+            s += 1
+            logger.debug("superstep %d: active=%d msgs=%d",
+                         s, n_active, total_msgs)
+            if n_active == 0 and total_msgs == 0:
+                break
+        return self._collect()
+
+    def _collect(self):
+        """Pull the final state to host, unpad, sort by id."""
+        vid = np.asarray(jax.device_get(self.vid))
+        vcnt = np.asarray(jax.device_get(self.vcnt))
+        vals = [np.asarray(jax.device_get(l)) for l in self.values]
+        act = np.asarray(jax.device_get(self.active))
+        ids, leaves, actv = [], [[] for _ in vals], []
+        for d in range(self.ndev):
+            c = int(vcnt[d])
+            ids.append(vid[d, :c])
+            for i, l in enumerate(vals):
+                leaves[i].append(l[d, :c])
+            actv.append(act[d, :c])
+        ids = np.concatenate(ids) if ids else np.zeros(0, np.int64)
+        order = np.argsort(ids)
+        leaves = [np.concatenate(ls)[order] for ls in leaves]
+        return (ids[order],
+                rewrap(leaves, self.v_tuple),
+                np.concatenate(actv)[order] if actv
+                else np.zeros(0, bool))

@@ -1,4 +1,4 @@
-"""Bagel: Pregel-style BSP graph processing on RDDs.
+"""Bagel: Pregel-style BSP graph processing.
 
 Reference parity: dpark/bagel.py (SURVEY.md sections 2.3 and 3.2) — the
 superstep loop cogroups vertices with inbound messages, applies the user
@@ -7,12 +7,22 @@ messages), optionally pre-combines messages per target (Combiner) and
 reduces a global Aggregator over all vertices each superstep; halts when
 every vertex is inactive and no messages remain.
 
-TPU mapping (SURVEY.md 3.2): each superstep is ordinary RDD algebra —
-cogroup (shuffle) + mapValue + flatMap — so on the tpu master the message
-combine rides the device segmented-reduce and the halting counters are a
-psum-style accumulator.  The Python loop stays on the host, exactly like
-the reference.
+Two execution models:
+
+* `Bagel.run` — the reference's object contract (Vertex/Message/Edge
+  Python objects, arbitrary compute).  Runs as RDD algebra on every
+  master; on the tpu master this is a HOST path (objects are
+  untraceable) and a warning says so.
+* `run_pregel` — the TPU-native contract (SURVEY.md 3.2 [H] mapping):
+  columnar vertex state, edge-centric vectorized compute/send, monoid
+  message combine.  On the tpu master each superstep runs as fused
+  shard_map programs (hash-dst all_to_all for messages, segment reduce
+  for the combine, psum for the aggregator and halting counters); on
+  local/process masters an equivalent vectorized numpy loop is the
+  golden model.
 """
+
+import numpy as np
 
 from dpark_tpu.utils.log import get_logger
 
@@ -99,6 +109,11 @@ class Bagel:
         superstep = 0
         combiner = combiner or Combiner()
         numSplits = numSplits or len(verts.splits)
+        if getattr(ctx.scheduler, "executor", None) is not None:
+            logger.warning(
+                "Bagel.run with object vertices executes on the HOST "
+                "path even on the tpu master; use bagel.run_pregel for "
+                "the device-native superstep")
 
         while superstep < max_superstep:
             logger.debug("superstep %d", superstep)
@@ -202,3 +217,220 @@ def _fst_of_pair(pair):
 
 def _identity(x):
     return x
+
+
+# ----------------------------------------------------------------------
+# TPU-native Pregel (SURVEY.md 3.2 [H] mapping): columnar vertex state,
+# vectorized edge-centric compute/send, monoid message combine
+# ----------------------------------------------------------------------
+
+PREGEL_MONOIDS = ("add", "min", "max", "mul")
+
+
+class PregelInputError(ValueError):
+    """Invalid run_pregel input (bad ids/edges/messages).  Never triggers
+    the silent device->host fallback: the input is wrong on both paths."""
+
+
+def as_leaves(x):
+    """(leaves, was_tuple) for a single-array-or-tuple user value."""
+    if isinstance(x, (tuple, list)):
+        return list(x), True
+    return [x], False
+
+
+def rewrap(leaves, was_tuple):
+    return tuple(leaves) if was_tuple else leaves[0]
+
+
+def monoid_identity(kind, dtype):
+    """Identity element so absent messages are a no-op under combine."""
+    dt = np.dtype(dtype)
+    if kind == "add":
+        return dt.type(0)
+    if kind == "mul":
+        return dt.type(1)
+    if dt.kind == "f":
+        return dt.type(np.inf if kind == "min" else -np.inf)
+    return np.iinfo(dt).max if kind == "min" else np.iinfo(dt).min
+
+
+_NP_COMBINE = {"add": np.add, "min": np.minimum,
+               "max": np.maximum, "mul": np.multiply}
+_NP_REDUCE = {"add": np.sum, "min": np.min,
+              "max": np.max, "mul": np.prod}
+
+
+def run_pregel(ctx, ids, values, edges, compute, send, combine="add",
+               edge_values=None, active=None, initial_messages=None,
+               aggregator=None, max_superstep=80):
+    """Vectorized Pregel — the device-native Bagel.
+
+    ids:     (n,) int array of unique vertex ids
+    values:  (n,) array or tuple of (n, ...) arrays — vertex state
+    edges:   (src_ids, dst_ids) int arrays; each edge lives with its
+             source, messages flow along it to dst
+    compute(values, msg, has_msg, active, aggregated, superstep)
+             -> (new_values, new_active): applied BLOCKWISE — every
+             argument is an array over a whole block of vertices (all of
+             them on the host path, one device's block on the tpu
+             master), so it must be written with vectorized/elementwise
+             array ops (jnp or np arithmetic, where(), comparisons) —
+             no Python control flow on the data.  `msg` holds the
+             combined inbound message per vertex (the monoid identity
+             where has_msg is False); `superstep` is a scalar.
+    send(src_values, edge_values, src_degree) -> per-edge message value
+             (scalar leaf or tuple of scalar leaves), same blockwise
+             contract over edges; only edges whose source is active
+             after compute actually send.
+    combine: message-combine monoid: "add" | "min" | "max" | "mul"
+    aggregator: None or (create(values) -> leaf/tuple, monoid): global
+             per-superstep reduce over the PRE-compute vertex state,
+             visible to compute as `aggregated` the same superstep
+    initial_messages: None or (dst_ids, msg_values) delivered at
+             superstep 0
+
+    Halts when no vertex is active and no messages are pending, or at
+    max_superstep.  Returns (ids, values, active) sorted by id (numpy).
+
+    On the tpu master the superstep runs as fused shard_map programs
+    over the device mesh (backend/tpu/bagel.py); other masters use the
+    equivalent vectorized numpy loop below (the golden model).
+    """
+    if combine not in PREGEL_MONOIDS:
+        raise ValueError("combine must be one of %s" % (PREGEL_MONOIDS,))
+    ctx.start()
+    ex = getattr(ctx.scheduler, "executor", None)
+    if ex is not None:
+        try:
+            from dpark_tpu.backend.tpu.bagel import DevicePregel
+            out = DevicePregel(
+                ex, ids, values, edges, compute, send, combine=combine,
+                edge_values=edge_values, active=active,
+                initial_messages=initial_messages, aggregator=aggregator,
+                max_superstep=max_superstep).run()
+            ctx.scheduler._pregel_device_used = True
+            return out
+        except PregelInputError:
+            raise                  # wrong on both paths: surface it
+        except Exception as e:
+            logger.warning("device Pregel unavailable (%s); host path", e)
+            ctx.scheduler._pregel_device_used = False
+    return _pregel_host(ids, values, edges, compute, send, combine,
+                        edge_values, active, initial_messages,
+                        aggregator, max_superstep)
+
+
+def _pregel_host(ids, values, edges, compute, send, combine,
+                 edge_values, active, initial_messages, aggregator,
+                 max_superstep):
+    """Single-host vectorized Pregel: the golden model for the device
+    implementation, pure numpy."""
+    ids = np.asarray(ids, np.int64)
+    n = ids.shape[0]
+    if np.unique(ids).shape[0] != n:
+        raise PregelInputError("vertex ids must be unique")
+    order = np.argsort(ids)
+    ids = ids[order]
+    vleaves, v_tuple = as_leaves(values)
+    vleaves = [np.asarray(l)[order] for l in vleaves]
+    act = np.ones(n, bool) if active is None \
+        else np.asarray(active, bool)[order]
+
+    src = np.asarray(edges[0], np.int64)
+    dst = np.asarray(edges[1], np.int64)
+    eleaves, e_tuple = ((None, False) if edge_values is None
+                        else as_leaves(edge_values))
+    eleaves = [np.asarray(l) for l in eleaves] if eleaves else []
+    src_idx = np.searchsorted(ids, src)
+    src_idx = np.clip(src_idx, 0, max(0, n - 1))
+    if n == 0 or not np.array_equal(ids[src_idx], src):
+        raise PregelInputError("edge source not in vertex ids")
+    deg = np.bincount(src_idx, minlength=n)
+
+    # message dtypes, discovered by probing `send` on empty slices (the
+    # host twin of the device path's eval_shape)
+    if src.size:
+        probe = send(rewrap([l[:0] for l in vleaves], v_tuple),
+                     rewrap([l[:0] for l in eleaves], e_tuple)
+                     if eleaves else None, deg[:0])
+        m_probe, m_tuple = as_leaves(probe)
+        msg_dtypes = [np.asarray(l).dtype for l in m_probe]
+    else:
+        m_tuple = False
+        msg_dtypes = [np.dtype(np.float64)]
+
+    def deliver(pdst, pvals):
+        """Combine pending messages per target; unknown targets drop
+        (parity with the object path)."""
+        pos = np.searchsorted(ids, pdst)
+        pos = np.clip(pos, 0, max(0, n - 1))
+        known = ids[pos] == pdst
+        pos = pos[known]
+        bufs = []
+        for l in pvals:
+            buf = np.full(n, monoid_identity(combine, l.dtype), l.dtype)
+            _NP_COMBINE[combine].at(buf, pos, l[known])
+            bufs.append(buf)
+        has = np.bincount(pos, minlength=n) > 0
+        return bufs, has
+
+    pending = None
+    if initial_messages is not None:
+        idst = np.asarray(initial_messages[0], np.int64)
+        ivls, _ = as_leaves(initial_messages[1])
+        if idst.size and len(ivls) != len(msg_dtypes):
+            raise PregelInputError(
+                "initial message leaves mismatch: got %d, send "
+                "produces %d" % (len(ivls), len(msg_dtypes)))
+        pending = (idst, [np.asarray(l, dt)
+                          for l, dt in zip(ivls, msg_dtypes)])
+
+    s = 0
+    while s < max_superstep:
+        aggregated = None
+        if aggregator is not None:
+            create, amon = aggregator
+            a_leaves, a_tuple = as_leaves(
+                create(rewrap(vleaves, v_tuple)))
+            aggregated = rewrap(
+                [_NP_REDUCE[amon](np.asarray(l)) for l in a_leaves],
+                a_tuple)
+
+        if pending is not None and pending[0].size:
+            msg_leaves, has = deliver(*pending)
+        else:
+            msg_leaves = [np.full(n, monoid_identity(combine, dt), dt)
+                          for dt in msg_dtypes]
+            has = np.zeros(n, bool)
+        nv_, na_ = compute(rewrap(vleaves, v_tuple),
+                           rewrap(msg_leaves, m_tuple), has, act,
+                           aggregated, s)
+        new_leaves, _ = as_leaves(nv_)
+        vleaves = [np.broadcast_to(np.asarray(l), (n,) +
+                                   np.asarray(l).shape[1:]).copy()
+                   if np.asarray(l).shape[:1] != (n,)
+                   else np.asarray(l) for l in new_leaves]
+        act = np.broadcast_to(np.asarray(na_, bool), (n,)).copy()
+
+        src_mask = act[src_idx] if src.size else np.zeros(0, bool)
+        if src.size:
+            msg = send(rewrap([l[src_idx] for l in vleaves], v_tuple),
+                       rewrap([l for l in eleaves], e_tuple)
+                       if eleaves else None,
+                       deg[src_idx])
+            m_leaves, m_tuple = as_leaves(msg)
+            m_leaves = [np.broadcast_to(
+                np.asarray(l), (src.size,)).copy() for l in m_leaves]
+            pending = (dst[src_mask],
+                       [l[src_mask] for l in m_leaves])
+        else:
+            pending = (np.zeros(0, np.int64), [])
+        n_active = int(act.sum())
+        n_msgs = int(src_mask.sum())
+        s += 1
+        logger.debug("host superstep %d: active=%d msgs=%d",
+                     s, n_active, n_msgs)
+        if n_active == 0 and n_msgs == 0:
+            break
+    return ids, rewrap(vleaves, v_tuple), act

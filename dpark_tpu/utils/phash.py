@@ -76,6 +76,29 @@ def portable_hash(obj):
     return _hash_bytes(pickle.dumps(obj, 4))
 
 
+def phash_np(keys):
+    """NumPy twin of phash_device: bulk host-side hashing of an int array
+    -> uint32 array, bit-identical to portable_hash/phash_device.  Used
+    for host-side vertex partitioning (device Bagel setup) so state lands
+    on the device that hash-routed messages will reach."""
+    import numpy as np
+    keys = np.asarray(keys)
+    if keys.dtype == np.int64:
+        lo = (keys & np.int64(0xFFFFFFFF)).astype(np.uint32)
+        hi = ((keys >> 32) & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    else:
+        k = keys.astype(np.int32)
+        lo = k.astype(np.uint32)
+        hi = (k >> 31).astype(np.uint32)       # 0 or 0xFFFFFFFF
+    h = lo ^ hi
+    h ^= h >> 16
+    h = h * np.uint32(_M1)
+    h ^= h >> 13
+    h = h * np.uint32(_M2)
+    h ^= h >> 16
+    return h
+
+
 def phash_device(keys):
     """Device-side portable hash of an int array -> uint32 array.
 

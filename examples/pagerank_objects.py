@@ -1,0 +1,55 @@
+"""PageRank via the reference's OBJECT Bagel contract (host path on
+every master; kept for API parity — see examples/pagerank.py for the
+device-native formulation).
+
+Usage: python examples/pagerank_objects.py [-m local|process|tpu]
+"""
+
+import operator
+
+from dpark_tpu import DparkContext, parse_options
+from dpark_tpu.bagel import Bagel, BasicCombiner, Edge, Message, Vertex
+
+
+class PageRank:
+    def __init__(self, n, damping=0.85, steps=20):
+        self.n = n
+        self.damping = damping
+        self.steps = steps
+
+    def __call__(self, vert, msg_sum, agg, superstep):
+        if superstep == 0:
+            value = vert.value
+        else:
+            value = ((1 - self.damping) / self.n
+                     + self.damping * (msg_sum or 0.0))
+        active = superstep < self.steps
+        v = Vertex(vert.id, value, vert.outEdges, active)
+        if active and vert.outEdges:
+            share = value / len(vert.outEdges)
+            return (v, [Message(e.target_id, share) for e in vert.outEdges])
+        return (v, [])
+
+
+def main():
+    options = parse_options()
+    ctx = DparkContext(options.master)
+    # a small ring-with-chords graph
+    n = 64
+    links = {i: [(i + 1) % n, (i * 7 + 3) % n] for i in range(n)}
+    verts = ctx.parallelize(
+        [(i, Vertex(i, 1.0 / n, [Edge(t) for t in targets]))
+         for i, targets in links.items()], 4)
+    msgs = ctx.parallelize([], 4)
+    final = Bagel.run(ctx, verts, msgs, PageRank(n),
+                      combiner=BasicCombiner(operator.add))
+    ranks = sorted(((v.value, vid) for vid, v in final.collect()),
+                   reverse=True)
+    print("total rank: %.4f" % sum(r for r, _ in ranks))
+    for r, vid in ranks[:5]:
+        print("  %3d: %.5f" % (vid, r))
+    ctx.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,282 @@
+"""Device-native Pregel (bagel.run_pregel): every test asserts the tpu
+master's fused-superstep output == the vectorized host golden model (and,
+for PageRank, == the reference object-Bagel formulation)."""
+
+import numpy as np
+import pytest
+
+from dpark_tpu.bagel import _pregel_host, run_pregel
+
+
+@pytest.fixture()
+def tctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu")
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ring_graph(n):
+    ids = np.arange(n, dtype=np.int64)
+    src = np.repeat(ids, 2)
+    dst = np.stack([(ids + 1) % n, (ids * 7 + 3) % n], 1).reshape(-1)
+    return ids, src, dst
+
+
+def _pagerank_fns(n, damping=0.85, steps=20):
+    def compute(value, msg, has_msg, active, agg, superstep):
+        is0 = superstep == 0
+        new = is0 * value + (1 - is0) * ((1 - damping) / n
+                                         + damping * msg)
+        return new, superstep < steps
+
+    def send(src_value, edge_value, src_degree):
+        return src_value / src_degree
+    return compute, send
+
+
+def test_pagerank_device_matches_host(tctx):
+    n = 64
+    ids, src, dst = _ring_graph(n)
+    values = np.full(n, 1.0 / n)
+    compute, send = _pagerank_fns(n)
+    gids, granks, _ = run_pregel(tctx, ids, values, (src, dst),
+                                 compute, send, combine="add")
+    assert tctx.scheduler._pregel_device_used
+    hids, hranks, _ = _pregel_host(ids, values, (src, dst), compute,
+                                   send, "add", None, None, None, None,
+                                   80)
+    assert np.array_equal(gids, hids)
+    assert np.allclose(granks, hranks)
+    assert abs(float(np.sum(granks)) - 1.0) < 1e-6
+
+
+def test_pagerank_matches_object_bagel(tctx):
+    """The vectorized contract reproduces the reference object-Bagel
+    numbers on the same graph."""
+    import operator
+    from dpark_tpu import DparkContext
+    from dpark_tpu.bagel import Bagel, BasicCombiner, Edge, Message, \
+        Vertex
+    n = 32
+    ids, src, dst = _ring_graph(n)
+    compute, send = _pagerank_fns(n)
+    _, granks, _ = run_pregel(tctx, ids, np.full(n, 1.0 / n),
+                              (src, dst), compute, send, combine="add")
+
+    class ObjPR:
+        def __call__(self, vert, msg_sum, agg, superstep):
+            if superstep == 0:
+                value = vert.value
+            else:
+                value = (1 - 0.85) / n + 0.85 * (msg_sum or 0.0)
+            active = superstep < 20
+            v = Vertex(vert.id, value, vert.outEdges, active)
+            if active and vert.outEdges:
+                share = value / len(vert.outEdges)
+                return (v, [Message(e.target_id, share)
+                            for e in vert.outEdges])
+            return (v, [])
+
+    lctx = DparkContext("local")
+    verts = lctx.parallelize(
+        [(int(i), Vertex(int(i), 1.0 / n,
+                         [Edge(int(t)) for t in dst[src == i]]))
+         for i in ids], 4)
+    msgs = lctx.parallelize([], 4)
+    final = Bagel.run(lctx, verts, msgs, ObjPR(),
+                      combiner=BasicCombiner(operator.add))
+    obj = dict((vid, v.value) for vid, v in final.collect())
+    lctx.stop()
+    assert np.allclose(granks, [obj[int(i)] for i in ids])
+
+
+def test_sssp_min_combine_initial_messages(tctx):
+    """Single-source shortest paths: min monoid, weighted edges, initial
+    message wakes the source, vertices halt when no improvement."""
+    rng = np.random.RandomState(7)
+    n = 50
+    ids = np.arange(n, dtype=np.int64) * 3 + 1      # non-contiguous ids
+    ne = 200
+    src = ids[rng.randint(0, n, ne)]
+    dst = ids[rng.randint(0, n, ne)]
+    w = rng.randint(1, 10, ne).astype(np.float64)
+    dist0 = np.full(n, np.inf)
+
+    def compute(dist, msg, has_msg, active, agg, superstep):
+        import jax.numpy as jnp           # works on np arrays and tracers
+        new = jnp.minimum(dist, msg)
+        return new, new < dist
+
+    def send(d, w_edge, deg):
+        return d + w_edge
+
+    init = (np.array([ids[0]]), np.array([0.0]))
+    gids, gdist, _ = run_pregel(tctx, ids, dist0, (src, dst), compute,
+                                send, combine="min", edge_values=w,
+                                initial_messages=init)
+    assert tctx.scheduler._pregel_device_used
+    hids, hdist, _ = _pregel_host(ids, dist0, (src, dst), compute, send,
+                                  "min", w, None, init, None, 80)
+    assert np.array_equal(gids, hids)
+    assert np.allclose(gdist, hdist, equal_nan=True)
+
+    # independent Bellman-Ford check
+    ref = {int(i): np.inf for i in ids}
+    ref[int(ids[0])] = 0.0
+    for _ in range(n):
+        for s, d, ww in zip(src, dst, w):
+            if ref[int(s)] + ww < ref[int(d)]:
+                ref[int(d)] = ref[int(s)] + ww
+    assert np.allclose(gdist, [ref[int(i)] for i in gids],
+                       equal_nan=True)
+
+
+def test_aggregator_psum(tctx):
+    """aggregated = global reduce over the PRE-compute state, visible to
+    compute the same superstep."""
+    n = 40
+    ids = np.arange(n, dtype=np.int64)
+    src = ids
+    dst = (ids + 1) % n
+    values = np.arange(n, dtype=np.float64)
+
+    def compute(value, msg, has_msg, active, agg, superstep):
+        return value * 0 + agg, superstep < 1      # value' = global sum
+
+    def send(v, e, deg):
+        return v * 0.0
+
+    agg = (lambda v: v, "add")
+    gids, gvals, _ = run_pregel(tctx, ids, values, (src, dst), compute,
+                                send, combine="add", aggregator=agg,
+                                max_superstep=1)
+    assert tctx.scheduler._pregel_device_used
+    assert np.allclose(gvals, np.sum(values))
+    hids, hvals, _ = _pregel_host(ids, values, (src, dst), compute,
+                                  send, "add", None, None, None, agg, 1)
+    assert np.allclose(gvals, hvals)
+
+
+def test_tuple_values_and_messages(tctx):
+    """Tuple-leaf vertex state and messages; monoid combines per leaf."""
+    n = 24
+    ids = np.arange(n, dtype=np.int64)
+    src = np.repeat(ids, 2)
+    dst = np.stack([(ids + 1) % n, (ids + 5) % n], 1).reshape(-1)
+    v0 = (np.ones(n), np.arange(n, dtype=np.int64))
+
+    def compute(values, msg, has_msg, active, agg, superstep):
+        a, b = values
+        ma, mb = msg
+        return (a + ma, b + mb), superstep < 3
+
+    def send(values, e, deg):
+        a, b = values
+        return (a * 0.5, b)
+
+    gids, gvals, _ = run_pregel(tctx, ids, v0, (src, dst), compute,
+                                send, combine="add")
+    assert tctx.scheduler._pregel_device_used
+    hids, hvals, _ = _pregel_host(ids, v0, (src, dst), compute, send,
+                                  "add", None, None, None, None, 80)
+    assert np.array_equal(gids, hids)
+    for g, h in zip(gvals, hvals):
+        assert np.allclose(g, h)
+
+
+def test_all_inactive_halts_immediately(tctx):
+    n = 8
+    ids = np.arange(n, dtype=np.int64)
+
+    def compute(value, msg, has_msg, active, agg, superstep):
+        return value, value < 0          # never active
+
+    def send(v, e, deg):
+        return v
+
+    gids, gvals, gact = run_pregel(
+        tctx, ids, np.ones(n), (ids, (ids + 1) % n), compute, send)
+    assert not gact.any()
+    assert np.allclose(gvals, 1.0)
+
+
+def test_messages_to_unknown_ids_dropped(tctx):
+    """Parity with the object path: mail to ids with no vertex vanishes."""
+    n = 8
+    ids = np.arange(n, dtype=np.int64)
+    src = ids
+    dst = np.where(ids < 4, ids + 1, 1000 + ids)    # half point nowhere
+
+    def compute(value, msg, has_msg, active, agg, superstep):
+        return value + msg, superstep < 2
+
+    def send(v, e, deg):
+        return v * 0 + 1.0
+
+    gids, gvals, _ = run_pregel(tctx, ids, np.zeros(n), (src, dst),
+                                compute, send, combine="add")
+    hids, hvals, _ = _pregel_host(ids, np.zeros(n), (src, dst), compute,
+                                  send, "add", None, None, None, None,
+                                  80)
+    assert np.allclose(gvals, hvals)
+
+
+def test_input_errors_surface_not_fallback(tctx):
+    """Invalid input raises PregelInputError on the tpu master instead
+    of silently degrading to the host path with wrong results."""
+    from dpark_tpu.bagel import PregelInputError
+    ids = np.arange(8, dtype=np.int64)
+
+    def compute(v, m, h, a, agg, s):
+        return v, s < 1
+
+    def send(v, e, deg):
+        return v
+
+    with pytest.raises(PregelInputError):        # duplicate ids
+        run_pregel(tctx, np.zeros(4, np.int64), np.ones(4),
+                   (np.zeros(1, np.int64), np.zeros(1, np.int64)),
+                   compute, send)
+    with pytest.raises(PregelInputError):        # unknown edge source
+        run_pregel(tctx, ids, np.ones(8),
+                   (np.array([99]), np.array([0])), compute, send)
+    with pytest.raises(PregelInputError):        # msg leaf mismatch
+        run_pregel(tctx, ids, np.ones(8), (ids, (ids + 1) % 8),
+                   compute, send,
+                   initial_messages=(np.array([0]),
+                                     (np.ones(1), np.ones(1))))
+
+
+def test_pregel_fuzz_host_vs_device(tctx):
+    """Random graphs / monoids: device == host on every superstep path."""
+    for seed, combine in [(1, "add"), (2, "min"), (3, "max")]:
+        rng = np.random.RandomState(seed)
+        n = rng.randint(10, 60)
+        ids = np.sort(rng.choice(10000, n, replace=False)).astype(
+            np.int64)
+        ne = rng.randint(n, 4 * n)
+        src = ids[rng.randint(0, n, ne)]
+        dst = ids[rng.randint(0, n, ne)]
+        w = rng.randint(0, 5, ne).astype(np.float64)
+        v0 = rng.randint(0, 100, n).astype(np.float64)
+        steps = int(rng.randint(1, 5))
+
+        def compute(value, msg, has_msg, active, agg, superstep,
+                    _s=steps):
+            import jax.numpy as jnp
+            return value + jnp.where(has_msg, msg, 0.0), superstep < _s
+
+        def send(v, e, deg):
+            return v * 0.25 + e
+
+        gids, gvals, _ = run_pregel(tctx, ids, v0, (src, dst), compute,
+                                    send, combine=combine,
+                                    edge_values=w)
+        assert tctx.scheduler._pregel_device_used, (seed, combine)
+        hids, hvals, _ = _pregel_host(ids, v0, (src, dst), compute,
+                                      send, combine, w, None, None,
+                                      None, 80)
+        assert np.array_equal(gids, hids)
+        assert np.allclose(gvals, hvals), (seed, combine)

@@ -2,7 +2,7 @@
 
 import numpy as np
 
-from dpark_tpu.utils.phash import portable_hash, phash_device
+from dpark_tpu.utils.phash import portable_hash, phash_device, phash_np
 
 
 def test_basic_types_deterministic():
@@ -29,6 +29,26 @@ def test_host_device_agree():
     dev = np.asarray(phash_device(keys))
     host = np.array([portable_hash(int(k)) for k in keys], dtype=np.uint64)
     assert (dev.astype(np.uint64) == host).all()
+
+
+def test_numpy_twin_bit_identical():
+    """phash_np is load-bearing for device Bagel routing: vertices are
+    partitioned with it while messages route via phash_device — any
+    divergence silently drops every message."""
+    import jax
+    jax.config.update("jax_enable_x64", True)   # device twin needs i64
+    rng = np.random.RandomState(0)
+    for dt in (np.int32, np.int64):
+        info = np.iinfo(dt)
+        keys = np.concatenate([
+            rng.randint(info.min, info.max, 500).astype(dt),
+            np.array([0, 1, -1, info.min, info.max], dt)])
+        h_np = phash_np(keys)
+        h_dev = np.asarray(phash_device(keys)).astype(np.uint32)
+        assert np.array_equal(h_np, h_dev), dt
+        h_py = np.array([portable_hash(int(k)) for k in keys],
+                        np.uint64)
+        assert np.array_equal(h_np.astype(np.uint64), h_py), dt
 
 
 def test_tuple_and_str_spread():
