@@ -108,10 +108,12 @@ class DevicePregel:
         pos = np.searchsorted(sorted_ids, src)
         pos = np.clip(pos, 0, max(0, n - 1))
         src_idx = sid[pos] if n else pos
-        if n == 0 or not np.array_equal(ids[src_idx], src):
+        if src.size and (n == 0
+                         or not np.array_equal(ids[src_idx], src)):
             raise PregelInputError("edge source not in vertex ids")
-        deg = np.bincount(src_idx, minlength=n)
-        edev = vdev[src_idx]
+        deg = np.bincount(src_idx, minlength=n) if src.size \
+            else np.zeros(n, np.int64)
+        edev = vdev[src_idx] if src.size else src_idx
 
         # per-device vertex tables, sorted by id (searchsorted
         # alignment).  One lexsort by (device, id) gives contiguous

@@ -28,6 +28,19 @@ logger = get_logger("tpu.executor")
 AXIS = conf.MESH_AXIS
 
 
+def _even_ranges(n, parts):
+    """parts contiguous [lo, hi) ranges covering n rows as evenly as
+    possible."""
+    base, extra = divmod(n, parts)
+    out = []
+    lo = 0
+    for d in range(parts):
+        hi = lo + base + (1 if d < extra else 0)
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
     try:
         from jax import shard_map as _sm
@@ -249,6 +262,9 @@ class JAXExecutor:
         """Execute the whole stage for all partitions at once.
 
         Returns ("result", list_of_row_lists) or ("shuffle", sid)."""
+        if plan.source[0] == "text":
+            outs = self._run_narrow(plan, self._ingest_text(plan))
+            return self._finish_stage(plan, outs)
         if plan.source[0] == "ingest" and self._should_stream(plan):
             return self._run_streamed_shuffle(plan)
         if plan.source[0] in ("ingest", "cached"):
@@ -289,6 +305,115 @@ class JAXExecutor:
         args = (batch.counts,) + ((bounds,) if bounds is not None
                                   else ()) + tuple(batch.cols)
         return jitted(*args)
+
+    # ------------------------------------------------------------------
+    # text-source ingest (SURVEY.md 3.1 hot loop #1): the narrow chain
+    # over a file source runs as a host prologue per split — the user's
+    # own generators (always correct) or, for the verified canonical
+    # wordcount shape, the C++ tokenizer — then string keys are
+    # dictionary-encoded and the device shuffle takes over
+    # ------------------------------------------------------------------
+    def _token_dict(self):
+        if not hasattr(self, "token_dict"):
+            from dpark_tpu.native import TokenDict
+            self.token_dict = TokenDict()
+        return self.token_dict
+
+    @staticmethod
+    def _read_text_split(text_rdd, sp):
+        """The bytes of one newline-aligned split (same boundary rule as
+        TextFileRDD.compute: skip a partial first line, finish the line
+        that crosses the end)."""
+        from dpark_tpu import file_manager
+        with file_manager.open_file(sp.path) as f:
+            begin = sp.begin
+            if begin > 0:
+                f.seek(begin - 1)
+                if f.read(1) != b"\n":
+                    f.readline()
+                begin = f.tell()
+            else:
+                f.seek(0)
+            data = f.read(sp.end - begin) if sp.end > begin else b""
+            if data and not data.endswith(b"\n"):
+                data += f.readline()
+            return data
+
+    def _verify_canonical(self, plan, data, td):
+        """Run the user's own flatMap/map on a prefix of this split and
+        compare with the C++ tokenizer: any divergence (e.g. unicode
+        whitespace the byte tokenizer doesn't split on) disables the
+        native path for this run — correctness first."""
+        prefix = data[:4096]
+        cut = prefix.rfind(b"\n")
+        prefix = b"" if cut < 0 else prefix[:cut + 1]
+        if not prefix:
+            # nothing to verify against (empty split or a >4KB first
+            # line): do NOT trust the byte tokenizer unverified
+            return False
+        fm, mp = plan.text_chain
+        expect = []
+        for line in prefix.decode("utf-8", "replace").splitlines():
+            for w in fm.f(line):
+                rec = mp.f(w)
+                if rec[1] != 1:
+                    return False
+                expect.append(rec[0])
+        got = [td.decode(int(t)) for t in td.encode(prefix)]
+        return got == expect
+
+    def _encode_rows(self, plan, top, sp, td):
+        """Host prologue for one split: run the user chain, columnarize,
+        dictionary-encode string keys."""
+        import jax.tree_util as jtu
+        keys = []
+        leaf_lists = [[] for _ in plan.in_specs[1:]]
+        encode = plan.encoded_keys
+        for rec in top.iterator(sp):
+            k, v = rec
+            keys.append(td.put(k) if encode else k)
+            for li, leaf in enumerate(jtu.tree_leaves(v)):
+                leaf_lists[li].append(leaf)
+        cols = [np.asarray(keys, np.int64)]
+        for ll, (dt, shape) in zip(leaf_lists, plan.in_specs[1:]):
+            cols.append(np.asarray(ll, dt))
+        return cols
+
+    def _ingest_text(self, plan):
+        from dpark_tpu.rdd import _ColumnarSlice
+        top = plan.stage.rdd
+        splits = top.splits
+        td = self._token_dict() if plan.encoded_keys else None
+        canonical = plan.canonical
+        chunks = []
+        for i, sp in enumerate(splits):
+            if canonical:
+                data = self._read_text_split(plan.text_rdd, sp)
+                if i == 0 and not self._verify_canonical(plan, data, td):
+                    logger.info("canonical tokenizer diverges from the "
+                                "user chain; using the host prologue")
+                    canonical = False
+                if canonical:
+                    ids = td.encode(data)
+                    chunks.append([np.asarray(ids, np.int64),
+                                   np.ones(len(ids), np.int64)])
+                    continue
+            chunks.append(self._encode_rows(plan, top, sp, td))
+        nleaves = len(plan.in_specs)
+        if chunks:
+            cols = [np.concatenate([c[li] for c in chunks])
+                    for li in range(nleaves)]
+        else:
+            cols = [np.zeros((0,) + shape, dt)
+                    for dt, shape in plan.in_specs]
+        # rows redistribute EVENLY across devices regardless of the file
+        # split layout (one big file = one split must not put everything
+        # on device 0); the hash exchange owns placement anyway.  The
+        # host bridge compensates via the store's single_map mode.
+        parts = [_ColumnarSlice([c[lo:hi] for c in cols])
+                 for lo, hi in _even_ranges(len(cols[0]), self.ndev)]
+        return layout.ingest(self.mesh, parts, plan.in_treedef,
+                             plan.in_specs, key_leaf=0)
 
     # -- HBM result cache (rdd.cache() on the device path) --------------
     def result_cache_ids(self):
@@ -347,9 +472,16 @@ class JAXExecutor:
         if plan.epilogue is None:
             counts, leaves = outs[0], list(outs[1:])
             batch = layout.Batch(plan.out_treedef, leaves, counts)
+            encoded = (plan.source[0] == "hbm"
+                       and self.shuffle_store.get(
+                           plan.source[1].shuffle_id, {})
+                       .get("encoded_keys", False))
             if plan.stage is not None \
                     and getattr(plan.stage.rdd, "should_cache", False) \
-                    and not plan.group_output:
+                    and not plan.group_output and not encoded:
+                # encoded batches never enter the result cache: a later
+                # device stage would see raw ids where the user expects
+                # strings
                 self.store_result(plan.stage.rdd.id, batch)
             rows_per_part = layout.egest(batch)
             if plan.group_output:
@@ -363,6 +495,10 @@ class JAXExecutor:
                         parts.append((k, [r[1] for r in grp]))
                     grouped.append(parts)
                 rows_per_part = grouped
+            if encoded:
+                store = self.shuffle_store[plan.source[1].shuffle_id]
+                rows_per_part = [self._maybe_decode(store, rows)
+                                 for rows in rows_per_part]
             return ("result", rows_per_part)
         dep = plan.epilogue[1]
         cnts, offs = outs[0], outs[1]
@@ -372,6 +508,11 @@ class JAXExecutor:
             "counts": cnts,              # (ndev, R)
             "offsets": offs,             # (ndev, R)
             "no_combine": fuse.is_list_agg(dep.aggregator),
+            "encoded_keys": getattr(plan, "encoded_keys", False),
+            # text ingest redistributes rows across devices, so device
+            # index != logical map partition: the host bridge reads the
+            # whole shuffle through map_id 0
+            "single_map": plan.source[0] == "text",
         })
 
     def _register_shuffle(self, dep, plan, store):
@@ -556,7 +697,8 @@ class JAXExecutor:
         store = self.shuffle_store[dep.shuffle_id]
         counts, leaves = self._exchange_sorted(dep, store)
         batch = layout.Batch(store["out_treedef"], leaves, counts)
-        return layout.egest(batch)
+        return [self._maybe_decode(store, rows)
+                for rows in layout.egest(batch)]
 
     # ------------------------------------------------------------------
     # device join: two exchanged+sorted sides expand to key-matched pairs
@@ -592,6 +734,11 @@ class JAXExecutor:
         shuffles; returns per-partition host rows (k, (va, vb))."""
         store_a = self.shuffle_store[dep_a.shuffle_id]
         store_b = self.shuffle_store[dep_b.shuffle_id]
+        if store_a.get("encoded_keys", False) != \
+                store_b.get("encoded_keys", False):
+            # ids on one side, user ints on the other: id equality would
+            # be spurious — the host path compares decoded keys
+            raise ValueError("mixed encoded/plain join keys")
         cnt_a, lv_a = self._exchange_sorted(dep_a, store_a)
         cnt_b, lv_b = self._exchange_sorted(dep_b, store_b)
         na, nb = len(lv_a), len(lv_b)
@@ -662,7 +809,14 @@ class JAXExecutor:
         joined_sample = (0, (sample_a[1], sample_b[1]))
         out_treedef = jtu.tree_structure(joined_sample)
         batch = layout.Batch(out_treedef, leaves, counts)
-        return layout.egest(batch)
+        rows_per_part = layout.egest(batch)
+        if store_a.get("encoded_keys"):
+            # both sides of a str-keyed join encode through the SAME
+            # executor dict, so id equality == string equality; decode
+            # at this host exit like every other
+            rows_per_part = [self._maybe_decode(store_a, rows)
+                             for rows in rows_per_part]
+        return rows_per_part
 
     # ------------------------------------------------------------------
     # host bridge
@@ -690,29 +844,58 @@ class JAXExecutor:
             ))[0, :cnt] for l in store["leaves"]]
             lists = [m.tolist() for m in mats]
             treedef = store["out_treedef"]
-            return [jax.tree_util.tree_unflatten(
+            rows = [jax.tree_util.tree_unflatten(
                 treedef, [pl[i] for pl in lists]) for i in range(cnt)]
+            return self._maybe_decode(store, rows)
+        if store.get("single_map"):
+            # device rows don't correspond to logical map partitions
+            # (text ingest): the whole shuffle exports through map 0
+            if map_id != 0:
+                return []
+            counts = np.asarray(jax.device_get(store["counts"]))
+            offsets = np.asarray(jax.device_get(store["offsets"]))
+            rows = []
+            for dev in range(counts.shape[0]):
+                rows.extend(self._export_one(store, dev, reduce_id,
+                                             counts, offsets))
+            return self._maybe_decode(store, rows)
         counts = np.asarray(jax.device_get(store["counts"]))
         offsets = np.asarray(jax.device_get(store["offsets"]))
-        off = int(offsets[map_id, reduce_id])
-        cnt = int(counts[map_id, reduce_id])
+        rows = self._export_one(store, map_id, reduce_id, counts,
+                                offsets)
+        return self._maybe_decode(store, rows)
+
+    @staticmethod
+    def _export_one(store, dev, reduce_id, counts, offsets):
+        """One device's bucket for one reduce partition as host rows."""
+        off = int(offsets[dev, reduce_id])
+        cnt = int(counts[dev, reduce_id])
+        if not cnt:
+            return []
         treedef = store["out_treedef"]
+        mats = [np.asarray(jax.device_get(
+            lax.slice_in_dim(l, dev, dev + 1, axis=0)
+        ))[0, off:off + cnt] for l in store["leaves"]]
+        lists = [m.tolist() for m in mats]
+        wrap = store.get("no_combine", False)
         rows = []
-        if cnt:
-            mats = [np.asarray(jax.device_get(
-                lax.slice_in_dim(l, map_id, map_id + 1, axis=0)
-            ))[0, off:off + cnt] for l in store["leaves"]]
-            lists = [m.tolist() for m in mats]
-            wrap = store.get("no_combine", False)
-            for i in range(cnt):
-                rec = jax.tree_util.tree_unflatten(
-                    treedef, [pl[i] for pl in lists])
-                if wrap:
-                    # no-combine rows are raw (k, v); the host merge
-                    # contract expects (k, combiner=[v])
-                    rec = (rec[0], [rec[1]])
-                rows.append(rec)
+        for i in range(cnt):
+            rec = jax.tree_util.tree_unflatten(
+                treedef, [pl[i] for pl in lists])
+            if wrap:
+                # no-combine rows are raw (k, v); the host merge
+                # contract expects (k, combiner=[v])
+                rec = (rec[0], [rec[1]])
+            rows.append(rec)
         return rows
+
+    def _maybe_decode(self, store, rows):
+        """Dictionary-encoded string keys leave the device as ids; every
+        host-facing exit decodes them back."""
+        if not store.get("encoded_keys") or not rows:
+            return rows
+        td = self.token_dict
+        return [(td.decode(int(r[0])),) + tuple(r[1:]) for r in rows]
 
     def drop_shuffle(self, sid):
         store = self.shuffle_store.pop(sid, None)

@@ -20,9 +20,10 @@ import jax.numpy as jnp
 from dpark_tpu.backend.tpu import layout
 from dpark_tpu.dependency import HashPartitioner, RangePartitioner
 from dpark_tpu.rdd import (
-    FilteredRDD, FlatMappedValuesRDD, KeyedRDD, MapPartitionsRDD,
+    CSVReaderRDD, DerivedRDD, FilteredRDD, FlatMappedRDD,
+    FlatMappedValuesRDD, GZipFileRDD, KeyedRDD, MapPartitionsRDD,
     MappedRDD, MappedValuesRDD, ParallelCollection, ShuffledRDD,
-    _SortPartFn, _append, _extend, _identity, _mk_list)
+    TextFileRDD, _SortPartFn, _append, _extend, _identity, _mk_list)
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("tpu.fuse")
@@ -343,6 +344,141 @@ def _sample_record(pc):
     return None
 
 
+# ----------------------------------------------------------------------
+# text-source stages (SURVEY.md 3.1 hot loop #1): the narrow chain over a
+# file source is string-typed and untraceable, so it runs as a HOST
+# PROLOGUE per split (the user's own generators), records are
+# dictionary-encoded to int64 columns, and the shuffle write + combine
+# ride the device.  The canonical wordcount shape additionally replaces
+# the Python per-record loop with the C++ tokenizer (verified per run
+# against the user's functions on a sample prefix).
+# ----------------------------------------------------------------------
+
+_TEXT_SOURCES = (TextFileRDD, GZipFileRDD, CSVReaderRDD)
+
+
+def extract_text_chain(top):
+    """Walk one-parent narrow links to a file source.  Returns
+    (source_rdd, chain root->top) or None."""
+    chain = []
+    cur = top
+    while True:
+        if isinstance(cur, _TEXT_SOURCES):
+            chain.reverse()
+            return cur, chain
+        if isinstance(cur, DerivedRDD):
+            chain.append(cur)
+            cur = cur.prev
+        else:
+            return None
+
+
+def _code_matches(f, template):
+    """f is a closure-free function with the template's bytecode."""
+    code = getattr(f, "__code__", None)
+    if code is None or getattr(f, "__closure__", None):
+        return False
+    t = template.__code__
+    return (code.co_code == t.co_code
+            and code.co_consts == t.co_consts
+            and code.co_names == t.co_names
+            and code.co_argcount == t.co_argcount)
+
+
+def _is_whitespace_split(f):
+    # 'split' in the template is an attribute load on the argument, not
+    # a global — bytecode equality is sufficient
+    return f is str.split or _code_matches(f, lambda line: line.split())
+
+
+def _is_pair_one(f):
+    return _code_matches(f, lambda w: (w, 1))
+
+
+def canonical_wordcount(chain):
+    """chain is exactly flatMap(whitespace split) -> map(w -> (w, 1))."""
+    if len(chain) != 2:
+        return False
+    fm, mp = chain
+    return (isinstance(fm, FlatMappedRDD) and isinstance(mp, MappedRDD)
+            and _is_whitespace_split(fm.f) and _is_pair_one(mp.f))
+
+
+def _sample_text_record(top):
+    """First record of the narrow chain, read from the first non-empty
+    split (driver-side, reads a handful of lines)."""
+    for sp in top.splits[:8]:
+        it = top.iterator(sp)
+        try:
+            for rec in it:
+                return rec
+        finally:
+            close = getattr(it, "close", None)
+            if close:
+                close()
+    return None
+
+
+def analyze_text_stage(stage, ndev, executor_or_store):
+    """Shuffle-map stage rooted at a file source: build a text StagePlan
+    (host-prologue ingest + device shuffle write) or return None."""
+    if not getattr(stage, "is_shuffle_map", False):
+        return None
+    top = stage.rdd
+    extracted = extract_text_chain(top)
+    if extracted is None:
+        return None
+    text_rdd, chain = extracted
+    dep = stage.shuffle_dep
+    if dep.partitioner.num_partitions > ndev:
+        return None
+    if partitioner_spec(dep.partitioner) != ("hash",):
+        return None                      # str keys have no range bounds
+
+    sample = _sample_text_record(top)
+    if not (isinstance(sample, tuple) and len(sample) == 2):
+        return None
+    k, v = sample
+    key_is_str = isinstance(k, (str, bytes))
+    if not key_is_str and not isinstance(k, (int, np.integer)):
+        return None
+    try:
+        treedef, specs = layout.record_spec((0, v))
+    except (TypeError, ValueError):
+        return None
+    for dt, _ in specs:
+        if dt == np.dtype(object) or dt.kind in "USO":
+            return None
+
+    ops = []
+    cur_treedef, cur_specs = treedef, specs
+    if not is_list_agg(dep.aggregator):
+        create = dep.aggregator.create_combiner
+        try:
+            op = MapOp(lambda rec: (rec[0], create(rec[1])))
+            cur_treedef, cur_specs = op.probe(cur_treedef, cur_specs)
+            ops.append(op)
+        except Exception as e:
+            logger.debug("create_combiner not traceable: %s", e)
+            return None
+        if layout.key_leaf_index(cur_treedef, cur_specs) is None:
+            return None
+
+    plan = StagePlan(("text", None), ops, ("shuffle_write", dep),
+                     treedef, specs, cur_treedef, cur_specs, stage)
+    plan.src_combine = False
+    plan.group_output = False
+    plan.epi_spec = ("hash",)
+    plan.epi_bounds = None
+    plan.text_rdd = text_rdd
+    plan.text_chain = chain
+    plan.encoded_keys = key_is_str
+    plan.canonical = (key_is_str and type(text_rdd) is TextFileRDD
+                      and canonical_wordcount(chain))
+    plan.program_key = plan.program_key + (False, False, ("hash",))
+    return plan
+
+
 def _leaves_merge_fn(merge, nleaves):
     """User merge_combiners (value, value) -> value lifted to leaf lists,
     vmapped for use inside segment scans."""
@@ -386,7 +522,7 @@ def analyze_stage(stage, ndev, executor_or_store):
     top = stage.rdd
     extracted = extract_chain(top, cached_ids)
     if extracted is None:
-        return None
+        return analyze_text_stage(stage, ndev, executor_or_store)
     source_rdd, ops, passthrough = extracted
     group_output = False
 
@@ -419,6 +555,12 @@ def analyze_stage(stage, ndev, executor_or_store):
             return None                  # R <= ndev: extra devices idle
         # record spec of the stored rows — registered when the map ran
         meta = hbm_sids[dep.shuffle_id]
+        if meta.get("encoded_keys") and (ops or stage.is_shuffle_map):
+            # keys are dictionary-encoded ids: only a plain read (decode
+            # at egest) may ride the device — anything else would show
+            # the user ids where they expect strings.  The host path
+            # sees decoded rows through the export bridge.
+            return None
         treedef, specs = meta["out_treedef"], meta["out_specs"]
         if is_list_agg(dep.aggregator):
             # no-combine shuffle (partitionBy/groupByKey): rows pass

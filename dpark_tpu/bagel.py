@@ -299,6 +299,12 @@ def run_pregel(ctx, ids, values, edges, compute, send, combine="add",
     """
     if combine not in PREGEL_MONOIDS:
         raise ValueError("combine must be one of %s" % (PREGEL_MONOIDS,))
+    if np.asarray(ids).shape[0] == 0 \
+            and np.asarray(edges[0]).shape[0] == 0:
+        vleaves, v_tuple = as_leaves(values)
+        return (np.zeros(0, np.int64),
+                rewrap([np.asarray(l)[:0] for l in vleaves], v_tuple),
+                np.zeros(0, bool))
     ctx.start()
     ex = getattr(ctx.scheduler, "executor", None)
     if ex is not None:
@@ -344,19 +350,21 @@ def _pregel_host(ids, values, edges, compute, send, combine,
     eleaves = [np.asarray(l) for l in eleaves] if eleaves else []
     src_idx = np.searchsorted(ids, src)
     src_idx = np.clip(src_idx, 0, max(0, n - 1))
-    if n == 0 or not np.array_equal(ids[src_idx], src):
+    if src.size and (n == 0
+                     or not np.array_equal(ids[src_idx], src)):
         raise PregelInputError("edge source not in vertex ids")
-    deg = np.bincount(src_idx, minlength=n)
+    deg = np.bincount(src_idx, minlength=n) if src.size \
+        else np.zeros(n, np.int64)
 
     # message dtypes, discovered by probing `send` on empty slices (the
     # host twin of the device path's eval_shape)
-    if src.size:
+    try:
         probe = send(rewrap([l[:0] for l in vleaves], v_tuple),
                      rewrap([l[:0] for l in eleaves], e_tuple)
                      if eleaves else None, deg[:0])
         m_probe, m_tuple = as_leaves(probe)
         msg_dtypes = [np.asarray(l).dtype for l in m_probe]
-    else:
+    except Exception:
         m_tuple = False
         msg_dtypes = [np.dtype(np.float64)]
 

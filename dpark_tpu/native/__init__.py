@@ -83,6 +83,9 @@ def get_lib():
         lib.tokendict_get.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
             ctypes.c_int64]
+        lib.tokendict_put.restype = ctypes.c_int64
+        lib.tokendict_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -190,6 +193,19 @@ class TokenDict:
                 self._rev.append(tok)
             ids.append(tid)
         return np.array(ids, dtype=np.int64)
+
+    def put(self, s):
+        """Encode ONE exact string (it may contain whitespace) -> id."""
+        if isinstance(s, str):
+            s = s.encode("utf-8")
+        if self._h:
+            return self._lib.tokendict_put(self._h, s, len(s))
+        tid = self._map.get(s)
+        if tid is None:
+            tid = len(self._rev)
+            self._map[s] = tid
+            self._rev.append(s)
+        return tid
 
     def decode(self, tid):
         if self._h:

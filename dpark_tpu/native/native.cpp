@@ -150,6 +150,19 @@ int64_t tokendict_encode(void* h, const uint8_t* buf, int64_t n,
     return count;
 }
 
+// Encode ONE exact string (no tokenization — the key may contain
+// whitespace) to its dense id, assigning a new id on first sight.
+int64_t tokendict_put(void* h, const uint8_t* buf, int64_t n) {
+    TokenDict* d = (TokenDict*)h;
+    std::string tok((const char*)buf, (size_t)n);
+    auto it = d->map.find(tok);
+    if (it != d->map.end()) return it->second;
+    int64_t id = (int64_t)d->rev.size();
+    d->rev.push_back(tok);
+    d->map.emplace(std::move(tok), id);
+    return id;
+}
+
 // Copy token `id` into out (capacity cap); returns its length or -1.
 int64_t tokendict_get(void* h, int64_t id, uint8_t* out, int64_t cap) {
     TokenDict* d = (TokenDict*)h;

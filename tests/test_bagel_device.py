@@ -249,6 +249,31 @@ def test_input_errors_surface_not_fallback(tctx):
                                      (np.ones(1), np.ones(1))))
 
 
+def test_empty_graph_and_no_edges(tctx):
+    def compute(v, m, h, a, agg, s):
+        return v, s < 1
+
+    def send(v, e, deg):
+        return v
+
+    gids, gvals, gact = run_pregel(
+        tctx, np.zeros(0, np.int64), np.zeros(0),
+        (np.zeros(0, np.int64), np.zeros(0, np.int64)), compute, send)
+    assert gids.size == 0 and gvals.size == 0 and gact.size == 0
+
+    # vertices but no edges: one superstep, no messages, halt
+    ids = np.arange(5, dtype=np.int64)
+    gids, gvals, _ = run_pregel(
+        tctx, ids, np.ones(5),
+        (np.zeros(0, np.int64), np.zeros(0, np.int64)), compute, send)
+    hids, hvals, _ = _pregel_host(
+        ids, np.ones(5),
+        (np.zeros(0, np.int64), np.zeros(0, np.int64)), compute, send,
+        "add", None, None, None, None, 80)
+    assert np.array_equal(gids, hids)
+    assert np.allclose(gvals, hvals)
+
+
 def test_pregel_fuzz_host_vs_device(tctx):
     """Random graphs / monoids: device == host on every superstep path."""
     for seed, combine in [(1, "add"), (2, "min"), (3, "max")]:

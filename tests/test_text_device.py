@@ -1,0 +1,251 @@
+"""Device ingest for text sources (SURVEY.md 3.1 hot loop #1): the narrow
+chain over ctx.textFile runs as a host prologue (user generators or the
+verified C++ tokenizer), string keys dictionary-encode to int64 columns,
+and the shuffle+combine ride the device.  Every test asserts parity with
+the local master."""
+
+import gzip
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def tctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu")
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    import random
+    rng = random.Random(42)
+    words = ["spark", "tpu", "mesh", "jit", "pallas", "ici", "hbm"]
+    p = str(tmp_path / "corpus.txt")
+    with open(p, "w") as f:
+        for _ in range(4000):
+            f.write(" ".join(rng.choices(words, k=5)) + "\n")
+    return p
+
+
+def _local_counts(path, **kw):
+    from dpark_tpu import DparkContext
+    lctx = DparkContext("local")
+    got = dict(lctx.textFile(path, **kw)
+               .flatMap(lambda line: line.split())
+               .map(lambda w: (w, 1))
+               .reduceByKey(lambda a, b: a + b, 4).collect())
+    lctx.stop()
+    return got
+
+
+def _text_path_used(tctx):
+    ex = tctx.scheduler.executor
+    return bool(ex.shuffle_store) and hasattr(ex, "token_dict")
+
+
+def test_canonical_wordcount_rides_device(tctx, corpus):
+    got = dict(tctx.textFile(corpus, splitSize=30000)
+               .flatMap(lambda line: line.split())
+               .map(lambda w: (w, 1))
+               .reduceByKey(lambda a, b: a + b, 4).collect())
+    assert got == _local_counts(corpus, splitSize=30000)
+    assert _text_path_used(tctx)
+
+
+def test_str_split_method_ref(tctx, corpus):
+    got = dict(tctx.textFile(corpus).flatMap(str.split)
+               .map(lambda w: (w, 1))
+               .reduceByKey(lambda a, b: a + b, 4).collect())
+    assert got == _local_counts(corpus)
+
+
+def test_non_canonical_chain_host_prologue(tctx, corpus):
+    """Arbitrary string-keyed narrow chain: the user's own generators
+    run per split, keys encode, the device combines."""
+    def first_two(line):
+        return [(w[:2], len(w)) for w in line.split()]
+
+    def run(ctx):
+        return dict(ctx.textFile(corpus)
+                    .flatMap(first_two)
+                    .reduceByKey(lambda a, b: a + b, 4).collect())
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
+    assert _text_path_used(tctx)
+
+
+def test_int_key_text_chain_no_encoding(tctx, tmp_path):
+    p = str(tmp_path / "nums.txt")
+    with open(p, "w") as f:
+        for i in range(2000):
+            f.write("%d\n" % i)
+
+    def run(ctx):
+        return dict(ctx.textFile(p, splitSize=4000)
+                    .map(lambda l: (int(l) % 13, 1))
+                    .reduceByKey(lambda a, b: a + b, 4).collect())
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
+    assert tctx.scheduler.executor.shuffle_store
+
+
+def test_group_by_key_words(tctx, corpus):
+    def run(ctx):
+        return {k: sorted(v) for k, v in
+                ctx.textFile(corpus)
+                .flatMap(lambda line: line.split())
+                .map(lambda w: (w, len(w)))
+                .groupByKey(4).collect()}
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
+
+
+def test_downstream_map_after_reduce(tctx, corpus):
+    """Further ops on the reduced words force the host path for the
+    result stage; the export bridge must hand it DECODED rows."""
+    def run(ctx):
+        return sorted(ctx.textFile(corpus)
+                      .flatMap(lambda line: line.split())
+                      .map(lambda w: (w, 1))
+                      .reduceByKey(lambda a, b: a + b, 4)
+                      .map(lambda kv: (kv[0].upper(), kv[1] * 2))
+                      .collect())
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
+
+
+def test_word_join_device(tctx, corpus):
+    """Str-keyed join: both sides encode through one dict, the device
+    matches ids, the exit decodes."""
+    def run(ctx):
+        words = ctx.textFile(corpus).flatMap(lambda line: line.split())
+        a = words.map(lambda w: (w, 1)).reduceByKey(
+            lambda x, y: x + y, 4)
+        b = words.map(lambda w: (w, len(w))).reduceByKey(
+            lambda x, y: x, 4)
+        return sorted(a.join(b, 4).collect())
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
+
+
+def test_unicode_whitespace_falls_back_correctly(tctx, tmp_path):
+    """NBSP splits in Python but not in the byte tokenizer: the sample
+    verification must catch the divergence and take the host prologue —
+    results stay correct."""
+    p = str(tmp_path / "nbsp.txt")
+    with open(p, "w", encoding="utf-8") as f:
+        for i in range(200):
+            f.write("a\u00a0b c%d\n" % (i % 3))
+
+    def run(ctx):
+        return dict(ctx.textFile(p)
+                    .flatMap(lambda line: line.split())
+                    .map(lambda w: (w, 1))
+                    .reduceByKey(lambda x, y: x + y, 4).collect())
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
+    assert "a" in got and "b" in got     # NBSP split like Python
+    assert "a\u00a0b" not in got
+
+
+def test_long_first_line_not_trusted(tctx, tmp_path):
+    """A >4KB first line leaves nothing to verify the byte tokenizer
+    against; the canonical path must NOT run unverified."""
+    p = str(tmp_path / "long.txt")
+    with open(p, "w", encoding="utf-8") as f:
+        f.write("x y " * 2000 + "\n")     # NBSP inside, one line
+
+    def run(ctx):
+        return dict(ctx.textFile(p)
+                    .flatMap(lambda line: line.split())
+                    .map(lambda w: (w, 1))
+                    .reduceByKey(lambda x, y: x + y, 2).collect())
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
+    assert "x" in got and "y" in got     # NBSP split like Python
+    assert "x\u00a0y" not in got
+
+
+def test_gzip_source_host_prologue(tctx, tmp_path):
+    p = str(tmp_path / "z.gz")
+    with gzip.open(p, "wt") as f:
+        for i in range(500):
+            f.write("x y z w%d\n" % (i % 5))
+
+    def run(ctx):
+        return dict(ctx.textFile(p)
+                    .flatMap(lambda line: line.split())
+                    .map(lambda w: (w, 1))
+                    .reduceByKey(lambda x, y: x + y, 2).collect())
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
+
+
+def test_cache_not_poisoned_by_encoded_results(tctx, corpus):
+    """A cached reduced-words RDD must return strings on every access."""
+    r = (tctx.textFile(corpus)
+         .flatMap(lambda line: line.split())
+         .map(lambda w: (w, 1))
+         .reduceByKey(lambda a, b: a + b, 4).cache())
+    first = dict(r.collect())
+    second = dict(r.collect())
+    assert first == second
+    assert all(isinstance(k, str) for k in second)
+
+
+def test_lineage_recovery_after_hbm_eviction(tctx, corpus):
+    """Evicting the encoded shuffle recomputes the text stage through
+    lineage; decoded results stay identical."""
+    r = (tctx.textFile(corpus)
+         .flatMap(lambda line: line.split())
+         .map(lambda w: (w, 1))
+         .reduceByKey(lambda a, b: a + b, 4))
+    first = dict(r.collect())
+    ex = tctx.scheduler.executor
+    for sid in list(ex.shuffle_store):
+        ex.drop_shuffle(sid)
+    assert dict(r.collect()) == first
