@@ -1,0 +1,118 @@
+"""Out-of-core demonstration runs (SURVEY.md 7.2 item 4 / round-2 plan):
+a >=10GB synthetic dataset through the north-star configs with bounded
+RSS and HBM, on either master.
+
+  python benchmarks/ooc_run.py --config wordcount --master tpu --gb 10
+  python benchmarks/ooc_run.py --config sortgroup --master tpu --gb 10
+
+Prints one JSON line: wall seconds, max RSS, HBM budget, spool bytes.
+The text corpus is generated once under --data-dir and reused.
+"""
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+
+def gen_corpus(path, gb):
+    """~gb GB of whitespace text, written in repeated 8MB blocks."""
+    import random
+    if os.path.exists(path) and os.path.getsize(path) >= gb * (1 << 30):
+        return
+    rng = random.Random(1234)
+    words = ["%s%d" % (w, i) for i in range(2000)
+             for w in ("tok", "key", "val")][:5000]
+    lines = []
+    size = 0
+    while size < (8 << 20):
+        line = " ".join(rng.choices(words, k=10)) + "\n"
+        lines.append(line)
+        size += len(line)
+    block = "".join(lines).encode()
+    with open(path, "wb") as f:
+        written = 0
+        target = gb * (1 << 30)
+        while written < target:
+            f.write(block)
+            written += len(block)
+
+
+def run_wordcount(ctx, path, n_parts):
+    r = (ctx.textFile(path)
+         .flatMap(lambda line: line.split())
+         .map(lambda w: (w, 1))
+         .reduceByKey(lambda a, b: a + b, n_parts))
+    top = r.top(5, key=lambda kv: kv[1])
+    return {"top": top[0][1], "distinct": r.count()}
+
+
+def run_sortgroup(ctx, gb, n_parts):
+    """Config #1 over columnar input: sortByKey + groupByKey with the
+    spilled-run streaming path (HBM + spool bounded; input in RAM)."""
+    import numpy as np
+    from dpark_tpu import Columns
+    n = (gb * (1 << 30)) // 16            # two int64 columns
+    keys = (np.arange(n, dtype=np.int64) * 2654435761) % (10 ** 9)
+    vals = np.arange(n, dtype=np.int64) & 0xFFFF
+    data = Columns(keys, vals)
+    s = ctx.parallelize(data, n_parts).sortByKey(numSplits=n_parts)
+    first_keys = [k for k, _ in s.take(3)]
+    g = (ctx.parallelize(data, n_parts)
+         .map(lambda kv: (kv[0] % 1000, kv[1]))
+         .reduceByKey(lambda a, b: a + b, n_parts))
+    return {"sort_head": first_keys, "groups": g.count()}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", choices=["wordcount", "sortgroup"],
+                    default="wordcount")
+    ap.add_argument("--master", default="tpu")
+    ap.add_argument("--gb", type=float, default=10.0)
+    ap.add_argument("--parts", type=int, default=8)
+    ap.add_argument("--data-dir", default="/tmp/dpark_ooc")
+    args = ap.parse_args()
+
+    if args.master == "tpu" and os.environ.get("DPARK_TPU_PLATFORM",
+                                               "cpu") == "cpu":
+        # default to the virtual CPU mesh unless a real device is asked
+        os.environ.setdefault("DPARK_TPU_PLATFORM", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    from dpark_tpu import DparkContext, conf
+    ctx = DparkContext(args.master)
+
+    os.makedirs(args.data_dir, exist_ok=True)
+    t0 = time.time()
+    out = {"config": args.config, "master": args.master, "gb": args.gb}
+    if args.config == "wordcount":
+        path = os.path.join(args.data_dir,
+                            "corpus_%dg.txt" % int(args.gb))
+        gen_corpus(path, args.gb)
+        out["gen_s"] = round(time.time() - t0, 1)
+        t0 = time.time()
+        out.update(run_wordcount(ctx, path, args.parts))
+    else:
+        out.update(run_sortgroup(ctx, args.gb, args.parts))
+    out["wall_s"] = round(time.time() - t0, 1)
+    out["max_rss_gb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20),
+        2)
+    out["hbm_budget_gb"] = round(conf.SHUFFLE_HBM_BUDGET / (1 << 30), 2)
+    ex = getattr(ctx.scheduler, "executor", None)
+    if ex is not None:
+        out["hbm_used_gb"] = round(
+            (ex._store_bytes + ex._result_bytes) / (1 << 30), 3)
+    ctx.stop()
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -113,6 +113,9 @@ class TPUScheduler(DAGScheduler):
             if kind != "shuffle" or not fuse.is_list_agg(obj.aggregator) \
                     or not self.executor.has_shuffle(obj.shuffle_id):
                 return None
+            if "host_runs" in self.executor.shuffle_store[
+                    obj.shuffle_id]:
+                return None      # spilled runs: host merge consumes them
             deps.append(obj)
         return deps
 

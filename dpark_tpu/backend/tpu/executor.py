@@ -262,11 +262,15 @@ class JAXExecutor:
         """Execute the whole stage for all partitions at once.
 
         Returns ("result", list_of_row_lists) or ("shuffle", sid)."""
+        mode = self._stream_mode(plan)
+        if mode is not None:
+            kind, waves = mode
+            if kind == "monoid":
+                return self._run_streamed_shuffle(plan, waves)
+            return self._run_streamed_nocombine(plan, waves)
         if plan.source[0] == "text":
             outs = self._run_narrow(plan, self._ingest_text(plan))
             return self._finish_stage(plan, outs)
-        if plan.source[0] == "ingest" and self._should_stream(plan):
-            return self._run_streamed_shuffle(plan)
         if plan.source[0] in ("ingest", "cached"):
             if plan.source[0] == "cached":
                 meta = self.result_cache[plan.source[1].id]
@@ -379,26 +383,30 @@ class JAXExecutor:
             cols.append(np.asarray(ll, dt))
         return cols
 
-    def _ingest_text(self, plan):
-        from dpark_tpu.rdd import _ColumnarSlice
-        top = plan.stage.rdd
-        splits = top.splits
-        td = self._token_dict() if plan.encoded_keys else None
-        canonical = plan.canonical
-        chunks = []
-        for i, sp in enumerate(splits):
-            if canonical:
-                data = self._read_text_split(plan.text_rdd, sp)
-                if i == 0 and not self._verify_canonical(plan, data, td):
+    def _text_split_cols(self, plan, sp, td, state):
+        """Columns for one split: C++ tokenizer (verified once per run)
+        on the canonical path, the user's own generators otherwise."""
+        if state["canonical"]:
+            data = self._read_text_split(plan.text_rdd, sp)
+            if not state["checked"]:
+                state["checked"] = True
+                if not self._verify_canonical(plan, data, td):
                     logger.info("canonical tokenizer diverges from the "
                                 "user chain; using the host prologue")
-                    canonical = False
-                if canonical:
-                    ids = td.encode(data)
-                    chunks.append([np.asarray(ids, np.int64),
-                                   np.ones(len(ids), np.int64)])
-                    continue
-            chunks.append(self._encode_rows(plan, top, sp, td))
+                    state["canonical"] = False
+            if state["canonical"]:
+                ids = td.encode(data)
+                return [np.asarray(ids, np.int64),
+                        np.ones(len(ids), np.int64)]
+        return self._encode_rows(plan, plan.stage.rdd, sp, td)
+
+    def _text_parts(self, plan, chunks):
+        """Concatenate per-split columns and redistribute rows EVENLY
+        across devices regardless of the file split layout (one big file
+        = one split must not put everything on device 0); the hash
+        exchange owns placement anyway.  The host bridge compensates via
+        the store's single_map mode."""
+        from dpark_tpu.rdd import _ColumnarSlice
         nleaves = len(plan.in_specs)
         if chunks:
             cols = [np.concatenate([c[li] for c in chunks])
@@ -406,12 +414,15 @@ class JAXExecutor:
         else:
             cols = [np.zeros((0,) + shape, dt)
                     for dt, shape in plan.in_specs]
-        # rows redistribute EVENLY across devices regardless of the file
-        # split layout (one big file = one split must not put everything
-        # on device 0); the hash exchange owns placement anyway.  The
-        # host bridge compensates via the store's single_map mode.
-        parts = [_ColumnarSlice([c[lo:hi] for c in cols])
-                 for lo, hi in _even_ranges(len(cols[0]), self.ndev)]
+        return [_ColumnarSlice([c[lo:hi] for c in cols])
+                for lo, hi in _even_ranges(len(cols[0]), self.ndev)]
+
+    def _ingest_text(self, plan):
+        td = self._token_dict() if plan.encoded_keys else None
+        state = {"canonical": plan.canonical, "checked": False}
+        chunks = [self._text_split_cols(plan, sp, td, state)
+                  for sp in plan.stage.rdd.splits]
+        parts = self._text_parts(plan, chunks)
         return layout.ingest(self.mesh, parts, plan.in_treedef,
                              plan.in_specs, key_leaf=0)
 
@@ -452,9 +463,11 @@ class JAXExecutor:
         lineage recomputation; evicted results recompute on next use."""
         budget = conf.SHUFFLE_HBM_BUDGET
         while self._store_bytes + self._result_bytes > budget:
+            # spilled (host_runs) stores hold no HBM: evicting them
+            # frees nothing and destroys on-disk runs
             cands = [(meta["seq"], "sid", sid)
                      for sid, meta in self.shuffle_store.items()
-                     if sid != keep_sid]
+                     if sid != keep_sid and "host_runs" not in meta]
             cands += [(meta["seq"], "rdd", rid)
                       for rid, meta in self.result_cache.items()
                       if rid != keep_rdd]
@@ -547,43 +560,80 @@ class JAXExecutor:
         return reduce_fn(*args)
 
     # ------------------------------------------------------------------
-    # out-of-core streaming shuffle (SURVEY.md 7.2 item 4): monoid
-    # reduces over columnar input bigger than a chunk run in
-    # ingest -> combine -> exchange -> merge-into-state waves, so HBM
-    # holds one chunk + the combined state instead of the whole dataset
+    # out-of-core streaming shuffle (SURVEY.md 7.2 item 4): input bigger
+    # than a chunk runs in ingest -> exchange waves so HBM holds one
+    # chunk (plus combined state for monoid reduces).  Covers columnar
+    # parallelize AND text-source stages; no-combine shuffles (sortByKey
+    # range exchange, groupByKey, partitionBy) spill key-sorted runs to
+    # host disk and merge lazily at the export bridge.
     # ------------------------------------------------------------------
-    def _should_stream(self, plan):
+    def _stream_mode(self, plan):
+        """None, or ("monoid"|"nocombine", wave iterator).  Each wave is
+        a list of per-device _ColumnarSlice parts."""
         if plan.epilogue is None:
-            return False
-        from dpark_tpu.rdd import _ColumnarSlice
-        slices = plan.source[1]._slices
-        if not all(isinstance(s, _ColumnarSlice) for s in slices):
-            return False
-        if max((len(s) for s in slices), default=0) \
-                <= conf.STREAM_CHUNK_ROWS:
-            return False
+            return None
         dep = plan.epilogue[1]
-        if fuse.is_list_agg(dep.aggregator):
-            return False                # repartition can't shrink: no win
-        monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
-        return monoid is not None
+        no_combine = fuse.is_list_agg(dep.aggregator)
+        monoid = None if no_combine else fuse.classify_merge(
+            dep.aggregator.merge_combiners)
+        if plan.source[0] == "ingest":
+            from dpark_tpu.rdd import _ColumnarSlice
+            slices = plan.source[1]._slices
+            if not all(isinstance(s, _ColumnarSlice) for s in slices):
+                return None
+            if max((len(s) for s in slices), default=0) \
+                    <= conf.STREAM_CHUNK_ROWS:
+                return None
+            waves = self._wave_iter_columnar(plan)
+        elif plan.source[0] == "text":
+            sizes = [max(0, getattr(sp, "end", 0)
+                         - getattr(sp, "begin", 0))
+                     for sp in plan.stage.rdd.splits]
+            if sum(sizes) <= conf.STREAM_TEXT_BYTES:
+                return None
+            waves = self._wave_iter_text(plan, sizes)
+        else:
+            return None
+        if no_combine:
+            return ("nocombine", waves)
+        if monoid is not None:
+            return ("monoid", waves)
+        return None                     # generic merge: in-core only
 
-    def _run_streamed_shuffle(self, plan):
+    def _wave_iter_columnar(self, plan):
         from dpark_tpu.rdd import _ColumnarSlice
-        dep = plan.epilogue[1]
-        # _should_stream guarantees a classified monoid: the combine runs
-        # entirely through segment scatters, never the user merge fn
-        monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
         slices = plan.source[1]._slices
         chunk = conf.STREAM_CHUNK_ROWS
         nchunks = (max(len(s) for s in slices) + chunk - 1) // chunk
-        state = None                    # (leaves, counts) combined so far
-        bounds = self._bounds_arg(plan)      # loop-invariant
         for c in range(nchunks):
-            parts = [
+            yield [
                 _ColumnarSlice([col[c * chunk:(c + 1) * chunk]
                                 for col in s.columns])
                 for s in slices]
+
+    def _wave_iter_text(self, plan, sizes):
+        """Groups of splits whose byte size fits one wave budget."""
+        td = self._token_dict() if plan.encoded_keys else None
+        state = {"canonical": plan.canonical, "checked": False}
+        budget = conf.STREAM_TEXT_BYTES
+        chunks, acc = [], 0
+        for sp, size in zip(plan.stage.rdd.splits, sizes):
+            chunks.append(self._text_split_cols(plan, sp, td, state))
+            acc += size if size > 0 else budget
+            if acc >= budget:
+                yield self._text_parts(plan, chunks)
+                chunks, acc = [], 0
+        if chunks:
+            yield self._text_parts(plan, chunks)
+
+    def _run_streamed_shuffle(self, plan, waves):
+        dep = plan.epilogue[1]
+        # _stream_mode guarantees a classified monoid: the combine runs
+        # entirely through segment scatters, never the user merge fn
+        monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
+        state = None                    # (leaves, counts) combined so far
+        bounds = self._bounds_arg(plan)      # loop-invariant
+        for c, parts in enumerate(waves):
             batch = layout.ingest(self.mesh, parts, plan.in_treedef,
                                   plan.in_specs, key_leaf=0)
             outs = self._run_narrow(plan, batch, bounds=bounds)
@@ -591,13 +641,100 @@ class JAXExecutor:
             leaves = list(outs[2:])
             recv = self._exchange_all(leaves, cnts, offs)
             state = self._merge_into_state(plan, state, recv, monoid)
-            logger.debug("streamed chunk %d/%d", c + 1, nchunks)
+            logger.debug("streamed wave %d", c + 1)
         leaves, counts = state
         return self._register_shuffle(dep, plan, {
             "leaves": leaves, "counts": counts,
             "pre_reduced": True,        # device d holds reduce part d
             "no_combine": False,
+            "encoded_keys": getattr(plan, "encoded_keys", False),
+            "single_map": plan.source[0] == "text",
         })
+
+    def _run_streamed_nocombine(self, plan, waves):
+        """No-combine shuffle (sortByKey range exchange, groupByKey,
+        partitionBy) over big input: each wave exchanges, sorts by key
+        on device, and spills one key-sorted run per reduce partition to
+        host disk; the export bridge heap-merges the runs lazily.  HBM
+        holds one wave; host RAM holds one wave of rows."""
+        import os
+        from dpark_tpu.env import env
+        dep = plan.epilogue[1]
+        # unique per run: a re-run must never write into (then delete,
+        # via the old store's drop_shuffle) the same directory
+        self._spool_seq = getattr(self, "_spool_seq", 0) + 1
+        spool = os.path.join(env.workdir, "hbmruns", "%d-%d"
+                             % (dep.shuffle_id, self._spool_seq))
+        os.makedirs(spool, exist_ok=True)
+        runs = [[] for _ in range(self.ndev)]
+        bounds = self._bounds_arg(plan)
+        for c, parts in enumerate(waves):
+            batch = layout.ingest(self.mesh, parts, plan.in_treedef,
+                                  plan.in_specs, key_leaf=0)
+            outs = self._run_narrow(plan, batch, bounds=bounds)
+            cnts, offs = outs[0], outs[1]
+            leaves = list(outs[2:])
+            recv = self._exchange_all(leaves, cnts, offs)
+            sorted_batch = self._sort_received(plan, recv)
+            for d, rows in enumerate(layout.egest(sorted_batch)):
+                if rows:
+                    path = os.path.join(spool, "%d-%d" % (d, c))
+                    self._write_run(path, rows)
+                    runs[d].append(path)
+            logger.debug("streamed no-combine wave %d", c + 1)
+        return self._register_shuffle(dep, plan, {
+            "leaves": [], "counts": None, "offsets": None,
+            "host_runs": runs, "spool_dir": spool,
+            "no_combine": True,
+            "encoded_keys": getattr(plan, "encoded_keys", False),
+            "single_map": True,
+        })
+
+    def _sort_received(self, plan, recv):
+        """Flatten exchange rounds and key-sort per device -> Batch."""
+        recv_rounds, cnt_rounds, slot = recv
+        rounds = len(recv_rounds)
+        nleaves = len(recv_rounds[0])
+        key = ("wave_sort", plan.program_key, rounds, slot, nleaves)
+        if key not in self._compiled:
+            def per_device(*args):
+                cnts = [c[0] for c in args[:rounds]]
+                bufs = args[rounds:]
+                recvs = []
+                for r in range(rounds):
+                    recvs.append([bufs[r * nleaves + li][0]
+                                  for li in range(nleaves)])
+                flat, mask = collectives.flatten_received(recvs, cnts)
+                packed = collectives._lex_sort(tuple(flat), 1)
+                n = jnp.sum(mask).astype(jnp.int32)
+                out = (jnp.expand_dims(n, 0),) + tuple(
+                    jnp.expand_dims(l, 0) for l in packed)
+                return out
+
+            fn = _shard_map(per_device, self.mesh,
+                            in_specs=(P(AXIS),) * (rounds
+                                                   + rounds * nleaves),
+                            out_specs=(P(AXIS),) * (1 + nleaves))
+            self._compiled[key] = jax.jit(fn)
+        args = list(cnt_rounds)
+        for r in range(rounds):
+            args.extend(recv_rounds[r])
+        outs = self._compiled[key](*args)
+        return layout.Batch(plan.out_treedef, list(outs[1:]), outs[0])
+
+    @staticmethod
+    def _write_run(path, rows):
+        from dpark_tpu.utils import compress
+        import pickle
+        with open(path, "wb") as f:
+            f.write(compress(pickle.dumps(rows, -1)))
+
+    @staticmethod
+    def _read_run(path):
+        from dpark_tpu.utils import decompress
+        import pickle
+        with open(path, "rb") as f:
+            return pickle.loads(decompress(f.read()))
 
     def _exchange_all(self, leaves, counts, offsets):
         """Run exchange rounds for already-bucketized buffers; returns
@@ -847,6 +984,17 @@ class JAXExecutor:
             rows = [jax.tree_util.tree_unflatten(
                 treedef, [pl[i] for pl in lists]) for i in range(cnt)]
             return self._maybe_decode(store, rows)
+        if "host_runs" in store:
+            # streamed no-combine shuffle: key-sorted runs on host disk,
+            # heap-merged here; the whole shuffle exports through map 0
+            if map_id != 0:
+                return []
+            import heapq
+            its = [iter(self._read_run(p))
+                   for p in store["host_runs"][reduce_id]]
+            rows = [(r[0], [r[1]])
+                    for r in heapq.merge(*its, key=lambda r: r[0])]
+            return self._maybe_decode(store, rows)
         if store.get("single_map"):
             # device rows don't correspond to logical map partitions
             # (text ingest): the whole shuffle exports through map 0
@@ -901,6 +1049,9 @@ class JAXExecutor:
         store = self.shuffle_store.pop(sid, None)
         if store:
             self._store_bytes -= store["nbytes"]
+            if store.get("spool_dir"):
+                import shutil
+                shutil.rmtree(store["spool_dir"], ignore_errors=True)
 
     @staticmethod
     def _check_cached_keys(batch):

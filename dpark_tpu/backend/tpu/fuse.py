@@ -432,8 +432,9 @@ def analyze_text_stage(stage, ndev, executor_or_store):
     dep = stage.shuffle_dep
     if dep.partitioner.num_partitions > ndev:
         return None
-    if partitioner_spec(dep.partitioner) != ("hash",):
-        return None                      # str keys have no range bounds
+    epi_spec = partitioner_spec(dep.partitioner)
+    if epi_spec is None:
+        return None
 
     sample = _sample_text_record(top)
     if not (isinstance(sample, tuple) and len(sample) == 2):
@@ -442,6 +443,8 @@ def analyze_text_stage(stage, ndev, executor_or_store):
     key_is_str = isinstance(k, (str, bytes))
     if not key_is_str and not isinstance(k, (int, np.integer)):
         return None
+    if key_is_str and epi_spec[0] != "hash":
+        return None                      # str keys have no range bounds
     try:
         treedef, specs = layout.record_spec((0, v))
     except (TypeError, ValueError):
@@ -449,6 +452,10 @@ def analyze_text_stage(stage, ndev, executor_or_store):
     for dt, _ in specs:
         if dt == np.dtype(object) or dt.kind in "USO":
             return None
+    epi_bounds = None
+    if epi_spec[0] == "range":
+        epi_bounds = np.asarray(dep.partitioner.bounds,
+                                dtype=np.int64)
 
     ops = []
     cur_treedef, cur_specs = treedef, specs
@@ -468,14 +475,14 @@ def analyze_text_stage(stage, ndev, executor_or_store):
                      treedef, specs, cur_treedef, cur_specs, stage)
     plan.src_combine = False
     plan.group_output = False
-    plan.epi_spec = ("hash",)
-    plan.epi_bounds = None
+    plan.epi_spec = epi_spec
+    plan.epi_bounds = epi_bounds
     plan.text_rdd = text_rdd
     plan.text_chain = chain
     plan.encoded_keys = key_is_str
     plan.canonical = (key_is_str and type(text_rdd) is TextFileRDD
                       and canonical_wordcount(chain))
-    plan.program_key = plan.program_key + (False, False, ("hash",))
+    plan.program_key = plan.program_key + (False, False, epi_spec)
     return plan
 
 
@@ -555,6 +562,8 @@ def analyze_stage(stage, ndev, executor_or_store):
             return None                  # R <= ndev: extra devices idle
         # record spec of the stored rows — registered when the map ran
         meta = hbm_sids[dep.shuffle_id]
+        if "host_runs" in meta:
+            return None          # spilled runs: host merge consumes them
         if meta.get("encoded_keys") and (ops or stage.is_shuffle_map):
             # keys are dictionary-encoded ids: only a plain read (decode
             # at egest) may ride the device — anything else would show

@@ -1,22 +1,27 @@
 """Broadcast: ship a read-only value to every worker once.
 
-Reference parity: dpark/broadcast.py — Broadcast.__getstate__ ships only the
-id; workers lazily fetch on first deref.  The reference distributes ~1MB
-compressed chunks P2P/tree-style over zmq (SURVEY.md section 2.1).
+Reference parity: dpark/broadcast.py — Broadcast.__getstate__ ships only
+the id; workers lazily fetch on first deref.  The reference distributes
+~1MB compressed chunks P2P/tree-style over zmq (SURVEY.md section 2.1).
 
-Single-host design: the value is dumped once, compressed, to a file in the
-shared workdir; worker processes mmap-read it on first access.  On the TPU
-backend a broadcast value that is a jax.Array (or numpy) is realised as a
-replicated device array via jax.device_put with a fully-replicated sharding
-(backend/tpu/), which is the ICI equivalent of the reference's tree
-broadcast.
+Layout: the value pickles+compresses once, then splits into CHUNK-sized
+pieces under workdir/broadcast (b<id>.meta + b<id>.<i>).  Same-host
+workers read the files directly; remote workers fetch the chunks over
+TCP from the origin's bucket server (dpark_tpu/dcn.py), whose address
+rides along in the pickled handle.  On the TPU backend a broadcast value
+that is a jax.Array (or numpy) is realised as a replicated device array
+via jax.device_put with a fully-replicated sharding — the ICI equivalent
+of the reference's tree broadcast.
 """
 
 import os
 import pickle
+import struct
 import threading
 
 from dpark_tpu.utils import atomic_file, compress, decompress
+
+CHUNK = 1 << 20                      # ~1MB compressed per chunk
 
 _local_values = {}          # bid -> value, populated in creating process
 _lock = threading.Lock()
@@ -29,18 +34,49 @@ class Broadcast:
         Broadcast._next_id[0] += 1
         self.bid = Broadcast._next_id[0]
         self._value = value
+        self._origin = None
         _local_values[self.bid] = value
-        self._write_file(value)
-
-    def _path(self):
+        self._write_chunks(value)
         from dpark_tpu.env import env
-        d = os.path.join(env.workdir, "broadcast")
-        return os.path.join(d, "b%d" % self.bid)
+        if env.bucket_server is not None:
+            self._origin = env.bucket_server.addr
 
-    def _write_file(self, value):
-        path = self._path()
-        with atomic_file(path) as f:
-            f.write(compress(pickle.dumps(value, -1)))
+    def _dir(self):
+        from dpark_tpu.env import env
+        return os.path.join(env.workdir, "broadcast")
+
+    def _write_chunks(self, value):
+        blob = compress(pickle.dumps(value, -1))
+        d = self._dir()
+        nchunks = max(1, (len(blob) + CHUNK - 1) // CHUNK)
+        for i in range(nchunks):
+            with atomic_file(os.path.join(
+                    d, "b%d.%d" % (self.bid, i))) as f:
+                f.write(blob[i * CHUNK:(i + 1) * CHUNK])
+        with atomic_file(os.path.join(d, "b%d.meta" % self.bid)) as f:
+            f.write(struct.pack("!I", nchunks))
+
+    def _read_local(self):
+        d = self._dir()
+        with open(os.path.join(d, "b%d.meta" % self.bid), "rb") as f:
+            (nchunks,) = struct.unpack("!I", f.read(4))
+        parts = []
+        for i in range(nchunks):
+            with open(os.path.join(d, "b%d.%d" % (self.bid, i)),
+                      "rb") as f:
+                parts.append(f.read())
+        return pickle.loads(decompress(b"".join(parts)))
+
+    def _fetch_remote(self):
+        """Chunked fetch over ONE TCP connection to the origin's bucket
+        server."""
+        from dpark_tpu import dcn
+        meta = dcn.fetch(self._origin, ("bcast_meta", self.bid))
+        (nchunks,) = struct.unpack("!I", meta)
+        parts = dcn.fetch_many(
+            self._origin,
+            [("bcast", self.bid, i) for i in range(nchunks)])
+        return pickle.loads(decompress(b"".join(parts)))
 
     @property
     def value(self):
@@ -49,21 +85,37 @@ class Broadcast:
                 if self.bid in _local_values:
                     self._value = _local_values[self.bid]
                 else:
-                    with open(self._path(), "rb") as f:
-                        self._value = pickle.loads(decompress(f.read()))
+                    try:
+                        self._value = self._read_local()
+                    except OSError:
+                        if self._origin is None:
+                            raise
+                        self._value = self._fetch_remote()
                     _local_values[self.bid] = self._value
         return self._value
 
     def __getstate__(self):
-        return (self.bid,)
+        return (self.bid, self._origin)
 
     def __setstate__(self, state):
-        (self.bid,) = state
+        self.bid, self._origin = state
         self._value = _local_values.get(self.bid)
 
     def clear(self):
         _local_values.pop(self.bid, None)
+        d = self._dir()
         try:
-            os.unlink(self._path())
+            with open(os.path.join(d, "b%d.meta" % self.bid),
+                      "rb") as f:
+                (nchunks,) = struct.unpack("!I", f.read(4))
+        except OSError:
+            return
+        for i in range(nchunks):
+            try:
+                os.unlink(os.path.join(d, "b%d.%d" % (self.bid, i)))
+            except OSError:
+                pass
+        try:
+            os.unlink(os.path.join(d, "b%d.meta" % self.bid))
         except OSError:
             pass

@@ -63,6 +63,10 @@ SHUFFLE_HBM_BUDGET = 2 << 30
 # pipeline of SURVEY.md 7.2 item 4)
 STREAM_CHUNK_ROWS = 4 << 20
 
+# text-source stages bigger than this stream in waves of splits instead
+# of materializing the whole encoded dataset (same out-of-core pipeline)
+STREAM_TEXT_BYTES = 1 << 28
+
 # default dtype for device-side values
 DEFAULT_DTYPE = "int32"
 

@@ -1,0 +1,177 @@
+"""DCN bulk data plane: TCP serving of shuffle buckets and broadcast
+chunks between hosts.
+
+Reference parity: dpark/shuffle.py serves map-output bucket files over a
+per-worker HTTP server, and dpark/broadcast.py distributes ~1MB
+compressed chunks P2P over zmq (SURVEY.md section 2.8).  Here one
+threaded TCP server per process fronts both: bucket requests resolve to
+the workdir bucket files (or the HBM export bridge for device-resident
+shuffles), broadcast requests to the chunk files written by
+dpark_tpu.broadcast.  The tracker (dpark_tpu/tracker.py) remains the
+metadata plane that carries the tcp:// URIs.
+
+Framing: 4-byte length + pickled request tuple; response 8-byte length +
+raw payload bytes (already compressed on disk — the server never
+recompresses).
+"""
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("dcn")
+
+
+def _routable_host():
+    """This host's address as other machines can reach it; loopback only
+    as a last resort (single-machine deployments)."""
+    name = socket.gethostname()
+    try:
+        addr = socket.gethostbyname(name)
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    try:
+        # the address of the default route's interface, no traffic sent
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("peer closed")
+        buf += part
+    return buf
+
+
+class BucketServer:
+    """Serves this process's shuffle buckets and broadcast chunks."""
+
+    def __init__(self, workdir, host="0.0.0.0", port=0):
+        self.workdir = workdir
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        raw = _recv_exact(self.request, 4)
+                        (n,) = struct.unpack("!I", raw)
+                        req = pickle.loads(
+                            _recv_exact(self.request, n))
+                        try:
+                            payload = outer._serve(req)
+                            status = 0
+                        except Exception as e:
+                            payload = pickle.dumps(str(e))
+                            status = 1
+                        self.request.sendall(
+                            struct.pack("!BQ", status, len(payload))
+                            + payload)
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="dpark-bucket-server")
+
+    @property
+    def addr(self):
+        """The ADVERTISED uri: must be routable from other hosts (it
+        ships in map-output locations and pickled Broadcast handles)."""
+        host, port = self._server.server_address[:2]
+        if host == "0.0.0.0":
+            host = os.environ.get("DPARK_DCN_HOST") or _routable_host()
+        return "tcp://%s:%d" % (host, port)
+
+    def start(self):
+        self._thread.start()
+        logger.debug("bucket server on %s", self.addr)
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- request handling ----------------------------------------------
+    def _serve(self, req):
+        kind = req[0]
+        if kind == "bucket":
+            _, sid, map_id, reduce_id = req
+            path = os.path.join(self.workdir, "shuffle", str(sid),
+                                str(map_id), str(reduce_id))
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    return f.read()
+            # device-resident shuffle: export through the HBM bridge
+            from dpark_tpu import shuffle as shuffle_mod
+            from dpark_tpu.utils import compress
+            for exporter in shuffle_mod.HBM_EXPORTERS.values():
+                try:
+                    items = exporter(sid, map_id, reduce_id)
+                    return compress(pickle.dumps(items, -1))
+                except KeyError:
+                    continue
+            raise FileNotFoundError(path)
+        if kind == "bcast_meta":
+            _, bid = req
+            path = os.path.join(self.workdir, "broadcast",
+                                "b%d.meta" % bid)
+            with open(path, "rb") as f:
+                return f.read()
+        if kind == "bcast":
+            _, bid, i = req
+            path = os.path.join(self.workdir, "broadcast",
+                                "b%d.%d" % (bid, i))
+            with open(path, "rb") as f:
+                return f.read()
+        raise ValueError("unknown request %r" % (req[0],))
+
+
+def _request(sock, req):
+    blob = pickle.dumps(req, -1)
+    sock.sendall(struct.pack("!I", len(blob)) + blob)
+    status, n = struct.unpack("!BQ", _recv_exact(sock, 9))
+    payload = _recv_exact(sock, n)
+    if status:
+        raise IOError("bucket server: %s" % pickle.loads(payload))
+    return payload
+
+
+def _connect(uri, timeout):
+    assert uri.startswith("tcp://"), uri
+    host, _, port = uri[len("tcp://"):].partition(":")
+    return socket.create_connection((host, int(port)), timeout=timeout)
+
+
+def fetch(uri, req, timeout=30):
+    """One request against a tcp:// bucket server; returns payload
+    bytes.  Raises on any transport or server error (callers translate
+    to FetchFailed for lineage recovery)."""
+    with _connect(uri, timeout) as sock:
+        return _request(sock, req)
+
+
+def fetch_many(uri, reqs, timeout=30):
+    """Several requests over ONE connection (the server handler loops);
+    yields payloads in request order — e.g. all chunks of a broadcast
+    without per-chunk connect/teardown."""
+    with _connect(uri, timeout) as sock:
+        return [_request(sock, req) for req in reqs]

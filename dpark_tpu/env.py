@@ -53,6 +53,7 @@ class DparkEnv:
         self.cache = None                 # set by cache.py on start
         self.shuffle_fetcher = None       # set by shuffle.py on start
         self.session_id = None
+        self.bucket_server = None         # DCN data plane, opt-in
 
     def start(self, is_master=True, environ=None):
         if self.started:
@@ -69,6 +70,18 @@ class DparkEnv:
         from dpark_tpu.cache import Cache
         self.shuffle_fetcher = ParallelShuffleFetcher()
         self.cache = Cache(self.workdir)
+        if environ.get("DPARK_BUCKET_SERVER") \
+                or os.environ.get("DPARK_BUCKET_SERVER"):
+            self.start_bucket_server()
+
+    def start_bucket_server(self, port=0):
+        """Serve this process's shuffle buckets + broadcast chunks over
+        TCP (the DCN data plane); shuffle URIs switch to tcp://."""
+        if self.bucket_server is None:
+            from dpark_tpu.dcn import BucketServer
+            self.bucket_server = BucketServer(
+                self.workdir, port=port).start()
+        return self.bucket_server
 
     def _pick_workdir(self):
         from dpark_tpu import conf
@@ -99,6 +112,9 @@ class DparkEnv:
         self.started = False
         if self.shuffle_fetcher:
             self.shuffle_fetcher.stop()
+        if self.bucket_server is not None:
+            self.bucket_server.stop()
+            self.bucket_server = None
 
     @property
     def host(self):

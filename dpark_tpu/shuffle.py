@@ -37,9 +37,14 @@ class LocalFileShuffle:
 
     @staticmethod
     def get_server_uri(workdir=None):
+        from dpark_tpu.env import env
         if workdir is None:
-            from dpark_tpu.env import env
             workdir = env.workdir
+        # with a bucket server running, advertise the network uri so
+        # other hosts can fetch; same-host readers go through TCP too
+        # (loopback — still one copy)
+        if env.bucket_server is not None:
+            return env.bucket_server.addr
         return "file://" + workdir
 
     @staticmethod
@@ -77,6 +82,12 @@ def read_bucket(uri, shuffle_id, map_id, reduce_id):
                             str(map_id), str(reduce_id))
         with open(path, "rb") as f:
             return pickle.loads(decompress(f.read()))
+    if uri.startswith("tcp://"):
+        # cross-host fetch from the serving worker's bucket server
+        from dpark_tpu import dcn
+        payload = dcn.fetch(
+            uri, ("bucket", shuffle_id, map_id, reduce_id))
+        return pickle.loads(decompress(payload))
     raise ValueError("unsupported shuffle uri %r" % uri)
 
 
