@@ -211,8 +211,17 @@ class TPUScheduler(DAGScheduler):
         return cg, nparts, was_cached
 
     def _run_array_stage(self, stage, tasks, plan, report):
+        import time as _time
+        t0 = _time.time()
         kind, result = self.executor.run_stage(plan)
+        note = {"kind": "array",
+                "run_seconds": round(_time.time() - t0, 3)}
         if kind == "shuffle":
+            store = self.executor.shuffle_store.get(result)
+            if store is not None:
+                note["hbm_bytes"] = store.get("nbytes", 0)
+                if "host_runs" in store:
+                    note["kind"] = "array+spill"
             uri = "hbm://%d" % result
             for task in tasks:
                 report(task, "success", (uri, {}, {}))
@@ -222,4 +231,5 @@ class TPUScheduler(DAGScheduler):
                 assert isinstance(task, ResultTask)
                 value = task.func(iter(rows_per_part[task.partition]))
                 report(task, "success", (value, {}, {}))
+        self.note_stage(stage.id, **note)
         logger.debug("array path ran %s (%d tasks)", stage, len(tasks))

@@ -74,6 +74,14 @@ class JAXExecutor:
         from dpark_tpu import shuffle as shuffle_mod
         shuffle_mod.HBM_EXPORTERS[id(self)] = self.export_bucket
         self._exporter_key = id(self)
+        self._tracing = False
+        if conf.TRACE_DIR:
+            try:
+                jax.profiler.start_trace(conf.TRACE_DIR)
+                self._tracing = True
+                logger.info("jax profiler trace -> %s", conf.TRACE_DIR)
+            except Exception as e:
+                logger.warning("profiler trace unavailable: %s", e)
 
     # ------------------------------------------------------------------
     # compilation
@@ -1076,5 +1084,12 @@ class JAXExecutor:
         from dpark_tpu import shuffle as shuffle_mod
         shuffle_mod.HBM_EXPORTERS.pop(self._exporter_key, None)
         cache_mod.DEVICE_CACHES.pop(self._cache_key, None)
-        self.shuffle_store.clear()
+        if self._tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
+        for sid in list(self.shuffle_store):
+            self.drop_shuffle(sid)      # also removes spool dirs
         self.result_cache.clear()

@@ -70,6 +70,10 @@ STREAM_TEXT_BYTES = 1 << 28
 # default dtype for device-side values
 DEFAULT_DTYPE = "int32"
 
+# when set, the tpu executor writes a jax.profiler trace here for the
+# whole session (view with tensorboard / xprof)
+TRACE_DIR = os.environ.get("DPARK_TRACE_DIR")
+
 
 def load_conf(path):
     """Execute a Python conf file and overlay module-level constants.

@@ -178,9 +178,11 @@ class DparkContext:
     def partialTextFile(self, path, begin, end, splitSize=None):
         return _rdd.PartialTextFileRDD(self, path, begin, end, splitSize)
 
-    def csvFile(self, path, dialect="excel", numSplits=None):
-        return _rdd.CSVReaderRDD(
-            _rdd.TextFileRDD(self, path, numSplits), dialect)
+    def csvFile(self, path, dialect="excel", numSplits=None,
+                splitSize=None):
+        # record-aware splits: quoted fields may contain newlines
+        return _rdd.CSVFileRDD(self, path, dialect, splitSize,
+                               numSplits)
 
     def binaryFile(self, path, fmt="I", length=None, numSplits=None):
         return _rdd.BinaryFileRDD(self, path, fmt, length, numSplits)

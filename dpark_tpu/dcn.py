@@ -56,12 +56,15 @@ def _recv_exact(sock, n):
     return buf
 
 
-class BucketServer:
-    """Serves this process's shuffle buckets and broadcast chunks."""
+class FramedServer:
+    """Threaded length-prefixed request/response TCP server shared by
+    the bucket server and the chunk-server filesystem: requests are
+    pickled tuples, responses raw payload bytes with a status byte
+    (1 = pickled error string)."""
 
-    def __init__(self, workdir, host="0.0.0.0", port=0):
-        self.workdir = workdir
-        outer = self
+    def __init__(self, serve, host="0.0.0.0", port=0,
+                 name="dpark-framed-server"):
+        outer_serve = serve
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
@@ -72,7 +75,7 @@ class BucketServer:
                         req = pickle.loads(
                             _recv_exact(self.request, n))
                         try:
-                            payload = outer._serve(req)
+                            payload = outer_serve(req)
                             status = 0
                         except Exception as e:
                             payload = pickle.dumps(str(e))
@@ -89,26 +92,42 @@ class BucketServer:
 
         self._server = Server((host, port), Handler)
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="dpark-bucket-server")
+            target=self._server.serve_forever, daemon=True, name=name)
 
     @property
-    def addr(self):
-        """The ADVERTISED uri: must be routable from other hosts (it
-        ships in map-output locations and pickled Broadcast handles)."""
-        host, port = self._server.server_address[:2]
-        if host == "0.0.0.0":
-            host = os.environ.get("DPARK_DCN_HOST") or _routable_host()
-        return "tcp://%s:%d" % (host, port)
+    def bind_address(self):
+        return self._server.server_address[:2]
 
     def start(self):
         self._thread.start()
-        logger.debug("bucket server on %s", self.addr)
         return self
 
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+class BucketServer(FramedServer):
+    """Serves this process's shuffle buckets and broadcast chunks."""
+
+    def __init__(self, workdir, host="0.0.0.0", port=0):
+        self.workdir = workdir
+        super().__init__(self._serve, host, port,
+                         name="dpark-bucket-server")
+
+    @property
+    def addr(self):
+        """The ADVERTISED uri: must be routable from other hosts (it
+        ships in map-output locations and pickled Broadcast handles)."""
+        host, port = self.bind_address
+        if host == "0.0.0.0":
+            host = os.environ.get("DPARK_DCN_HOST") or _routable_host()
+        return "tcp://%s:%d" % (host, port)
+
+    def start(self):
+        super().start()
+        logger.debug("bucket server on %s", self.addr)
+        return self
 
     # -- request handling ----------------------------------------------
     def _serve(self, req):

@@ -167,3 +167,7 @@ class VerifyingReader:
 
     def close(self):
         self.f.close()
+
+
+# chunk-server DFS client registers the "cfs" scheme on import
+from dpark_tpu.file_manager import chunkserver as _chunkserver  # noqa: E402,F401

@@ -1298,29 +1298,226 @@ class WholeFileSplit(Split):
         self.path = path
 
 
-class GZipFileRDD(RDD):
-    """One split per .gz member file (gzip streams are not block-splittable
-    without an index; the reference scans deflate blocks [M] — here
-    correctness first, parallelism across files)."""
+def _scan_magic_offsets(path, prefix, magic_at, validate):
+    """Byte offsets of validated stream/member starts inside one file.
 
-    def __init__(self, ctx, path):
+    `prefix` narrows candidates cheaply; magic_at(buf, j) -> bool checks
+    the full magic at buf[j:]; validate(path, off) -> bool confirms by
+    test-decompressing a prefix.  Used for intra-file gzip member and
+    bz2 stream splitting (reference: GZipFileRDD/BZip2FileRDD scan
+    compressed block magics [M], SURVEY.md section 2.2)."""
+    from dpark_tpu import file_manager
+    offsets = [0]
+    candidates = []
+    chunk_size = 4 << 20
+    overlap = 16
+    with file_manager.open_file(path) as f:
+        pos = 0
+        tail = b""
+        while True:
+            data = f.read(chunk_size)
+            if not data:
+                break
+            buf = tail + data
+            base = pos - len(tail)
+            j = 0
+            while True:
+                j = buf.find(prefix, j)
+                if j < 0 or j > len(buf) - overlap:
+                    break
+                off = base + j
+                if off > 0 and magic_at(buf, j):
+                    candidates.append(off)
+                j += 1
+            tail = buf[-(overlap - 1):]
+            pos += len(data)
+    for off in candidates:
+        if validate(path, off):
+            offsets.append(off)
+    return offsets
+
+
+def _gzip_magic(buf, j):
+    # \x1f\x8b, deflate method, sane flag byte
+    return (buf[j:j + 3] == b"\x1f\x8b\x08" and buf[j + 3] < 0x20)
+
+
+def _bzip2_magic(buf, j):
+    # BZh<level> + block magic (BCD pi)
+    return (buf[j:j + 3] == b"BZh" and 0x31 <= buf[j + 3] <= 0x39
+            and buf[j + 4:j + 10] == b"\x31\x41\x59\x26\x53\x59")
+
+
+def _gzip_valid(path, off):
+    import zlib
+    from dpark_tpu import file_manager
+    with file_manager.open_file(path) as f:
+        f.seek(off)
+        blob = f.read(8192)
+    try:
+        zlib.decompressobj(wbits=31).decompress(blob)
+        return True
+    except zlib.error:
+        return False
+
+
+def _bzip2_valid(path, off):
+    from dpark_tpu import file_manager
+    with file_manager.open_file(path) as f:
+        f.seek(off)
+        blob = f.read(1 << 16)
+    try:
+        _bz2.BZ2Decompressor().decompress(blob)
+        return True
+    except OSError:
+        return False
+
+
+class GZipFileRDD(RDD):
+    """Intra-file splitting at gzip MEMBER boundaries: the raw bytes are
+    scanned for validated member magics and consecutive members group
+    into ~splitSize compressed splits, each decompressed independently
+    (reference: GZipFileRDD block scanning [M]).  A single-member file
+    still yields one split — gzip streams aren't block-splittable
+    without an index."""
+
+    def __init__(self, ctx, path, splitSize=None):
         super().__init__(ctx)
         self.paths = [p for p, _ in TextFileRDD._expand(path)]
+        self.split_size = splitSize or DEFAULT_BLOCK
+
+    def _magic(self):
+        return b"\x1f\x8b", _gzip_magic, _gzip_valid
 
     def _make_splits(self):
-        return [WholeFileSplit(i, p) for i, p in enumerate(self.paths)]
+        from dpark_tpu import file_manager
+        prefix, magic, valid = self._magic()
+        splits = []
+        for p in self.paths:
+            size = file_manager.file_size(p)
+            offs = _scan_magic_offsets(p, prefix, magic, valid) + [size]
+            begin = offs[0]
+            for i in range(1, len(offs)):
+                if offs[i] - begin >= self.split_size \
+                        or offs[i] == size:
+                    if offs[i] > begin:
+                        splits.append(TextSplit(len(splits), p,
+                                                begin, offs[i]))
+                    begin = offs[i]
+        return splits
+
+    def _open(self, raw):
+        import io
+        return _gzip.GzipFile(fileobj=io.BytesIO(raw))
 
     def compute(self, split):
-        with _gzip.open(split.path, "rb") as f:
+        from dpark_tpu import file_manager
+        with file_manager.open_file(split.path) as f:
+            f.seek(split.begin)
+            raw = f.read(split.end - split.begin)
+        with self._open(raw) as f:
             for line in f:
                 yield line.rstrip(b"\r\n").decode("utf-8", "replace")
 
 
 class BZip2FileRDD(GZipFileRDD):
+    """Intra-file splitting at bz2 STREAM boundaries (byte-aligned
+    "BZh" starts; intra-stream blocks are bit-aligned and stay within
+    one split)."""
+
+    def _magic(self):
+        return b"BZh", _bzip2_magic, _bzip2_valid
+
+    def _open(self, raw):
+        import io
+        return _bz2.BZ2File(io.BytesIO(raw))
+
+def _scan_csv_boundaries(path, split_size, quotechar='"'):
+    """Record-aligned split offsets for a CSV file: newline positions at
+    EVEN quote parity (a doubled quote inside a quoted field toggles
+    twice, preserving parity), vectorized with numpy.  Quoted fields may
+    therefore contain newlines without breaking split boundaries
+    (reference: csv record handling, SURVEY.md section 2.2)."""
+    import numpy as np
+    from dpark_tpu import file_manager
+    bounds = [0]
+    target = split_size
+    quotes_before = 0
+    pos = 0
+    qbyte = ord(quotechar)
+    with file_manager.open_file(path) as f:
+        while True:
+            chunk = f.read(8 << 20)
+            if not chunk:
+                break
+            arr = np.frombuffer(chunk, np.uint8)
+            qpos = np.flatnonzero(arr == qbyte)
+            npos = np.flatnonzero(arr == ord("\n"))
+            parity = (quotes_before
+                      + np.searchsorted(qpos, npos)) % 2
+            good = npos[parity == 0] + pos + 1    # offset AFTER the \n
+            # jump boundary to boundary instead of looping every newline
+            i = int(np.searchsorted(good, target))
+            while i < len(good):
+                off = int(good[i])
+                bounds.append(off)
+                target = off + split_size
+                i = int(np.searchsorted(good, target))
+            quotes_before += len(qpos)
+            pos += len(chunk)
+        size = f.tell()
+    if bounds[-1] >= size:
+        bounds.pop()
+    return bounds, size
+
+
+class CSVFileRDD(RDD):
+    """CSV with record-aware splits: boundaries land only on newlines at
+    even quote parity (per the dialect's quotechar), so a quoted field
+    containing newlines never straddles two tasks (reference: csv
+    reader [M])."""
+
+    def __init__(self, ctx, path, dialect="excel", splitSize=None,
+                 numSplits=None):
+        super().__init__(ctx)
+        files = list(TextFileRDD._expand(path))
+        self.paths = [p for p, _ in files]
+        self.dialect = dialect
+        if splitSize is None:
+            total = sum(sz for _, sz in files)
+            splitSize = (max(1, total // numSplits) if numSplits
+                         else DEFAULT_BLOCK)
+        self.split_size = splitSize
+
+    def _quotechar(self):
+        d = _csv.get_dialect(self.dialect) \
+            if isinstance(self.dialect, str) else self.dialect
+        return d.quotechar or '"'
+
+    def _make_splits(self):
+        splits = []
+        qc = self._quotechar()
+        for p in self.paths:
+            bounds, size = _scan_csv_boundaries(p, self.split_size, qc)
+            for i, b in enumerate(bounds):
+                e = bounds[i + 1] if i + 1 < len(bounds) else size
+                if e > b:
+                    splits.append(TextSplit(len(splits), p, b, e))
+        return splits
+
+    def preferred_locations(self, split):
+        from dpark_tpu import file_manager
+        return file_manager.locations(split.path, split.begin,
+                                      split.end - split.begin)
+
     def compute(self, split):
-        with _bz2.open(split.path, "rb") as f:
-            for line in f:
-                yield line.rstrip(b"\r\n").decode("utf-8", "replace")
+        import io
+        from dpark_tpu import file_manager
+        with file_manager.open_file(split.path) as f:
+            f.seek(split.begin)
+            raw = f.read(split.end - split.begin)
+        text = raw.decode("utf-8", "replace")
+        return _csv.reader(io.StringIO(text), self.dialect)
 
 
 class CSVReaderRDD(RDD):

@@ -213,6 +213,11 @@ class DAGScheduler:
             now = _time.time()
             for t in tasks:
                 submitted_at[(stage.id, t.partition)] = now
+            info = self._stage_info(record, stage.id)
+            info.update({"rdd": type(stage.rdd).__name__,
+                         "parts": stage.num_partitions,
+                         "shuffle": stage.is_shuffle_map,
+                         "started": now})
             logger.debug("submit stage %s with %d tasks", stage, len(tasks))
             in_flight[0] += len(tasks)
             self.submit_tasks(stage, tasks, report)
@@ -251,10 +256,34 @@ class DAGScheduler:
         self._next_job_id += 1
         record = {"id": self._next_job_id, "scope": final_rdd.scope_name,
                   "parts": parts, "finished": 0, "stages": stages,
-                  "seconds": 0.0, "state": "running"}
+                  "seconds": 0.0, "state": "running", "stage_info": []}
         self.history.append(record)
         del self.history[:-100]
+        self._current_record = record
         return record
+
+    def _stage_info(self, record, stage_id):
+        """The per-stage observability dict inside a job record
+        (SURVEY.md 5.1: per-stage timings/path for the web UI)."""
+        for info in record.get("stage_info", ()):
+            if info["id"] == stage_id:
+                return info
+        info = {"id": stage_id, "kind": "object", "seconds": None}
+        record.setdefault("stage_info", []).append(info)
+        return info
+
+    def note_stage(self, stage_id, **kw):
+        """Executor/backends annotate the CURRENT job's stage record
+        (e.g. kind=array, shuffle bytes) — best-effort, never raises."""
+        record = getattr(self, "_current_record", None)
+        if record is not None:
+            self._stage_info(record, stage_id).update(kw)
+
+    def _finish_stage_info(self, record, stage_id):
+        import time as _time
+        info = self._stage_info(record, stage_id)
+        if info.get("started") and info.get("seconds") is None:
+            info["seconds"] = round(_time.time() - info["started"], 3)
 
     def max_concurrency(self):
         """How many tasks can execute at once (None = unbounded/inline).
@@ -346,6 +375,9 @@ class DAGScheduler:
                         results[idx] = result
                         num_finished += 1
                         record["finished"] = num_finished
+                        if num_finished == len(output_parts):
+                            self._finish_stage_info(record,
+                                                    task.stage_id)
                         progress.tick()
                     while (next_to_yield < len(output_parts)
                            and finished[next_to_yield]):
@@ -366,6 +398,7 @@ class DAGScheduler:
                     if stage.is_available:
                         env.map_output_tracker.register_outputs(
                             stage.shuffle_dep.shuffle_id, stage.output_locs)
+                        self._finish_stage_info(record, stage.id)
                         running.discard(stage)
                         # wake children whose parents are now all ready
                         for child in list(waiting):

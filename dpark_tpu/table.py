@@ -24,7 +24,14 @@ _AS_RE = re.compile(r"^(.*?)\s+as\s+(\w+)\s*$", re.I)
 
 
 def _compile_expr(expr, fields):
-    """Compile a string expression over row fields into row -> value."""
+    """Compile a string expression over row fields into row -> value.
+
+    SECURITY NOTE: expression strings are CODE, at the same trust level
+    as a lambda passed to .map() — the restricted-builtins dict below
+    blocks accidents, not adversaries (attribute traversal escapes any
+    eval sandbox).  Never feed untrusted input to ctx.sql / where /
+    select; this matches the reference, whose table layer also evals
+    user expressions (dpark/table.py [L])."""
     code = compile(expr, "<table:%s>" % expr, "eval")
 
     def run(row):

@@ -26,17 +26,30 @@ _PAGE = """<!doctype html>
 <h2>dpark_tpu jobs</h2>
 <table id="t"><tr><th>job</th><th>scope</th><th>parts</th>
 <th>finished</th><th>stages</th><th>seconds</th><th>state</th></tr></table>
+<h2>stages</h2>
+<table id="s"><tr><th>job</th><th>stage</th><th>rdd</th><th>parts</th>
+<th>kind</th><th>seconds</th><th>device run s</th><th>HBM bytes</th>
+</tr></table>
 <script>
 async function tick() {
   const r = await fetch('/api/jobs'); const jobs = await r.json();
   const t = document.getElementById('t');
   while (t.rows.length > 1) t.deleteRow(1);
+  const s = document.getElementById('s');
+  while (s.rows.length > 1) s.deleteRow(1);
   for (const j of jobs) {
     const row = t.insertRow();
     for (const v of [j.id, j.scope, j.parts, j.finished, j.stages,
                      j.seconds, j.state])
       row.insertCell().textContent = v;
     row.className = j.state === 'done' ? 'done' : 'run';
+    for (const st of (j.stage_info || [])) {
+      const sr = s.insertRow();
+      for (const v of [j.id, st.id, st.rdd, st.parts, st.kind,
+                       st.seconds, st.run_seconds, st.hbm_bytes])
+        sr.insertCell().textContent = v === undefined ? '' : v;
+      sr.className = st.seconds === null ? 'run' : 'done';
+    }
   }
 }
 setInterval(tick, 1000); tick();

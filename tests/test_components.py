@@ -222,6 +222,46 @@ def test_web_ui_serves_history(ctx):
         server.shutdown()
 
 
+def test_stage_info_records(ctx):
+    """Per-stage observability (SURVEY.md 5.1): the job record carries
+    stage timings; the web UI surfaces them."""
+    import json
+    import urllib.request
+    from dpark_tpu.web import start_ui
+    ctx.parallelize([(i % 3, 1) for i in range(50)], 4) \
+       .reduceByKey(lambda a, b: a + b, 2).collect()
+    rec = ctx.scheduler.history[-1]
+    infos = rec["stage_info"]
+    assert len(infos) == 2                    # map + reduce stages
+    assert any(i["shuffle"] for i in infos)
+    assert all(i["seconds"] is not None for i in infos)
+    server, url = start_ui(ctx.scheduler)
+    try:
+        jobs = json.loads(urllib.request.urlopen(url + "api/jobs",
+                                                 timeout=5).read())
+        assert jobs[-1]["stage_info"][0]["parts"] >= 1
+    finally:
+        server.shutdown()
+
+
+def test_stage_info_array_kind():
+    """On the tpu master the array path annotates kind/run time."""
+    from dpark_tpu import DparkContext
+    tctx = DparkContext("tpu")
+    tctx.start()
+    try:
+        tctx.parallelize([(i % 5, 1) for i in range(200)], 8) \
+            .reduceByKey(lambda a, b: a + b, 8).collect()
+        infos = tctx.scheduler.history[-1]["stage_info"]
+        kinds = {i.get("kind") for i in infos}
+        assert "array" in kinds, infos
+        arr = [i for i in infos if i.get("kind") == "array"][0]
+        assert arr["run_seconds"] >= 0
+        assert any("hbm_bytes" in i for i in infos)
+    finally:
+        tctx.stop()
+
+
 def test_distributed_init_single():
     from dpark_tpu.distributed import init
     pid, n = init(num_processes=1, process_id=0)
