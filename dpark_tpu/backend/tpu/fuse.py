@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from dpark_tpu.backend.tpu import layout
 from dpark_tpu.dependency import HashPartitioner, RangePartitioner
 from dpark_tpu.rdd import (
-    CSVReaderRDD, DerivedRDD, FilteredRDD, FlatMappedRDD,
+    CSVFileRDD, CSVReaderRDD, DerivedRDD, FilteredRDD, FlatMappedRDD,
     FlatMappedValuesRDD, GZipFileRDD, KeyedRDD, MapPartitionsRDD,
     MappedRDD, MappedValuesRDD, ParallelCollection, ShuffledRDD,
     TextFileRDD, _SortPartFn, _append, _extend, _identity, _mk_list)
@@ -354,7 +354,7 @@ def _sample_record(pc):
 # against the user's functions on a sample prefix).
 # ----------------------------------------------------------------------
 
-_TEXT_SOURCES = (TextFileRDD, GZipFileRDD, CSVReaderRDD)
+_TEXT_SOURCES = (TextFileRDD, GZipFileRDD, CSVReaderRDD, CSVFileRDD)
 
 
 def extract_text_chain(top):

@@ -170,9 +170,9 @@ class DparkContext:
     def textFile(self, path, ext="", followLink=True, numSplits=None,
                  splitSize=None):
         if path.endswith(".gz"):
-            return _rdd.GZipFileRDD(self, path)
+            return _rdd.GZipFileRDD(self, path, splitSize, numSplits)
         if path.endswith(".bz2"):
-            return _rdd.BZip2FileRDD(self, path)
+            return _rdd.BZip2FileRDD(self, path, splitSize, numSplits)
         return _rdd.TextFileRDD(self, path, numSplits, splitSize)
 
     def partialTextFile(self, path, begin, end, splitSize=None):

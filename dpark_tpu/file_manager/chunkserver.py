@@ -84,6 +84,8 @@ class ChunkServer(FramedServer):
                     if n.startswith("."):
                         continue
                     p = os.path.join(root, n)
+                    if not os.path.isfile(p):
+                        continue          # dangling symlink / fifo
                     rel = "/" + os.path.relpath(p, self.root)
                     out.append((rel, os.path.getsize(p)))
             return out

@@ -86,6 +86,14 @@ def get_lib():
         lib.tokendict_put.restype = ctypes.c_int64
         lib.tokendict_put.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.csv_scan.restype = ctypes.c_int64
+        lib.csv_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint8,
+            ctypes.c_uint8, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
+            ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -215,3 +223,67 @@ class TokenDict:
                 raise KeyError(tid)
             return buf.raw[:n].decode("utf-8", "replace")
         return self._rev[tid].decode("utf-8", "replace")
+
+
+class CsvScanner:
+    """Incremental CSV record-boundary scanner (exact RFC4180-style
+    state machine, C++ with a pure-Python fallback): feed byte chunks,
+    collect record-start offsets >= a moving target stepped by `step`.
+    A bare quote inside an unquoted field never flips state — the case
+    where a quote-parity heuristic would corrupt records."""
+
+    def __init__(self, step, quote=b'"', delim=b","):
+        self.step = step
+        self.quote = quote[0]
+        self.delim = delim[0]
+        self.state = 2                   # field_start at file start
+        self.target = step
+        self.pos = 0
+        self.bounds = []
+        self._lib = get_lib()
+
+    def feed(self, chunk):
+        if self._lib is not None:
+            # exact upper bound: one boundary per newline, never capped
+            max_out = chunk.count(b"\n") + 2
+            out = np.empty(max_out, dtype=np.int64)
+            st = ctypes.c_int64()
+            tg = ctypes.c_int64()
+            cnt = self._lib.csv_scan(
+                chunk, len(chunk), self.quote, self.delim, self.state,
+                ctypes.byref(st), self.pos, self.target, self.step,
+                ctypes.byref(tg), out.ctypes.data, max_out)
+            self.state = st.value
+            self.target = tg.value
+            self.bounds.extend(out[:cnt].tolist())
+        else:
+            in_q = bool(self.state & 1)
+            fstart = bool(self.state & 2)
+            pending = bool(self.state & 4)
+            q, d = self.quote, self.delim
+            for i, c in enumerate(chunk):
+                if pending:
+                    pending = False
+                    if c == q:
+                        continue
+                    in_q = False
+                if in_q:
+                    if c == q:
+                        pending = True
+                    continue
+                if c == 0x0A:
+                    off = self.pos + i + 1
+                    if off >= self.target:
+                        self.bounds.append(off)
+                        self.target = off + self.step
+                    fstart = True
+                elif c == d:
+                    fstart = True
+                elif c == q and fstart:
+                    in_q = True
+                    fstart = False
+                else:
+                    fstart = False
+            self.state = ((1 if in_q else 0) | (2 if fstart else 0)
+                          | (4 if pending else 0))
+        self.pos += len(chunk)

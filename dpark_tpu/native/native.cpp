@@ -163,6 +163,54 @@ int64_t tokendict_put(void* h, const uint8_t* buf, int64_t n) {
     return id;
 }
 
+// CSV record-boundary scanner: exact RFC4180-style state machine.  A
+// quote only OPENS a quoted field at field start (after delimiter or
+// newline); inside a quoted field a doubled quote is a literal; a bare
+// quote inside an unquoted field is a literal and never flips state —
+// which is where the simpler quote-parity heuristic corrupts records.
+// Emits record-start offsets >= target stepping by `step` into out.
+// state bits: 1 = in_quoted, 2 = field_start, 4 = pending close quote.
+int64_t csv_scan(const uint8_t* buf, int64_t n, uint8_t quote,
+                 uint8_t delim, int64_t state_in, int64_t* state_out,
+                 int64_t base, int64_t target, int64_t step,
+                 int64_t* target_out, int64_t* out, int64_t max_out) {
+    bool in_quoted = state_in & 1;
+    bool field_start = state_in & 2;
+    bool pending = state_in & 4;
+    int64_t cnt = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint8_t c = buf[i];
+        if (pending) {
+            pending = false;
+            if (c == quote) continue;        // doubled quote: literal
+            in_quoted = false;               // previous quote closed
+        }
+        if (in_quoted) {
+            if (c == quote) pending = true;  // close or doubled?
+            continue;
+        }
+        if (c == '\n') {
+            int64_t off = base + i + 1;
+            if (off >= target && cnt < max_out) {
+                out[cnt++] = off;
+                target = off + step;
+            }
+            field_start = true;
+        } else if (c == delim) {
+            field_start = true;
+        } else if (c == quote && field_start) {
+            in_quoted = true;
+            field_start = false;
+        } else {
+            field_start = false;
+        }
+    }
+    *state_out = (in_quoted ? 1 : 0) | (field_start ? 2 : 0)
+               | (pending ? 4 : 0);
+    *target_out = target;
+    return cnt;
+}
+
 // Copy token `id` into out (capacity cap); returns its length or -1.
 int64_t tokendict_get(void* h, int64_t id, uint8_t* out, int64_t cap) {
     TokenDict* d = (TokenDict*)h;

@@ -1381,10 +1381,15 @@ class GZipFileRDD(RDD):
     still yields one split — gzip streams aren't block-splittable
     without an index."""
 
-    def __init__(self, ctx, path, splitSize=None):
+    def __init__(self, ctx, path, splitSize=None, numSplits=None):
         super().__init__(ctx)
-        self.paths = [p for p, _ in TextFileRDD._expand(path)]
-        self.split_size = splitSize or DEFAULT_BLOCK
+        files = list(TextFileRDD._expand(path))
+        self.paths = [p for p, _ in files]
+        if splitSize is None:
+            total = sum(sz for _, sz in files)
+            splitSize = (max(1, total // numSplits) if numSplits
+                         else DEFAULT_BLOCK)
+        self.split_size = splitSize
 
     def _magic(self):
         return b"\x1f\x8b", _gzip_magic, _gzip_valid
@@ -1432,50 +1437,66 @@ class BZip2FileRDD(GZipFileRDD):
         import io
         return _bz2.BZ2File(io.BytesIO(raw))
 
-def _scan_csv_boundaries(path, split_size, quotechar='"'):
-    """Record-aligned split offsets for a CSV file: newline positions at
-    EVEN quote parity (a doubled quote inside a quoted field toggles
-    twice, preserving parity), vectorized with numpy.  Quoted fields may
-    therefore contain newlines without breaking split boundaries
-    (reference: csv record handling, SURVEY.md section 2.2)."""
-    import numpy as np
+def _scan_csv_boundaries(path, split_size, quotechar='"',
+                         delimiter=","):
+    """Record-aligned split offsets for a CSV file via an exact
+    RFC4180-style state machine (native.CsvScanner, C++): a quote opens
+    a field only at field start, doubled quotes are literals, and a
+    bare quote inside an unquoted field never flips state — so a quoted
+    field containing newlines never straddles two splits (reference:
+    csv record handling, SURVEY.md section 2.2)."""
     from dpark_tpu import file_manager
-    bounds = [0]
-    target = split_size
-    quotes_before = 0
-    pos = 0
-    qbyte = ord(quotechar)
+    from dpark_tpu.native import CsvScanner
+    scanner = CsvScanner(split_size, quotechar.encode("utf-8"),
+                         delimiter.encode("utf-8"))
     with file_manager.open_file(path) as f:
         while True:
             chunk = f.read(8 << 20)
             if not chunk:
                 break
-            arr = np.frombuffer(chunk, np.uint8)
-            qpos = np.flatnonzero(arr == qbyte)
-            npos = np.flatnonzero(arr == ord("\n"))
-            parity = (quotes_before
-                      + np.searchsorted(qpos, npos)) % 2
-            good = npos[parity == 0] + pos + 1    # offset AFTER the \n
-            # jump boundary to boundary instead of looping every newline
-            i = int(np.searchsorted(good, target))
-            while i < len(good):
-                off = int(good[i])
-                bounds.append(off)
-                target = off + split_size
-                i = int(np.searchsorted(good, target))
-            quotes_before += len(qpos)
-            pos += len(chunk)
+            scanner.feed(chunk)
         size = f.tell()
+    bounds = [0] + scanner.bounds
     if bounds[-1] >= size:
         bounds.pop()
     return bounds, size
 
 
+import io as _io
+
+
+class _RangeRaw(_io.RawIOBase):
+    """A bounded window over an open file handle (owns and closes it):
+    lets TextIOWrapper/csv stream a split without materializing it."""
+
+    def __init__(self, f, remaining):
+        self.f = f
+        self.remaining = remaining
+
+    def readable(self):
+        return True
+
+    def readinto(self, b):
+        n = min(len(b), self.remaining)
+        if n <= 0:
+            return 0
+        data = self.f.read(n)
+        b[:len(data)] = data
+        self.remaining -= len(data)
+        return len(data)
+
+    def close(self):
+        try:
+            self.f.close()
+        finally:
+            super().close()
+
+
 class CSVFileRDD(RDD):
-    """CSV with record-aware splits: boundaries land only on newlines at
-    even quote parity (per the dialect's quotechar), so a quoted field
-    containing newlines never straddles two tasks (reference: csv
-    reader [M])."""
+    """CSV with record-aware splits: boundaries come from an exact
+    RFC4180-style scan (per the dialect's quotechar/delimiter), so a
+    quoted field containing newlines never straddles two tasks
+    (reference: csv reader [M])."""
 
     def __init__(self, ctx, path, dialect="excel", splitSize=None,
                  numSplits=None):
@@ -1489,16 +1510,18 @@ class CSVFileRDD(RDD):
                          else DEFAULT_BLOCK)
         self.split_size = splitSize
 
-    def _quotechar(self):
-        d = _csv.get_dialect(self.dialect) \
+    def _dialect_obj(self):
+        return _csv.get_dialect(self.dialect) \
             if isinstance(self.dialect, str) else self.dialect
-        return d.quotechar or '"'
 
     def _make_splits(self):
         splits = []
-        qc = self._quotechar()
+        d = self._dialect_obj()
+        qc = d.quotechar or '"'
+        delim = d.delimiter or ","
         for p in self.paths:
-            bounds, size = _scan_csv_boundaries(p, self.split_size, qc)
+            bounds, size = _scan_csv_boundaries(p, self.split_size, qc,
+                                                delim)
             for i, b in enumerate(bounds):
                 e = bounds[i + 1] if i + 1 < len(bounds) else size
                 if e > b:
@@ -1513,11 +1536,26 @@ class CSVFileRDD(RDD):
     def compute(self, split):
         import io
         from dpark_tpu import file_manager
-        with file_manager.open_file(split.path) as f:
+        f = file_manager.open_file(split.path)
+        try:
             f.seek(split.begin)
-            raw = f.read(split.end - split.begin)
-        text = raw.decode("utf-8", "replace")
-        return _csv.reader(io.StringIO(text), self.dialect)
+            # stream the bounded range: no split-sized buffers
+            raw = _RangeRaw(f, split.end - split.begin)
+            text = io.TextIOWrapper(io.BufferedReader(raw),
+                                    encoding="utf-8", errors="replace",
+                                    newline="")
+        except BaseException:
+            f.close()
+            raise
+
+        def rows():
+            # generator wrapper: abandoning the iterator (take/first,
+            # sampling) closes the handle deterministically
+            try:
+                yield from _csv.reader(text, self.dialect)
+            finally:
+                text.close()
+        return rows()
 
 
 class CSVReaderRDD(RDD):

@@ -106,3 +106,50 @@ def test_random_program_parity(seed):
     finally:
         tctx.stop()
         lctx.stop()
+
+
+def _text_chain(ctx, path, prog, splitSize):
+    r = ctx.textFile(path, splitSize=splitSize)
+    kind = prog[0]
+    if kind == "canonical":
+        r = r.flatMap(lambda line: line.split()).map(lambda w: (w, 1))
+    elif kind == "lengths":
+        r = r.flatMap(lambda line: [(w[:2], len(w))
+                                    for w in line.split()])
+    else:                       # int keys
+        r = r.map(lambda l, m=prog[1]: (len(l) % m, 1))
+    red = prog[-1]
+    if red == "sum":
+        return r.reduceByKey(lambda a, b: a + b, 4)
+    if red == "max":
+        return r.reduceByKey(lambda a, b: max(a, b), 4)
+    return r.groupByKey(4).mapValue(
+        lambda vs: sum(vs) if isinstance(vs, list) else vs)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_text_chain_parity(seed, tmp_path):
+    """Random text-source chains: host-prologue ingest + encode +
+    device shuffle == local object path, across split layouts."""
+    from dpark_tpu import DparkContext
+    rng = random.Random(1000 + seed)
+    words = ["w%d" % i for i in range(rng.choice([5, 40, 300]))]
+    p = str(tmp_path / "fuzz.txt")
+    with open(p, "w") as f:
+        for _ in range(rng.randint(200, 2000)):
+            f.write(" ".join(rng.choices(words,
+                                         k=rng.randint(1, 9))) + "\n")
+    prog = (rng.choice([("canonical",), ("lengths",),
+                        ("intkey", rng.randint(2, 9))])
+            + (rng.choice(["sum", "max", "group"]),))
+    splitSize = rng.choice([1000, 7000, None])
+
+    tctx = DparkContext("tpu")
+    lctx = DparkContext("local")
+    try:
+        got = sorted(_text_chain(tctx, p, prog, splitSize).collect())
+        expect = sorted(_text_chain(lctx, p, prog, splitSize).collect())
+        assert got == expect, "parity violation for %r" % (prog,)
+    finally:
+        tctx.stop()
+        lctx.stop()

@@ -148,6 +148,54 @@ def test_compressed_sources_over_chunkserver(ctx, tmp_path):
         srv.stop()
 
 
+def test_csv_bare_quote_in_unquoted_field(ctx, tmp_path):
+    """A stray quote in an unquoted field (legal to csv.reader) must not
+    poison later split boundaries — the exact state machine ignores it
+    where a quote-parity count would flip forever."""
+    p = str(tmp_path / "bare.csv")
+    with open(p, "w", newline="") as f:
+        f.write('1,5" nail,plain\r\n')        # bare quote, unquoted
+        for i in range(300):
+            f.write('%d,"multi\nline %d",z\r\n' % (i, i))
+    expect = list(csv.reader(open(p, newline="")))
+    r = ctx.csvFile(p, splitSize=500)
+    assert len(r.splits) > 3
+    assert r.collect() == expect
+
+
+def test_csvfile_rides_device_text_path(tmp_path):
+    """csvFile chains reach the device text-ingest path on the tpu
+    master."""
+    from dpark_tpu import DparkContext
+    p = str(tmp_path / "dev.csv")
+    with open(p, "w", newline="") as f:
+        csv.writer(f).writerows(
+            [["k%d" % (i % 7), str(i % 3)] for i in range(500)])
+    tctx = DparkContext("tpu")
+    tctx.start()
+    try:
+        got = dict(tctx.csvFile(p)
+                   .map(lambda row: (row[0], int(row[1])))
+                   .reduceByKey(lambda a, b: a + b, 4).collect())
+        assert tctx.scheduler.executor.shuffle_store, "host fallback"
+        lctx = DparkContext("local")
+        expect = dict(lctx.csvFile(p)
+                      .map(lambda row: (row[0], int(row[1])))
+                      .reduceByKey(lambda a, b: a + b, 4).collect())
+        lctx.stop()
+        assert got == expect
+    finally:
+        tctx.stop()
+
+
+def test_gzip_splitsize_via_textfile(ctx, tmp_path):
+    p = str(tmp_path / "s.gz")
+    expect = _write_multi_member_gz(p, 4, 100)
+    r = ctx.textFile(p, splitSize=1)       # forwarded to member grouping
+    assert len(r.splits) == 4
+    assert r.collect() == expect
+
+
 def test_csv_roundtrip_save_load(ctx, tmp_path):
     data = [["a", "1"], ["b", "2"], ["c,d", "3"]]
     ctx.parallelize(data, 2).saveAsCSVFile(str(tmp_path / "csv"))
