@@ -49,16 +49,19 @@ def run_wordcount(ctx, path, n_parts):
     return {"top": top[0][1], "distinct": r.count()}
 
 
-def run_sortgroup(ctx, gb, n_parts):
+def run_sortgroup(ctx, gb, n_parts, reduce_parts=64):
     """Config #1 over columnar input: sortByKey + groupByKey with the
-    spilled-run streaming path (HBM + spool bounded; input in RAM)."""
+    spilled-run streaming path (HBM + spool bounded; input in RAM).
+    reduce_parts > mesh keeps each reduce partition small — the rid
+    column rides the exchange."""
     import numpy as np
     from dpark_tpu import Columns
-    n = (gb * (1 << 30)) // 16            # two int64 columns
+    n = int(gb * (1 << 30)) // 16         # two int64 columns
     keys = (np.arange(n, dtype=np.int64) * 2654435761) % (10 ** 9)
     vals = np.arange(n, dtype=np.int64) & 0xFFFF
     data = Columns(keys, vals)
-    s = ctx.parallelize(data, n_parts).sortByKey(numSplits=n_parts)
+    s = ctx.parallelize(data, n_parts).sortByKey(
+        numSplits=reduce_parts)
     first_keys = [k for k, _ in s.take(3)]
     g = (ctx.parallelize(data, n_parts)
          .map(lambda kv: (kv[0] % 1000, kv[1]))

@@ -121,8 +121,11 @@ def main():
                  "--degree", str(args.degree),
                  "--steps", str(args.steps)],
                 capture_output=True, text=True)
-            sys.stderr.write(out.stderr[-500:])
+            sys.stderr.write(out.stderr[-2000:])
             print(out.stdout, end="")
+            if out.returncode != 0 or not out.stdout.strip():
+                sys.exit("object-mode child failed (rc=%d)"
+                         % out.returncode)
             obj = json.loads(out.stdout.splitlines()[-1])
         else:
             wall, total = run_object(args.vertices, args.degree,

@@ -276,6 +276,10 @@ class JAXExecutor:
             if kind == "monoid":
                 return self._run_streamed_shuffle(plan, waves)
             return self._run_streamed_nocombine(plan, waves)
+        if getattr(plan, "logical_spill", False):
+            # analyze only admits logical_spill when the input clears
+            # the streaming bar, so this is a safety net, not a route
+            raise ValueError("logical_spill plan without streaming")
         if plan.source[0] == "text":
             outs = self._run_narrow(plan, self._ingest_text(plan))
             return self._finish_stage(plan, outs)
@@ -659,36 +663,120 @@ class JAXExecutor:
             "single_map": plan.source[0] == "text",
         })
 
+    def _compile_stream_nocombine(self, plan, cap, nleaves_in, r):
+        """Map-side program for the spilled-run stream: narrow ops, then
+        LOGICAL partition assignment (rid in [0, r), r may exceed the
+        mesh), then bucketize by rid % ndev with rid riding along as an
+        extra column."""
+        key = ("snc", plan.program_key, cap, nleaves_in, r)
+        if key in self._compiled:
+            return self._compiled[key]
+        ops = plan.ops
+        ndev = self.ndev
+        has_bounds = plan.epi_bounds is not None
+        ascending = (plan.epi_spec[1] if plan.epi_spec[0] == "range"
+                     else True)
+        # the rid column rides the exchange only when needed: with
+        # r <= ndev the receiving device IS the logical partition
+        carry_rid = r > ndev
+
+        def per_device(counts, *rest):
+            n = counts[0]
+            bounds = rest[0][0] if has_bounds else None
+            leaves = rest[1:] if has_bounds else rest
+            lv = [l[0] for l in leaves]
+            for op in ops:
+                lv, n = op.apply(lv, n)
+            k = lv[0]
+            capn = k.shape[0]
+            valid = jnp.arange(capn) < n
+            if has_bounds:
+                rid = collectives.range_dst(k, bounds, ascending,
+                                            r, valid, r=r)
+            else:
+                rid = collectives.hash_dst(k, r, valid, r=r)
+            if carry_rid:
+                dev = jnp.where(valid, rid % ndev,
+                                ndev).astype(jnp.int32)
+                cols = [rid.astype(jnp.int64)] + lv
+            else:
+                dev = jnp.where(valid, rid, ndev).astype(jnp.int32)
+                cols = lv
+            sorted_lv, cnts, offs = collectives.bucketize(
+                k, cols, n, ndev, dst=dev)
+            out = (cnts, offs) + tuple(sorted_lv)
+            return tuple(jnp.expand_dims(o, 0) for o in out)
+
+        n_in = 1 + nleaves_in + (1 if has_bounds else 0)
+        n_out = 2 + (1 if carry_rid else 0) + len(plan.out_specs)
+        fn = _shard_map(per_device, self.mesh,
+                        in_specs=(P(AXIS),) * n_in,
+                        out_specs=(P(AXIS),) * n_out)
+        self._compiled[key] = jax.jit(fn)
+        return self._compiled[key]
+
     def _run_streamed_nocombine(self, plan, waves):
         """No-combine shuffle (sortByKey range exchange, groupByKey,
-        partitionBy) over big input: each wave exchanges, sorts by key
-        on device, and spills one key-sorted run per reduce partition to
-        host disk; the export bridge heap-merges the runs lazily.  HBM
-        holds one wave; host RAM holds one wave of rows."""
+        partitionBy) over big input: each wave exchanges (with the
+        LOGICAL partition id riding along when r exceeds the mesh),
+        sorts by (rid, key) on device, and spills one key-sorted COLUMN
+        run per logical partition to host disk; the export bridge
+        merges a partition's runs eagerly with one stable argsort when
+        a reduce task asks for it.  HBM holds one wave; host RAM holds
+        one wave of columns (no Python row objects until the reduce).
+        r may exceed the mesh size — the cure for partition-sized
+        reduce memory."""
         import os
         from dpark_tpu.env import env
         dep = plan.epilogue[1]
+        r = dep.partitioner.num_partitions
         # unique per run: a re-run must never write into (then delete,
         # via the old store's drop_shuffle) the same directory
         self._spool_seq = getattr(self, "_spool_seq", 0) + 1
         spool = os.path.join(env.workdir, "hbmruns", "%d-%d"
                              % (dep.shuffle_id, self._spool_seq))
         os.makedirs(spool, exist_ok=True)
-        runs = [[] for _ in range(self.ndev)]
+        runs = [[] for _ in range(r)]
         bounds = self._bounds_arg(plan)
         for c, parts in enumerate(waves):
             batch = layout.ingest(self.mesh, parts, plan.in_treedef,
                                   plan.in_specs, key_leaf=0)
-            outs = self._run_narrow(plan, batch, bounds=bounds)
+            jitted = self._compile_stream_nocombine(
+                plan, batch.cap, len(batch.cols), r)
+            args = (batch.counts,) + ((bounds,) if bounds is not None
+                                      else ()) + tuple(batch.cols)
+            outs = jitted(*args)
             cnts, offs = outs[0], outs[1]
-            leaves = list(outs[2:])
+            leaves = list(outs[2:])          # [rid +] row leaves
+            carry_rid = r > self.ndev
             recv = self._exchange_all(leaves, cnts, offs)
-            sorted_batch = self._sort_received(plan, recv)
-            for d, rows in enumerate(layout.egest(sorted_batch)):
-                if rows:
+            sorted_batch = self._sort_received(
+                plan, recv, nkeys=2 if carry_rid else 1)
+            # spill NUMPY COLUMNS per logical partition — no Python row
+            # objects materialize at spill time (rows arrive sorted by
+            # (rid, key); rid boundaries come from searchsorted)
+            counts = np.asarray(jax.device_get(sorted_batch.counts))
+            cols = [np.asarray(jax.device_get(l))
+                    for l in sorted_batch.cols]
+            for d in range(self.ndev):
+                n = int(counts[d])
+                if not n:
+                    continue
+                if not carry_rid:            # device IS the partition
                     path = os.path.join(spool, "%d-%d" % (d, c))
-                    self._write_run(path, rows)
+                    self._write_run(path, [col[d, :n] for col in cols])
                     runs[d].append(path)
+                    continue
+                rid = cols[0][d, :n]
+                uniq = np.unique(rid)
+                los = np.searchsorted(rid, uniq, side="left")
+                his = np.searchsorted(rid, uniq, side="right")
+                for u, lo, hi in zip(uniq.tolist(), los.tolist(),
+                                     his.tolist()):
+                    path = os.path.join(spool, "%d-%d-%d" % (u, c, d))
+                    self._write_run(
+                        path, [col[d, lo:hi] for col in cols[1:]])
+                    runs[int(u)].append(path)
             logger.debug("streamed no-combine wave %d", c + 1)
         return self._register_shuffle(dep, plan, {
             "leaves": [], "counts": None, "offsets": None,
@@ -698,12 +786,15 @@ class JAXExecutor:
             "single_map": True,
         })
 
-    def _sort_received(self, plan, recv):
-        """Flatten exchange rounds and key-sort per device -> Batch."""
+    def _sort_received(self, plan, recv, nkeys=1):
+        """Flatten exchange rounds and sort per device by the first
+        `nkeys` leaves -> Batch (extra leading leaves beyond
+        plan.out_specs, e.g. the rid column, ride along)."""
         recv_rounds, cnt_rounds, slot = recv
         rounds = len(recv_rounds)
         nleaves = len(recv_rounds[0])
-        key = ("wave_sort", plan.program_key, rounds, slot, nleaves)
+        key = ("wave_sort", plan.program_key, rounds, slot, nleaves,
+               nkeys)
         if key not in self._compiled:
             def per_device(*args):
                 cnts = [c[0] for c in args[:rounds]]
@@ -713,7 +804,7 @@ class JAXExecutor:
                     recvs.append([bufs[r * nleaves + li][0]
                                   for li in range(nleaves)])
                 flat, mask = collectives.flatten_received(recvs, cnts)
-                packed = collectives._lex_sort(tuple(flat), 1)
+                packed = collectives._lex_sort(tuple(flat), nkeys)
                 n = jnp.sum(mask).astype(jnp.int32)
                 out = (jnp.expand_dims(n, 0),) + tuple(
                     jnp.expand_dims(l, 0) for l in packed)
@@ -728,7 +819,18 @@ class JAXExecutor:
         for r in range(rounds):
             args.extend(recv_rounds[r])
         outs = self._compiled[key](*args)
-        return layout.Batch(plan.out_treedef, list(outs[1:]), outs[0])
+        leaves = list(outs[1:])
+        extra = len(leaves) - len(plan.out_specs)
+        treedef = plan.out_treedef
+        if extra:
+            # prepend the rid column FLAT: egested rows read
+            # (rid, k, v...) so callers can strip row[0]
+            import jax.tree_util as jtu
+            sample = jtu.tree_unflatten(
+                treedef, list(range(len(plan.out_specs))))
+            assert extra == 1 and isinstance(sample, tuple), sample
+            treedef = jtu.tree_structure((0,) + sample)
+        return layout.Batch(treedef, leaves, outs[0])
 
     @staticmethod
     def _write_run(path, rows):
@@ -993,15 +1095,25 @@ class JAXExecutor:
                 treedef, [pl[i] for pl in lists]) for i in range(cnt)]
             return self._maybe_decode(store, rows)
         if "host_runs" in store:
-            # streamed no-combine shuffle: key-sorted runs on host disk,
-            # heap-merged here; the whole shuffle exports through map 0
+            # streamed no-combine shuffle: per-partition COLUMN runs on
+            # host disk, merged here by one stable argsort; the whole
+            # shuffle exports through map 0
             if map_id != 0:
                 return []
-            import heapq
-            its = [iter(self._read_run(p))
-                   for p in store["host_runs"][reduce_id]]
-            rows = [(r[0], [r[1]])
-                    for r in heapq.merge(*its, key=lambda r: r[0])]
+            paths = store["host_runs"][reduce_id]
+            if not paths:
+                return []
+            parts = [self._read_run(p) for p in paths]
+            cols = [np.concatenate([pt[li] for pt in parts])
+                    for li in range(len(parts[0]))]
+            order = np.argsort(cols[0], kind="stable")
+            lists = [c[order].tolist() for c in cols]
+            treedef = store["out_treedef"]
+            rows = []
+            for i in range(len(lists[0])):
+                rec = jax.tree_util.tree_unflatten(
+                    treedef, [pl[i] for pl in lists])
+                rows.append((rec[0], [rec[1]]))
             return self._maybe_decode(store, rows)
         if store.get("single_map"):
             # device rows don't correspond to logical map partitions

@@ -430,8 +430,11 @@ def analyze_text_stage(stage, ndev, executor_or_store):
         return None
     text_rdd, chain = extracted
     dep = stage.shuffle_dep
+    logical_spill = False
     if dep.partitioner.num_partitions > ndev:
-        return None
+        if not (is_list_agg(dep.aggregator) and _big_text(stage)):
+            return None              # small input: object path
+        logical_spill = True         # spilled-run stream handles r>ndev
     epi_spec = partitioner_spec(dep.partitioner)
     if epi_spec is None:
         return None
@@ -480,6 +483,7 @@ def analyze_text_stage(stage, ndev, executor_or_store):
     plan.text_rdd = text_rdd
     plan.text_chain = chain
     plan.encoded_keys = key_is_str
+    plan.logical_spill = logical_spill
     plan.canonical = (key_is_str and type(text_rdd) is TextFileRDD
                       and canonical_wordcount(chain))
     plan.program_key = plan.program_key + (False, False, epi_spec)
@@ -504,6 +508,25 @@ def _leaves_merge_fn(merge, nleaves):
     def merged(va_leaves, vb_leaves):
         return list(vfn(*(list(va_leaves) + list(vb_leaves))))
     return merged
+
+
+def _big_columnar(pc):
+    """ParallelCollection big enough for the wave stream (the r > ndev
+    spill requires streaming)."""
+    from dpark_tpu import conf
+    from dpark_tpu.rdd import _ColumnarSlice
+    slices = pc._slices
+    return (all(isinstance(s, _ColumnarSlice) for s in slices)
+            and max((len(s) for s in slices), default=0)
+            > conf.STREAM_CHUNK_ROWS)
+
+
+def _big_text(stage):
+    """Text source big enough for the wave stream."""
+    from dpark_tpu import conf
+    sizes = [max(0, getattr(sp, "end", 0) - getattr(sp, "begin", 0))
+             for sp in stage.rdd.splits]
+    return sum(sizes) > conf.STREAM_TEXT_BYTES
 
 
 def _numeric_key(specs):
@@ -608,10 +631,19 @@ def analyze_stage(stage, ndev, executor_or_store):
     epilogue = None
     epi_spec = None
     epi_bounds = None
+    logical_spill = False
     if stage.is_shuffle_map:
         dep = stage.shuffle_dep
         if dep.partitioner.num_partitions > ndev:
-            return None                  # R <= ndev: extra devices idle
+            # more logical partitions than devices: only the spilled
+            # no-combine stream supports this (rid rides the exchange,
+            # runs land per logical partition).  Small inputs go to the
+            # object path HERE, not via an executor error.
+            if not (is_list_agg(dep.aggregator)
+                    and source[0] == "ingest"
+                    and _big_columnar(source[1])):
+                return None
+            logical_spill = True
         epi_spec = partitioner_spec(dep.partitioner)
         if epi_spec is None:
             return None
@@ -646,6 +678,7 @@ def analyze_stage(stage, ndev, executor_or_store):
     plan.group_output = group_output
     plan.epi_spec = epi_spec
     plan.epi_bounds = epi_bounds
+    plan.logical_spill = logical_spill
     plan.program_key = plan.program_key + (
         src_combine, group_output, epi_spec)
     return plan

@@ -261,6 +261,20 @@ class CsvScanner:
             fstart = bool(self.state & 2)
             pending = bool(self.state & 4)
             q, d = self.quote, self.delim
+            if not in_q and not pending \
+                    and bytes([q]) not in chunk:
+                # vectorized fast path: no quotes in this chunk means
+                # every newline ends a record
+                npos = np.flatnonzero(
+                    np.frombuffer(chunk, np.uint8) == 0x0A)
+                for off in (npos + self.pos + 1).tolist():
+                    if off >= self.target:
+                        self.bounds.append(off)
+                        self.target = off + self.step
+                last = chunk[-1:]
+                self.state = 2 if last in (b"\n", bytes([d])) else 0
+                self.pos += len(chunk)
+                return
             for i, c in enumerate(chunk):
                 if pending:
                     pending = False

@@ -167,6 +167,31 @@ def test_spool_cleanup_on_drop(tctx, tiny_waves):
     assert not any(os.path.isdir(d) for d in spools)
 
 
+def test_logical_partitions_beyond_mesh(tctx, tiny_waves):
+    """r > ndev: the spilled-run stream carries the LOGICAL partition id
+    through the exchange, so big sorts/groups can use many small reduce
+    partitions (bounded reduce memory) instead of mesh-sized ones."""
+    rng = np.random.RandomState(11)
+    keys = rng.randint(0, 10**6, 20000).astype(np.int64)
+    vals = np.arange(20000, dtype=np.int64)
+    got = tctx.parallelize(Columns(keys, vals), 8) \
+              .sortByKey(numSplits=32).collect()
+    assert _spilled(tctx)
+    store = [s for s in tctx.scheduler.executor.shuffle_store.values()
+             if "host_runs" in s][0]
+    assert len(store["host_runs"]) == 32
+    assert [k for k, _ in got] == sorted(keys.tolist())
+    assert sorted(got) == sorted(zip(keys.tolist(), vals.tolist()))
+
+    g = {k: sorted(v) for k, v in
+         tctx.parallelize(Columns(keys % 101, vals), 8)
+         .groupByKey(64).collect()}
+    expect = {}
+    for k, v in zip((keys % 101).tolist(), vals.tolist()):
+        expect.setdefault(k, []).append(v)
+    assert g == {k: sorted(v) for k, v in expect.items()}
+
+
 def test_spilled_rerun_keeps_new_spool(tctx, tiny_waves):
     """Re-running a spilled map stage while the OLD store is still
     registered must not delete the new run files (per-run spool dirs)."""
