@@ -588,21 +588,19 @@ class JAXExecutor:
         no_combine = fuse.is_list_agg(dep.aggregator)
         monoid = None if no_combine else fuse.classify_merge(
             dep.aggregator.merge_combiners)
+        # ONE eligibility predicate shared with fuse's analyze-time
+        # logical_spill gate — divergence would turn the run_stage
+        # safety net into a user-facing error
         if plan.source[0] == "ingest":
-            from dpark_tpu.rdd import _ColumnarSlice
-            slices = plan.source[1]._slices
-            if not all(isinstance(s, _ColumnarSlice) for s in slices):
-                return None
-            if max((len(s) for s in slices), default=0) \
-                    <= conf.STREAM_CHUNK_ROWS:
+            if not fuse._big_columnar(plan.source[1]):
                 return None
             waves = self._wave_iter_columnar(plan)
         elif plan.source[0] == "text":
+            if not fuse._big_text(plan.stage):
+                return None
             sizes = [max(0, getattr(sp, "end", 0)
                          - getattr(sp, "begin", 0))
                      for sp in plan.stage.rdd.splits]
-            if sum(sizes) <= conf.STREAM_TEXT_BYTES:
-                return None
             waves = self._wave_iter_text(plan, sizes)
         else:
             return None
@@ -1108,12 +1106,16 @@ class JAXExecutor:
                     for li in range(len(parts[0]))]
             order = np.argsort(cols[0], kind="stable")
             lists = [c[order].tolist() for c in cols]
-            treedef = store["out_treedef"]
-            rows = []
-            for i in range(len(lists[0])):
-                rec = jax.tree_util.tree_unflatten(
-                    treedef, [pl[i] for pl in lists])
-                rows.append((rec[0], [rec[1]]))
+            if len(lists) == 2:
+                # flat (k, v) records — one zip, no per-row treedef work
+                rows = [(k, [v]) for k, v in zip(lists[0], lists[1])]
+            else:
+                treedef = store["out_treedef"]
+                rows = []
+                for i in range(len(lists[0])):
+                    rec = jax.tree_util.tree_unflatten(
+                        treedef, [pl[i] for pl in lists])
+                    rows.append((rec[0], [rec[1]]))
             return self._maybe_decode(store, rows)
         if store.get("single_map"):
             # device rows don't correspond to logical map partitions

@@ -556,6 +556,15 @@ def analyze_stage(stage, ndev, executor_or_store):
     source_rdd, ops, passthrough = extracted
     group_output = False
 
+    if (not stage.is_shuffle_map and not ops
+            and isinstance(source_rdd, ParallelCollection)
+            and source_rdd.id not in cached_ids):
+        # a result stage that would only ingest + egest the input does
+        # no device work at all — and egesting a huge columnar input as
+        # Python rows is exactly what a lazy host read avoids (e.g.
+        # sortByKey's bounds sample takes 250 rows per slice)
+        return None
+
     # -- source record spec ---------------------------------------------
     if source_rdd.id in cached_ids:
         meta = executor_or_store.result_cache_meta(source_rdd.id)

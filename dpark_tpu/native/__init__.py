@@ -243,6 +243,8 @@ class CsvScanner:
         self._lib = get_lib()
 
     def feed(self, chunk):
+        if not chunk:
+            return                       # state must survive empty reads
         if self._lib is not None:
             # exact upper bound: one boundary per newline, never capped
             max_out = chunk.count(b"\n") + 2

@@ -1132,10 +1132,18 @@ class _ColumnarSlice:
         return row[0] if len(row) == 1 else row
 
     def __iter__(self):
-        lists = [c.tolist() for c in self.columns]
-        if len(lists) == 1:
-            return iter(lists[0])
-        return zip(*lists)
+        # tolist() in bounded chunks: a take/sample over a huge column
+        # must not materialize the whole slice as Python objects
+        chunk = 1 << 16
+        n = len(self)
+        if len(self.columns) == 1:
+            col = self.columns[0]
+            for off in range(0, n, chunk):
+                yield from col[off:off + chunk].tolist()
+            return
+        for off in range(0, n, chunk):
+            yield from zip(*(c[off:off + chunk].tolist()
+                             for c in self.columns))
 
 
 class Columns:
