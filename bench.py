@@ -17,6 +17,9 @@ import sys
 import time
 
 N_PAIRS = int(os.environ.get("BENCH_PAIRS", 16_000_000))
+# with a REAL device reachable the default rises to a non-toy size
+# (main() sets this after the probe; BENCH_PAIRS always wins)
+N_PAIRS_DEVICE_DEFAULT = 64_000_000
 N_KEYS = int(os.environ.get("BENCH_KEYS", 65_536))
 # two int64 columns (16 bytes/pair) — computed from the real dtypes in
 # make_data below, kept in sync by an assert there
@@ -170,18 +173,29 @@ def _run_tpu_with_timeout(timeout, env=None):
 
 
 def main():
+    global N_PAIRS, BYTES
     if "--tpu-only" in sys.argv:
         _tpu_phase()
         return
     if "--probe" in sys.argv:
         _probe_phase()
         return
+    # probe FIRST (cheap): a real chip raises the default workload out
+    # of toy range; the wedged-tunnel case costs two 30s attempts.
+    # An explicitly requested platform (BENCH_PLATFORM=cpu in CI) keeps
+    # the toy size — only an actual device earns the big run.
+    reachable = _device_reachable()
+    if reachable and "BENCH_PAIRS" not in os.environ \
+            and os.environ.get("BENCH_PLATFORM") is None:
+        N_PAIRS = N_PAIRS_DEVICE_DEFAULT
+        BYTES = N_PAIRS * 16
+        os.environ["BENCH_PAIRS"] = str(N_PAIRS)   # child agrees
     data = make_data()
     t_proc = bench_process(data)
     del data                 # the child regenerates its own copy
     emulated = False
     tpu = None
-    if _device_reachable():
+    if reachable:
         tpu = _run_tpu_with_timeout(
             int(os.environ.get("BENCH_TPU_TIMEOUT", 900)))
     if tpu is None and not os.environ.get("BENCH_PLATFORM"):

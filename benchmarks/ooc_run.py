@@ -56,10 +56,12 @@ def run_sortgroup(ctx, gb, n_parts, reduce_parts=64):
     column rides the exchange."""
     import numpy as np
     from dpark_tpu import Columns, conf
-    # smaller waves: on the CPU-emulated mesh every device buffer lives
-    # in host RSS, so the wave working-set multiplier (~10x across the
-    # program pipeline) must stay a fraction of the input size
-    conf.STREAM_CHUNK_ROWS = 1 << 20
+    if os.environ.get("DPARK_TPU_PLATFORM") == "cpu":
+        # smaller waves: on the CPU-emulated mesh every device buffer
+        # lives in host RSS, so the wave working-set multiplier (~10x
+        # across the program pipeline) must stay a fraction of the
+        # input; a real chip keeps full waves
+        conf.STREAM_CHUNK_ROWS = 1 << 20
     n = int(gb * (1 << 30)) // 16         # two int64 columns
     keys = (np.arange(n, dtype=np.int64) * 2654435761) % (10 ** 9)
     vals = np.arange(n, dtype=np.int64) & 0xFFFF

@@ -1106,7 +1106,8 @@ class JAXExecutor:
                     for li in range(len(parts[0]))]
             order = np.argsort(cols[0], kind="stable")
             lists = [c[order].tolist() for c in cols]
-            if len(lists) == 2:
+            flat2 = jax.tree_util.tree_structure((0, 0))
+            if store["out_treedef"] == flat2:
                 # flat (k, v) records — one zip, no per-row treedef work
                 rows = [(k, [v]) for k, v in zip(lists[0], lists[1])]
             else:
