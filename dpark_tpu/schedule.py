@@ -217,6 +217,7 @@ class DAGScheduler:
             info.update({"rdd": type(stage.rdd).__name__,
                          "parts": stage.num_partitions,
                          "shuffle": stage.is_shuffle_map,
+                         "parents": [p.id for p in stage.parents],
                          "started": now})
             logger.debug("submit stage %s with %d tasks", stage, len(tasks))
             in_flight[0] += len(tasks)
@@ -444,10 +445,14 @@ class DAGScheduler:
                                              failures[key], payload))
                 logger.warning("task %r failed (try %d): %s",
                                task, failures[key], str(payload)[:200])
-                task.tried += 1
+                # a retry is a FRESH attempt with its own task id — no
+                # shared-object mutation between attempts, so completion
+                # attribution stays unambiguous when dispatch crosses
+                # process/host boundaries
+                retry = task.retry_copy()
                 in_flight[0] += 1
                 submitted_at[tkey] = _time.time()
-                self.submit_tasks(stage, [task], report)
+                self.submit_tasks(stage, [retry], report)
 
     # -- master-specific -------------------------------------------------
     def submit_tasks(self, stage, tasks, report):

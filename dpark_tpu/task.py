@@ -22,6 +22,17 @@ class Task:
     def run(self, attempt_id):
         raise NotImplementedError
 
+    def retry_copy(self):
+        """A fresh attempt of the same work with its own task id; the
+        retry counter carries over (memory-limit escalation keys on
+        it)."""
+        import copy
+        t = copy.copy(self)
+        Task._next_id[0] += 1
+        t.id = Task._next_id[0]
+        t.tried = self.tried + 1
+        return t
+
     def preferred_locations(self):
         return []
 

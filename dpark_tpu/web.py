@@ -27,9 +27,9 @@ _PAGE = """<!doctype html>
 <table id="t"><tr><th>job</th><th>scope</th><th>parts</th>
 <th>finished</th><th>stages</th><th>seconds</th><th>state</th></tr></table>
 <h2>stages</h2>
-<table id="s"><tr><th>job</th><th>stage</th><th>rdd</th><th>parts</th>
-<th>kind</th><th>seconds</th><th>device run s</th><th>HBM bytes</th>
-</tr></table>
+<table id="s"><tr><th>job</th><th>stage</th><th>dag</th><th>rdd</th>
+<th>parts</th><th>kind</th><th>seconds</th><th>device run s</th>
+<th>HBM bytes</th></tr></table>
 <script>
 async function tick() {
   const r = await fetch('/api/jobs'); const jobs = await r.json();
@@ -45,7 +45,9 @@ async function tick() {
     row.className = j.state === 'done' ? 'done' : 'run';
     for (const st of (j.stage_info || [])) {
       const sr = s.insertRow();
-      for (const v of [j.id, st.id, st.rdd, st.parts, st.kind,
+      const dag = (st.parents && st.parents.length)
+        ? st.parents.join(',') + ' → ' + st.id : String(st.id);
+      for (const v of [j.id, st.id, dag, st.rdd, st.parts, st.kind,
                        st.seconds, st.run_seconds, st.hbm_bytes])
         sr.insertCell().textContent = v === undefined ? '' : v;
       sr.className = st.seconds === null ? 'run' : 'done';

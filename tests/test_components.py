@@ -235,6 +235,10 @@ def test_stage_info_records(ctx):
     assert len(infos) == 2                    # map + reduce stages
     assert any(i["shuffle"] for i in infos)
     assert all(i["seconds"] is not None for i in infos)
+    # DAG edges: the result stage names the map stage as its parent
+    by_id = {i["id"]: i for i in infos}
+    child = [i for i in infos if i["parents"]][0]
+    assert by_id[child["parents"][0]]["shuffle"]
     server, url = start_ui(ctx.scheduler)
     try:
         jobs = json.loads(urllib.request.urlopen(url + "api/jobs",
