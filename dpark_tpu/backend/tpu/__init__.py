@@ -26,17 +26,12 @@ class TPUScheduler(DAGScheduler):
     def start(self):
         super().start()
         if self.executor is None:
-            import os
             import jax
-            if os.environ.get("DPARK_TPU_PLATFORM"):
-                # select the mesh platform before backend init (e.g. `cpu`
-                # with --xla_force_host_platform_device_count for a virtual
-                # mesh without touching a TPU tunnel)
-                try:
-                    jax.config.update(
-                        "jax_platforms", os.environ["DPARK_TPU_PLATFORM"])
-                except Exception:
-                    pass
+            # select the mesh platform before backend init (e.g. `cpu`
+            # with --xla_force_host_platform_device_count for a virtual
+            # mesh without touching a TPU tunnel)
+            from dpark_tpu.utils import apply_platform_override
+            apply_platform_override()
             from dpark_tpu.backend.tpu.executor import JAXExecutor
             devices = jax.devices()
             if self._requested_ndev:

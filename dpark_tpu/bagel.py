@@ -331,7 +331,12 @@ def _pregel_host(ids, values, edges, compute, send, combine,
                  edge_values, active, initial_messages, aggregator,
                  max_superstep):
     """Single-host vectorized Pregel: the golden model for the device
-    implementation, pure numpy."""
+    implementation.  The framework side is pure numpy, but user
+    compute/send may use jnp — whose first call initializes the default
+    jax backend, so honor DPARK_TPU_PLATFORM here too (a wedged device
+    tunnel must not hang the LOCAL master)."""
+    from dpark_tpu.utils import apply_platform_override
+    apply_platform_override()
     ids = np.asarray(ids, np.int64)
     n = ids.shape[0]
     if np.unique(ids).shape[0] != n:

@@ -69,13 +69,27 @@ class Broadcast:
 
     def _fetch_remote(self):
         """Chunked fetch over ONE TCP connection to the origin's bucket
-        server."""
+        server.  The fetched chunks are re-written into the LOCAL
+        workdir, so co-located workers read files and this host's
+        bucket server can re-serve them (the P2P leg of the reference's
+        tree distribution)."""
         from dpark_tpu import dcn
         meta = dcn.fetch(self._origin, ("bcast_meta", self.bid))
         (nchunks,) = struct.unpack("!I", meta)
         parts = dcn.fetch_many(
             self._origin,
             [("bcast", self.bid, i) for i in range(nchunks)])
+        try:
+            d = self._dir()
+            for i, blob in enumerate(parts):
+                with atomic_file(os.path.join(
+                        d, "b%d.%d" % (self.bid, i))) as f:
+                    f.write(blob)
+            with atomic_file(os.path.join(
+                    d, "b%d.meta" % self.bid)) as f:
+                f.write(struct.pack("!I", nchunks))
+        except OSError:
+            pass                         # read-only workdir: skip cache
         return pickle.loads(decompress(b"".join(parts)))
 
     @property

@@ -55,6 +55,20 @@ def atomic_file(path, mode="wb"):
         raise
 
 
+def apply_platform_override():
+    """Honor DPARK_TPU_PLATFORM before the first jax backend init (a
+    wedged device tunnel must not hang CPU-only work).  The config API
+    is the only reliable route: the axon sitecustomize overrides the
+    JAX_PLATFORMS env var."""
+    plat = os.environ.get("DPARK_TPU_PLATFORM")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+
 def user_call_site(depth_limit=12):
     """Return 'file:lineno' of the first stack frame outside dpark_tpu.
 

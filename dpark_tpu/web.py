@@ -15,7 +15,7 @@ from dpark_tpu.utils.log import get_logger
 logger = get_logger("web")
 
 _PAGE = """<!doctype html>
-<html><head><title>dpark_tpu</title>
+<html><head><meta charset="utf-8"><title>dpark_tpu</title>
 <style>
  body { font-family: monospace; margin: 2em; }
  table { border-collapse: collapse; }
@@ -72,7 +72,7 @@ def start_ui(scheduler, host="127.0.0.1", port=0):
                 ctype = "application/json"
             else:
                 body = _PAGE.encode()
-                ctype = "text/html"
+                ctype = "text/html; charset=utf-8"
             self.send_response(200)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
