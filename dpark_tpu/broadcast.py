@@ -70,9 +70,11 @@ class Broadcast:
     def _fetch_remote(self):
         """Chunked fetch over ONE TCP connection to the origin's bucket
         server.  The fetched chunks are re-written into the LOCAL
-        workdir, so co-located workers read files and this host's
-        bucket server can re-serve them (the P2P leg of the reference's
-        tree distribution)."""
+        workdir so CO-LOCATED workers (same workdir) read files instead
+        of re-fetching.  Handles still point every remote host at the
+        single origin — the reference's tree/P2P fan-out (re-routing
+        fetchers to peers that already hold the value) is not
+        implemented."""
         from dpark_tpu import dcn
         meta = dcn.fetch(self._origin, ("bcast_meta", self.bid))
         (nchunks,) = struct.unpack("!I", meta)

@@ -98,6 +98,9 @@ _RANK_SCRIPT = textwrap.dedent("""
             time.sleep(0.05)
         b = pickle.loads(blob)
         assert b.value == {"payload": list(range(400000))}
+        # the remote fetch caches chunks locally for co-located workers
+        assert os.path.exists(os.path.join(
+            workdir, "broadcast", "b%d.meta" % b.bid))
         t.set("rank1_done", "ok")
     else:
         for _ in range(600):

@@ -79,3 +79,10 @@ def test_logistic_regression_tpu():
     assert "consistency with true boundary" in out
     pct = float(out.split("boundary:")[1].strip().rstrip("%"))
     assert pct > 85.0
+
+
+def test_sssp_both_masters():
+    host = run_example("sssp.py")
+    tpu = run_example("sssp.py", "-m", "tpu")
+    assert host.strip() == tpu.strip()
+    assert host.startswith("reachable: 997/1000")
