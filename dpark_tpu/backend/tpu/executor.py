@@ -273,7 +273,7 @@ class JAXExecutor:
         mode = self._stream_mode(plan)
         if mode is not None:
             kind, waves = mode
-            if kind == "monoid":
+            if kind == "combine":
                 return self._run_streamed_shuffle(plan, waves)
             return self._run_streamed_nocombine(plan, waves)
         if getattr(plan, "logical_spill", False):
@@ -580,8 +580,8 @@ class JAXExecutor:
     # host disk and merge lazily at the export bridge.
     # ------------------------------------------------------------------
     def _stream_mode(self, plan):
-        """None, or ("monoid"|"nocombine", wave iterator).  Each wave is
-        a list of per-device _ColumnarSlice parts."""
+        """None, or ("combine"|"nocombine", wave iterator).  Each wave
+        is a list of per-device _ColumnarSlice parts."""
         if plan.epilogue is None:
             return None
         dep = plan.epilogue[1]
@@ -606,9 +606,20 @@ class JAXExecutor:
             return None
         if no_combine:
             return ("nocombine", waves)
-        if monoid is not None:
-            return ("monoid", waves)
-        return None                     # generic merge: in-core only
+        # monoids combine via segment scatters; any other TRACEABLE
+        # merge streams through the segmented associative scan — ONE
+        # probe (shared with compile time), memoized per plan
+        merge_fn, _ = self._merge_probe(plan)
+        if monoid is not None or merge_fn is not None:
+            return ("combine", waves)
+        return None                     # untraceable merge: in-core only
+
+    def _merge_probe(self, plan):
+        """Memoized (merge_fn, monoid) for the plan's shuffle write —
+        the same probe _epilogue_merge runs at compile time."""
+        if not hasattr(plan, "_merge_probe_result"):
+            plan._merge_probe_result = self._epilogue_merge(plan)
+        return plan._merge_probe_result
 
     def _wave_iter_columnar(self, plan):
         from dpark_tpu.rdd import _ColumnarSlice
@@ -638,9 +649,10 @@ class JAXExecutor:
 
     def _run_streamed_shuffle(self, plan, waves):
         dep = plan.epilogue[1]
-        # _stream_mode guarantees a classified monoid: the combine runs
-        # entirely through segment scatters, never the user merge fn
-        monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
+        # classified monoids combine through segment scatters; any
+        # other TRACEABLE user merge runs as a segmented associative
+        # scan (_stream_mode verified it traces, same memoized probe)
+        merge_fn, monoid = self._merge_probe(plan)
         state = None                    # (leaves, counts) combined so far
         bounds = self._bounds_arg(plan)      # loop-invariant
         for c, parts in enumerate(waves):
@@ -650,7 +662,8 @@ class JAXExecutor:
             cnts, offs = outs[0], outs[1]
             leaves = list(outs[2:])
             recv = self._exchange_all(leaves, cnts, offs)
-            state = self._merge_into_state(plan, state, recv, monoid)
+            state = self._merge_into_state(plan, state, recv, monoid,
+                                           merge_fn)
             logger.debug("streamed wave %d", c + 1)
         leaves, counts = state
         return self._register_shuffle(dep, plan, {
@@ -870,9 +883,12 @@ class JAXExecutor:
                 raise RuntimeError("shuffle exchange did not converge")
         return recv_rounds, cnt_rounds, slot
 
-    def _merge_into_state(self, plan, state, recv, monoid):
+    def _merge_into_state(self, plan, state, recv, monoid,
+                          merge_fn=None):
         """Combine received rows (and the running state) into the new
-        per-device unique-key state (monoid scatters only)."""
+        per-device unique-key state: one segment scatter for classified
+        monoids, a segmented associative scan of the traced user merge
+        otherwise."""
         recv_rounds, cnt_rounds, slot = recv
         rounds = len(recv_rounds)
         nleaves = len(recv_rounds[0])
@@ -904,7 +920,7 @@ class JAXExecutor:
                         for sl, fl in zip(st_leaves[1:], flat[1:])]
                     mask = jnp.concatenate([stv, mask])
                 k, vs, n = collectives.segment_reduce(
-                    flat[0], flat[1:], mask, None, monoid=monoid)
+                    flat[0], flat[1:], mask, merge_fn, monoid=monoid)
                 out = (jnp.expand_dims(n, 0),
                        jnp.expand_dims(k, 0)) + tuple(
                     jnp.expand_dims(v, 0) for v in vs)

@@ -167,6 +167,26 @@ def test_spool_cleanup_on_drop(tctx, tiny_waves):
     assert not any(os.path.isdir(d) for d in spools)
 
 
+def test_streamed_generic_combiner(tctx, tiny_waves):
+    """A traceable NON-monoid merge (tuple-wise sums) streams too, via
+    the segmented associative scan."""
+    n = 12000
+    keys = (np.arange(n, dtype=np.int64) * 13) % 37
+    vals = np.arange(n, dtype=np.int64) % 9
+    got = dict(tctx.parallelize(Columns(keys, vals), 8)
+               .mapValue(lambda v: (v, 1))
+               .reduceByKey(lambda a, b: (a[0] + b[0], a[1] + b[1]), 8)
+               .collect())
+    ex = tctx.scheduler.executor
+    assert any(s.get("pre_reduced")
+               for s in ex.shuffle_store.values()), "did not stream"
+    expect = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        s, c = expect.get(k, (0, 0))
+        expect[k] = (s + v, c + 1)
+    assert got == expect
+
+
 def test_logical_partitions_beyond_mesh(tctx, tiny_waves):
     """r > ndev: the spilled-run stream carries the LOGICAL partition id
     through the exchange, so big sorts/groups can use many small reduce
