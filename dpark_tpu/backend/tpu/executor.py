@@ -598,8 +598,7 @@ class JAXExecutor:
         elif plan.source[0] == "text":
             if not fuse._big_text(plan.stage):
                 return None
-            sizes = [max(0, getattr(sp, "end", 0)
-                         - getattr(sp, "begin", 0))
+            sizes = [fuse._split_bytes(sp)
                      for sp in plan.stage.rdd.splits]
             waves = self._wave_iter_text(plan, sizes)
         else:

@@ -354,16 +354,22 @@ def _sample_record(pc):
 # against the user's functions on a sample prefix).
 # ----------------------------------------------------------------------
 
-_TEXT_SOURCES = (TextFileRDD, GZipFileRDD, CSVReaderRDD, CSVFileRDD)
+def _text_sources():
+    """File-backed record sources whose narrow chains run as a host
+    prologue feeding the device shuffle (lazy: tabular imports rdd)."""
+    from dpark_tpu.tabular import TabularRDD
+    return (TextFileRDD, GZipFileRDD, CSVReaderRDD, CSVFileRDD,
+            TabularRDD)
 
 
 def extract_text_chain(top):
     """Walk one-parent narrow links to a file source.  Returns
     (source_rdd, chain root->top) or None."""
+    sources = _text_sources()
     chain = []
     cur = top
     while True:
-        if isinstance(cur, _TEXT_SOURCES):
+        if isinstance(cur, sources):
             chain.reverse()
             return cur, chain
         if isinstance(cur, DerivedRDD):
@@ -406,17 +412,25 @@ def canonical_wordcount(chain):
 
 def _sample_text_record(top):
     """First record of the narrow chain, read from the first non-empty
-    split (driver-side, reads a handful of lines)."""
+    split (driver-side; cached per RDD — a tabular source decompresses
+    a whole chunk to produce it, so once is enough)."""
+    if hasattr(top, "_tpu_sample_record"):
+        return top._tpu_sample_record
+    sample = None
     for sp in top.splits[:8]:
         it = top.iterator(sp)
         try:
             for rec in it:
-                return rec
+                sample = rec
+                break
         finally:
             close = getattr(it, "close", None)
             if close:
                 close()
-    return None
+        if sample is not None:
+            break
+    top._tpu_sample_record = sample
+    return sample
 
 
 def analyze_text_stage(stage, ndev, executor_or_store):
@@ -521,12 +535,28 @@ def _big_columnar(pc):
             > conf.STREAM_CHUNK_ROWS)
 
 
+def _split_bytes(sp):
+    """Best-effort on-disk size of one file split: byte range when the
+    split carries one (TextSplit), whole-file size otherwise (tabular /
+    whole-file splits)."""
+    end = getattr(sp, "end", None)
+    if end is not None:
+        return max(0, end - getattr(sp, "begin", 0))
+    path = getattr(sp, "path", None)
+    if path and "://" not in path:
+        try:
+            import os
+            return os.path.getsize(path)
+        except OSError:
+            return 0
+    return 0
+
+
 def _big_text(stage):
     """Text source big enough for the wave stream."""
     from dpark_tpu import conf
-    sizes = [max(0, getattr(sp, "end", 0) - getattr(sp, "begin", 0))
-             for sp in stage.rdd.splits]
-    return sum(sizes) > conf.STREAM_TEXT_BYTES
+    return (sum(_split_bytes(sp) for sp in stage.rdd.splits)
+            > conf.STREAM_TEXT_BYTES)
 
 
 def _numeric_key(specs):

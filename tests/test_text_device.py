@@ -249,3 +249,24 @@ def test_lineage_recovery_after_hbm_eviction(tctx, corpus):
     for sid in list(ex.shuffle_store):
         ex.drop_shuffle(sid)
     assert dict(r.collect()) == first
+
+
+def test_tabular_source_rides_device(tctx, tmp_path):
+    """Tabular chains reach the device shuffle via the host prologue."""
+    from dpark_tpu import DparkContext
+    from dpark_tpu.tabular import write_tabular
+    p = str(tmp_path / "t.tab")
+    rows = [(i % 23, i % 7, i) for i in range(4000)]
+    write_tabular(p, ["k", "v", "x"], rows, chunk_rows=500)
+
+    def run(ctx):
+        return dict(ctx.tabular(p)
+                    .map(lambda r: (r[0], r[1]))
+                    .reduceByKey(lambda a, b: a + b, 4).collect())
+
+    got = run(tctx)
+    assert tctx.scheduler.executor.shuffle_store, "host fallback"
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
