@@ -102,10 +102,17 @@ def bucketize(key, leaves, n, n_dst, dst=None, r=None):
     return sorted_leaves, counts, offsets
 
 
-def exchange_round(axis, leaves, offsets, counts, sent, slot):
+def exchange_round(axis, leaves, offsets, counts, sent, slot,
+                   narrow=None):
     """One all_to_all round: send up to `slot` records to each destination.
 
     leaves: destination-sorted rows (cap, ...); offsets/counts/sent: (R,).
+    `narrow`: optional per-leaf wire dtype (or None) — leaves proven to
+    fit ride the collective narrowed (e.g. int64 -> int32: TPUs have no
+    native 64-bit integer datapath, XLA emulates i64 as i32 pairs, so an
+    i64 exchange moves 2x the ICI bytes; the executor's runtime min/max
+    guard decides per exchange).  The cast happens right around the
+    collective — callers always see the original dtypes.
     Returns (recv_leaves (R, slot, ...), recv_cnt (R,), new_sent,
     overflow_scalar) where overflow is the psum of still-unsent records
     across all devices — 0 means the exchange is complete.
@@ -121,8 +128,13 @@ def exchange_round(axis, leaves, offsets, counts, sent, slot):
     for li, leaf in enumerate(leaves):
         g = leaf[idx]                                          # (R, slot, ..)
         g = jnp.where(_bcast(mask, g), g, jnp.zeros((), g.dtype))
+        if narrow is not None and narrow[li] is not None:
+            g = g.astype(narrow[li])
         send.append(g)
     recv = _grouped_all_to_all(send, axis)
+    for li, leaf in enumerate(leaves):
+        if narrow is not None and narrow[li] is not None:
+            recv[li] = recv[li].astype(leaf.dtype)
     recv_cnt = lax.all_to_all(sendable, axis, 0, 0, tiled=True)
     new_sent = sent + sendable
     overflow = lax.psum(jnp.sum(counts - new_sent), axis)

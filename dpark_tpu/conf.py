@@ -67,8 +67,19 @@ STREAM_CHUNK_ROWS = 4 << 20
 # of materializing the whole encoded dataset (same out-of-core pipeline)
 STREAM_TEXT_BYTES = 1 << 28
 
+# thread-pool width for text-split tokenize/encode (the C++ tokenizer
+# releases the GIL, so splits tokenize truly concurrently; the reference
+# runs hot loop #1 on every executor — SURVEY.md 3.1).  0 = cpu count.
+INGEST_THREADS = int(os.environ.get("DPARK_INGEST_THREADS", "0") or 0)
+
 # default dtype for device-side values
 DEFAULT_DTYPE = "int32"
+
+# narrow int64 columns to int32 on the all_to_all wire when a runtime
+# min/max guard proves every valid value fits (TPUs have no native i64
+# datapath: XLA emulates i64 as i32 pairs, doubling ICI bytes).  Compute
+# stays i64 either way; set 0 to disable (e.g. when bisecting parity).
+NARROW_EXCHANGE = os.environ.get("DPARK_NARROW_EXCHANGE", "1") != "0"
 
 # when set, the tpu executor writes a jax.profiler trace here for the
 # whole session (view with tensorboard / xprof)

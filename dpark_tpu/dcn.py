@@ -10,13 +10,30 @@ shuffles), broadcast requests to the chunk files written by
 dpark_tpu.broadcast.  The tracker (dpark_tpu/tracker.py) remains the
 metadata plane that carries the tcp:// URIs.
 
-Framing: 4-byte length + pickled request tuple; response 8-byte length +
-raw payload bytes (already compressed on disk — the server never
-recompresses).
+Framing: 4-byte length + JSON request array (never pickle — requests
+arrive from the network, and unpickling untrusted bytes is arbitrary
+code execution; all request fields are ints/strings so JSON loses
+nothing).  Response: status byte + 8-byte length + raw payload bytes
+(already compressed on disk — the server never recompresses); error
+payloads are UTF-8 strings.
+
+Response payloads can still be hostile: shuffle/broadcast clients
+unpickle the data they fetch, so a poisoned peer URI or a MITM could
+answer with a crafted pickle.  Setting DPARK_DCN_SECRET on every host
+closes both directions: requests carry an HMAC-SHA256 tag (only secret
+holders can issue requests at all) and responses carry a tag over
+status+payload that the client verifies BEFORE any deserialization.
+Without the secret, request parsing is still non-executable (JSON),
+but fetched payloads are trusted exactly as far as the tracker that
+advertised the peer.
 """
 
+import hashlib
+import hmac
+import json
 import os
-import pickle
+import pickle  # encode-only: serializing OUR data for peers, never
+               # deserializing network input
 import socket
 import socketserver
 import struct
@@ -56,11 +73,34 @@ def _recv_exact(sock, n):
     return buf
 
 
+def _secret():
+    return os.environ.get("DPARK_DCN_SECRET", "").encode()
+
+
+def _encode_req(req):
+    blob = json.dumps(list(req), separators=(",", ":")).encode()
+    secret = _secret()
+    if secret:
+        blob = hmac.new(secret, blob, hashlib.sha256).digest() + blob
+    return blob
+
+
+def _decode_req(blob):
+    secret = _secret()
+    if secret:
+        tag, blob = blob[:32], blob[32:]
+        want = hmac.new(secret, blob, hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise PermissionError("request MAC mismatch")
+    return tuple(json.loads(blob.decode("utf-8")))
+
+
 class FramedServer:
     """Threaded length-prefixed request/response TCP server shared by
     the bucket server and the chunk-server filesystem: requests are
-    pickled tuples, responses raw payload bytes with a status byte
-    (1 = pickled error string)."""
+    JSON arrays of ints/strings (optionally HMAC-tagged — see module
+    docstring), responses raw payload bytes with a status byte
+    (1 = UTF-8 error string)."""
 
     def __init__(self, serve, host="0.0.0.0", port=0,
                  name="dpark-framed-server"):
@@ -72,17 +112,27 @@ class FramedServer:
                     while True:
                         raw = _recv_exact(self.request, 4)
                         (n,) = struct.unpack("!I", raw)
-                        req = pickle.loads(
-                            _recv_exact(self.request, n))
+                        frame = _recv_exact(self.request, n)
+                        try:
+                            req = _decode_req(frame)
+                        except Exception:
+                            # malformed or unauthenticated frame: hang
+                            # up, never answer
+                            return
                         try:
                             payload = outer_serve(req)
                             status = 0
                         except Exception as e:
-                            payload = pickle.dumps(str(e))
+                            payload = str(e).encode(
+                                "utf-8", "replace")
                             status = 1
+                        secret = _secret()
+                        tag = hmac.new(
+                            secret, bytes([status]) + payload,
+                            hashlib.sha256).digest() if secret else b""
                         self.request.sendall(
                             struct.pack("!BQ", status, len(payload))
-                            + payload)
+                            + payload + tag)
                 except (ConnectionError, OSError):
                     pass
 
@@ -165,12 +215,21 @@ class BucketServer(FramedServer):
 
 
 def _request(sock, req):
-    blob = pickle.dumps(req, -1)
+    blob = _encode_req(req)
     sock.sendall(struct.pack("!I", len(blob)) + blob)
     status, n = struct.unpack("!BQ", _recv_exact(sock, 9))
     payload = _recv_exact(sock, n)
+    secret = _secret()
+    if secret:
+        # verify the response BEFORE any caller deserializes it
+        tag = _recv_exact(sock, 32)
+        want = hmac.new(secret, bytes([status]) + payload,
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise IOError("bucket server: response MAC mismatch")
     if status:
-        raise IOError("bucket server: %s" % pickle.loads(payload))
+        raise IOError("bucket server: %s"
+                      % payload.decode("utf-8", "replace"))
     return payload
 
 

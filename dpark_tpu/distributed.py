@@ -17,10 +17,50 @@ coordinator) so the same program runs unchanged everywhere.
 """
 
 import os
+import time
 
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("distributed")
+
+
+def _file_rendezvous(path, process_id, timeout=120):
+    """file:// coordinator rendezvous: rank 0 picks a free port ITSELF
+    (no launcher-side bind/close/reuse race — the window between
+    choosing and jax binding is microseconds inside one process, and a
+    stolen port fails the bind loudly instead of connecting ranks to a
+    stranger) and publishes host:port by atomic rename; other ranks
+    poll the path.  Multi-host deployments put the path on the shared
+    FS (the reference's workdir-on-MooseFS pattern)."""
+    if process_id == 0:
+        import socket
+        from dpark_tpu.dcn import _routable_host
+        try:
+            os.unlink(path)       # a LEFTOVER address from a previous
+        except OSError:           # run must never be joinable; use a
+            pass                  # fresh path per run where possible
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        addr = "%s:%d" % (_routable_host(), port)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            f.write(addr)
+        os.replace(tmp, path)
+        return addr
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with open(path) as f:
+                addr = f.read().strip()
+            if addr:
+                return addr
+        except OSError:
+            pass
+        time.sleep(0.05)
+    raise TimeoutError("no coordinator address at %s after %ds"
+                       % (path, timeout))
 
 
 def init(coordinator_address=None, num_processes=None, process_id=None):
@@ -28,6 +68,8 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
 
     Defaults come from the mrun/SLURM-style env vars:
       MRUN_RANK/RANK, MRUN_SIZE/WORLD_SIZE, DPARK_COORDINATOR.
+    DPARK_COORDINATOR may be host:port or file:///path — the latter
+    rendezvouses through the filesystem with rank 0 choosing the port.
     Returns (process_id, num_processes).
     """
     import jax
@@ -41,6 +83,9 @@ def init(coordinator_address=None, num_processes=None, process_id=None):
     if coordinator_address is None:
         coordinator_address = os.environ.get(
             "DPARK_COORDINATOR", "127.0.0.1:8476")
+    if coordinator_address.startswith("file://"):
+        coordinator_address = _file_rendezvous(
+            coordinator_address[len("file://"):], process_id)
 
     if num_processes > 1:
         jax.distributed.initialize(

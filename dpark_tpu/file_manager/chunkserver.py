@@ -17,9 +17,10 @@ chunkserver read path).
 """
 
 import io
+import json
 import os
-import pickle
 import socket
+import struct
 
 from dpark_tpu.dcn import FramedServer, fetch
 from dpark_tpu.file_manager import FileSystem, register_filesystem
@@ -33,8 +34,14 @@ READ_BLOCK = 1 << 20              # client read-ahead per request
 
 
 def _call(addr, req, timeout=30):
-    """One pickled request/response against a chunk server."""
-    return pickle.loads(fetch("tcp://" + addr, req, timeout))
+    """One request/response against a chunk server.  Responses are
+    never pickled (the peer is untrusted network input): "read" frames
+    are 4-byte crc32c + raw bytes, everything else is JSON."""
+    payload = fetch("tcp://" + addr, req, timeout)
+    if req[0] == "read":
+        (crc,) = struct.unpack("!I", payload[:4])
+        return payload[4:], crc
+    return json.loads(payload.decode("utf-8"))
 
 
 class ChunkServer(FramedServer):
@@ -45,13 +52,19 @@ class ChunkServer(FramedServer):
 
     def __init__(self, root, host="127.0.0.1", port=0, host_map=None,
                  corrupt_reads=False):
-        self.root = os.path.abspath(root)
+        self.root = os.path.realpath(root)
         self.host_map = host_map or (
             lambda path, idx: [socket.gethostname()])
         self.corrupt_reads = corrupt_reads       # test hook: bad payload
-        super().__init__(
-            lambda req: pickle.dumps(self._serve(req), -1),
-            host, port, name="dpark-chunk-server")
+        super().__init__(self._encode, host, port,
+                         name="dpark-chunk-server")
+
+    def _encode(self, req):
+        out = self._serve(req)
+        if req[0] == "read":
+            data, crc = out
+            return struct.pack("!I", crc) + data
+        return json.dumps(out).encode()
 
     @property
     def addr(self):
@@ -63,8 +76,10 @@ class ChunkServer(FramedServer):
         return self
 
     def _resolve(self, path):
-        full = os.path.abspath(os.path.join(self.root,
-                                            path.lstrip("/")))
+        # realpath, not abspath: containment must hold after symlink
+        # resolution, or a link inside the root escapes it
+        full = os.path.realpath(os.path.join(self.root,
+                                             path.lstrip("/")))
         if not (full == self.root
                 or full.startswith(self.root + os.sep)):
             raise PermissionError("outside served root: %s" % path)

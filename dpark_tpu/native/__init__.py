@@ -86,6 +86,9 @@ def get_lib():
         lib.tokendict_put.restype = ctypes.c_int64
         lib.tokendict_put.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.tokendict_merge.restype = ctypes.c_int64
+        lib.tokendict_merge.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         lib.csv_scan.restype = ctypes.c_int64
         lib.csv_scan.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint8,
@@ -216,13 +219,34 @@ class TokenDict:
         return tid
 
     def decode(self, tid):
+        return self.raw(tid).decode("utf-8", "replace")
+
+    def raw(self, tid):
+        """The EXACT bytes of token `tid` (decode() lossily re-encodes
+        invalid utf-8)."""
         if self._h:
             buf = ctypes.create_string_buffer(1 << 16)
             n = self._lib.tokendict_get(self._h, int(tid), buf, len(buf))
             if n < 0:
                 raise KeyError(tid)
-            return buf.raw[:n].decode("utf-8", "replace")
-        return self._rev[tid].decode("utf-8", "replace")
+            return buf.raw[:n]
+        return self._rev[tid]
+
+    def merge_from(self, other):
+        """Merge `other`'s vocabulary into this dict in other-id order;
+        returns remap (np.int64, len(other)) with remap[i] = this
+        dict's id for other's token i.  C++ loop when both dicts are
+        native — the parallel-ingest merge must not walk tokens in
+        Python."""
+        m = len(other)
+        remap = np.empty(m, dtype=np.int64)
+        if self._h and other._h:
+            self._lib.tokendict_merge(self._h, other._h,
+                                      remap.ctypes.data)
+            return remap
+        for i in range(m):
+            remap[i] = self.put(other.raw(i))
+        return remap
 
 
 class CsvScanner:

@@ -211,6 +211,31 @@ int64_t csv_scan(const uint8_t* buf, int64_t n, uint8_t quote,
     return cnt;
 }
 
+// Merge src's vocabulary into dst IN src-id ORDER, writing
+// remap[i] = dst id of src token i.  Backbone of the parallel text
+// ingest: worker threads tokenize into private dicts with the GIL
+// released, the driver merges them in split order so global ids come
+// out identical to a serial walk.  Returns src's size.
+int64_t tokendict_merge(void* dst_h, void* src_h, int64_t* remap) {
+    TokenDict* dst = (TokenDict*)dst_h;
+    TokenDict* src = (TokenDict*)src_h;
+    int64_t m = (int64_t)src->rev.size();
+    for (int64_t i = 0; i < m; i++) {
+        const std::string& tok = src->rev[(size_t)i];
+        auto it = dst->map.find(tok);
+        int64_t id;
+        if (it != dst->map.end()) {
+            id = it->second;
+        } else {
+            id = (int64_t)dst->rev.size();
+            dst->rev.push_back(tok);
+            dst->map.emplace(tok, id);
+        }
+        remap[i] = id;
+    }
+    return m;
+}
+
 // Copy token `id` into out (capacity cap); returns its length or -1.
 int64_t tokendict_get(void* h, int64_t id, uint8_t* out, int64_t cap) {
     TokenDict* d = (TokenDict*)h;

@@ -37,6 +37,56 @@ def test_bucket_server_roundtrip(tmp_path):
         srv.stop()
 
 
+def test_request_framing_is_not_pickle(tmp_path):
+    """Security (ADVICE r2): the server must never unpickle network
+    input.  A crafted pickle sent as a request frame must not execute —
+    it is rejected as a malformed frame (connection closed, no
+    response), and with DPARK_DCN_SECRET set, frames without a valid
+    MAC are likewise dropped."""
+    import socket
+    import struct as struct_mod
+    from dpark_tpu.dcn import BucketServer, fetch
+
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    srv = BucketServer(wd, host="127.0.0.1").start()
+    host, port = srv.bind_address
+    try:
+        # a pickle that would touch the filesystem if unpickled
+        evil = pickle.dumps(("bucket", 1, 0, 0))
+        marker = tmp_path / "pwned"
+
+        class Evil:
+            def __reduce__(self):
+                return (open, (str(marker), "w"))
+        evil = pickle.dumps(Evil())
+        with socket.create_connection((host, port), timeout=5) as s:
+            s.sendall(struct_mod.pack("!I", len(evil)) + evil)
+            # server hangs up without answering
+            s.settimeout(5)
+            assert s.recv(1) == b""
+        assert not marker.exists()
+
+        # with a shared secret, an un-MACed (but well-formed JSON)
+        # request is also dropped...
+        os.environ["DPARK_DCN_SECRET"] = "s3cret"
+        try:
+            blob = b'["bcast_meta",1]'
+            with socket.create_connection((host, port),
+                                          timeout=5) as s:
+                s.sendall(struct_mod.pack("!I", len(blob)) + blob)
+                s.settimeout(5)
+                assert s.recv(1) == b""
+            # ...while the authenticated client path still works
+            with pytest.raises(IOError):
+                fetch("tcp://%s:%d" % (host, port),
+                      ("bcast_meta", 999))     # valid MAC, missing id
+        finally:
+            del os.environ["DPARK_DCN_SECRET"]
+    finally:
+        srv.stop()
+
+
 _RANK_SCRIPT = textwrap.dedent("""
     import os, pickle, sys, time
     rank = int(sys.argv[1])
@@ -120,14 +170,17 @@ def test_two_rank_exchange_over_tcp(tmp_path):
     srv = TrackerServer()
     srv.start()
     try:
-        import socket
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        coord = "127.0.0.1:%d" % s.getsockname()[1]
-        s.close()
+        # file:// rendezvous: rank 0 picks the port itself (the racy
+        # bind/close/reuse pattern was ADVICE r2 finding #4)
+        coord = "file://" + str(tmp_path / "coord.addr")
         script = str(tmp_path / "rank.py")
         with open(script, "w") as f:
             f.write(_RANK_SCRIPT)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        child_env = dict(os.environ)
+        child_env["PYTHONPATH"] = repo_root + os.pathsep + \
+            child_env.get("PYTHONPATH", "")
         procs = []
         for rank in (0, 1):
             wd = str(tmp_path / ("wd%d" % rank))
@@ -136,7 +189,7 @@ def test_two_rank_exchange_over_tcp(tmp_path):
                 [sys.executable, script, str(rank), wd,
                  srv.addr, coord],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True))
+                text=True, env=child_env))
         outs = []
         for rank, p in enumerate(procs):
             try:

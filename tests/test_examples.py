@@ -12,6 +12,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def run_example(name, *args, timeout=240):
     env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.update({
         "DPARK_PROGRESS": "0",
         "DPARK_TPU_PLATFORM": "cpu",

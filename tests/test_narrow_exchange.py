@@ -1,0 +1,117 @@
+"""Dtype narrowing on the all_to_all wire (VERDICT r2 ask #1).
+
+dpark parity requires i64 compute (counting must not wrap at 2**31),
+but TPUs have no native i64 datapath — XLA emulates i64 as i32 pairs
+and an i64 exchange moves 2x the ICI bytes.  The executor's runtime
+min/max guard narrows int64 columns whose valid values fit int32 to
+i32 for the collective only, widening right after.  These tests pin
+the guard's soundness (parity on edge ranges, per-leaf decisions,
+fallback) and the byte win itself.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def tctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu")
+    c.start()
+    yield c
+    c.stop()
+
+
+def _reduce(ctx, data, parts=8):
+    return dict(ctx.parallelize(data, 8)
+                .reduceByKey(lambda a, b: a + b, parts).collect())
+
+
+def _expect(data):
+    out = {}
+    for k, v in data:
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def test_narrow_halves_wire_bytes(tctx):
+    """Small int keys/values ride the wire at i32: exactly half the
+    bytes of the i64 exchange for the same data."""
+    from dpark_tpu import DparkContext
+    data = [(i % 1000, i % 500) for i in range(20000)]
+    got = _reduce(tctx, data)
+    assert got == _expect(data)
+    narrowed = tctx.scheduler.executor.exchange_wire_bytes
+    assert narrowed > 0
+
+    import dpark_tpu.conf as conf
+    was = conf.NARROW_EXCHANGE
+    conf.NARROW_EXCHANGE = False
+    try:
+        wide_ctx = DparkContext("tpu")
+        wide_ctx.start()
+        got2 = _reduce(wide_ctx, data)
+        assert got2 == _expect(data)
+        wide = wide_ctx.scheduler.executor.exchange_wire_bytes
+        wide_ctx.stop()
+    finally:
+        conf.NARROW_EXCHANGE = was
+    assert narrowed * 2 == wide, (narrowed, wide)
+
+
+def test_narrow_is_per_leaf(tctx):
+    """Keys beyond i32 keep the i64 wire while small values still
+    narrow — the guard decides column by column."""
+    data = [(2 ** 40 + (i % 100), 1) for i in range(20000)]
+    got = _reduce(tctx, data)
+    assert got == _expect(data)
+    # key leaf stayed wide (8B) + value narrowed (4B) = 12B per slot
+    ex = tctx.scheduler.executor
+    assert ex.exchange_wire_bytes % 12 == 0
+
+
+def test_i32_boundary_values_exact(tctx):
+    """Values AT the int32 limits still narrow and stay exact; one past
+    the limit falls back to the i64 wire.  Both must agree with the
+    local master."""
+    lim = 2 ** 31 - 1
+    edge = [(1, lim), (1, -lim), (2, lim), (3, -(2 ** 31)), (3, 0)]
+    got = _reduce(tctx, edge, parts=4)
+    assert got == _expect(edge)
+
+    over = [(1, 2 ** 31), (1, 5), (2, -(2 ** 31) - 1), (2, -5)]
+    got2 = _reduce(tctx, over, parts=4)
+    assert got2 == _expect(over)
+
+
+def test_sums_wider_than_i32_still_exact(tctx):
+    """Each value fits i32 so the wire narrows, but the reduced sums
+    exceed i32 — compute stays i64, so no wrap."""
+    data = [(i % 4, 2 ** 30) for i in range(64)]
+    got = _reduce(tctx, data, parts=4)
+    assert got == _expect(data)
+    assert all(v == 16 * 2 ** 30 for v in got.values())
+
+
+def test_negative_keys_narrow(tctx):
+    data = [(-(i % 50) - 1, -i) for i in range(10000)]
+    got = _reduce(tctx, data)
+    assert got == _expect(data)
+
+
+def test_narrow_in_sort_and_group(tctx):
+    """The no-combine exchanges (sortByKey range exchange, groupByKey)
+    run through the same narrowing hook."""
+    import random
+    rng = random.Random(7)
+    data = [(rng.randrange(10000), i) for i in range(20000)]
+    got = tctx.parallelize(data, 8).sortByKey(numSplits=8).collect()
+    assert got == sorted(data, key=lambda kv: kv[0])
+
+    grouped = dict(tctx.parallelize(data[:4000], 8)
+                   .groupByKey(4)
+                   .mapValue(sorted).collect())
+    expect = {}
+    for k, v in data[:4000]:
+        expect.setdefault(k, []).append(v)
+    assert grouped == {k: sorted(v) for k, v in expect.items()}
