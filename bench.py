@@ -68,13 +68,18 @@ def bench_tpu(data):
     from dpark_tpu import DparkContext
     ctx = DparkContext("tpu")
     ctx.start()
-    ndev = ctx.scheduler.executor.ndev
+    ex = ctx.scheduler.executor
+    ndev = ex.ndev
     # warm-up: compile the stage programs at the same size class
     run_once(ctx, data, ndev)
     best = min(run_once(ctx, data, ndev, min(N_KEYS, N_PAIRS))
                for _ in range(3))
+    stats = {"wire_bytes": ex.exchange_wire_bytes,
+             "pad_efficiency": round(
+                 ex.exchange_real_rows
+                 / max(1, ex.exchange_slot_rows), 4)}
     ctx.stop()
-    return best, ndev
+    return best, ndev, stats
 
 
 def _tpu_phase():
@@ -82,8 +87,9 @@ def _tpu_phase():
     as one line (isolated so a wedged TPU tunnel cannot hang the whole
     benchmark — the parent times out and still reports)."""
     data = make_data()
-    t_tpu, ndev = bench_tpu(data)
-    print("TPU_RESULT %r %d" % (t_tpu, ndev), flush=True)
+    t_tpu, ndev, stats = bench_tpu(data)
+    print("TPU_RESULT %s" % json.dumps(
+        dict(stats, t=t_tpu, ndev=ndev)), flush=True)
 
 
 # out-of-core config: sized by env knob, routed through the wave-stream
@@ -130,6 +136,8 @@ def _ooc_phase():
         "hbm_store_gb": round(ex._store_bytes / (1 << 30), 4),
         "exchange_wire_gb": round(ex.exchange_wire_bytes / (1 << 30),
                                   4),
+        "pad_efficiency": round(ex.exchange_real_rows
+                                / max(1, ex.exchange_slot_rows), 4),
         "chips": ndev,
     }
     ctx.stop()
@@ -218,8 +226,8 @@ def _run_tpu_with_timeout(timeout, env=None):
     got = _run_child("--tpu-only", timeout, env=env)
     if got is None:
         return None
-    t, ndev = got.split()
-    return float(t), int(ndev)
+    stats = json.loads(got)
+    return stats.pop("t"), stats.pop("ndev"), stats
 
 
 def main():
@@ -271,7 +279,7 @@ def main():
         print("# process baseline: %.3fs (%.4f GB/s); tpu unavailable"
               % (t_proc, BYTES / t_proc / 1e9), file=sys.stderr)
         return
-    t_tpu, ndev = tpu
+    t_tpu, ndev, stats = tpu
     gbps_chip = BYTES / t_tpu / 1e9 / ndev
     gbps_proc = BYTES / t_proc / 1e9
     out = {
@@ -288,8 +296,10 @@ def main():
         out["emulated_cpu_mesh"] = True
     print(json.dumps(out))
     print("# pairs=%d keys=%d chips=%d tpu=%.3fs process=%.3fs "
-          "(process=%.4f GB/s)%s"
+          "(process=%.4f GB/s) exchange_wire_bytes=%d "
+          "pad_efficiency=%s%s"
           % (N_PAIRS, N_KEYS, ndev, t_tpu, t_proc, gbps_proc,
+             stats.get("wire_bytes", 0), stats.get("pad_efficiency"),
              " [EMULATED cpu mesh]" if emulated else ""),
           file=sys.stderr)
     # second line: the out-of-core wave-stream config (same platform

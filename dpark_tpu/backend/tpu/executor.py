@@ -111,6 +111,9 @@ class JAXExecutor:
         self._result_bytes = 0
         self._hbm_seq = 0             # global LRU clock across both tiers
         self.exchange_wire_bytes = 0  # ICI bytes moved by all_to_all
+        self.exchange_real_rows = 0   # valid rows offered for exchange
+        self.exchange_slot_rows = 0   # padded slots actually moved;
+        #   pad efficiency = real/slot (HARDWARE_CHECKLIST.md step 3)
         self._compiled = {}
         # let rdd.unpersist() reach device-resident caches
         from dpark_tpu import cache as cache_mod
@@ -463,18 +466,26 @@ class JAXExecutor:
             return data
 
     @staticmethod
-    def _tokenizer_safe(data):
-        """True iff the ASCII byte tokenizer provably equals
-        str.split() on these bytes: every byte is printable ASCII or
-        one of \\t \\n \\r.  Bytes >= 0x80 can decode to unicode
-        whitespace (\\xc2\\xa0 etc.) and control bytes \\x0b \\x0c
-        \\x1c-\\x1f ARE str.split() whitespace but not the byte
-        tokenizer's — any of them forces the host prologue for this
-        split (ADVICE r2: the 4KB first-split check alone missed
-        divergence appearing later in the file)."""
+    def _tokenizer_safe(data, sep=None):
+        """True iff the ASCII byte tokenizer provably equals the
+        Python chain on these bytes.
+
+        Whitespace mode (sep=None): every byte must be printable ASCII
+        or \\t \\n \\r — bytes >= 0x80 can decode to unicode whitespace
+        (\\xc2\\xa0 etc.) and control bytes \\x0b \\x0c \\x1c-\\x1f ARE
+        str.split() whitespace but not the byte tokenizer's (ADVICE r2:
+        the 4KB first-split check alone missed divergence appearing
+        later in the file).
+
+        Separator mode: str.split(sep) splits ONLY on the separator, so
+        control bytes pass through both paths verbatim — only >= 0x80
+        (utf-8 'replace' decoding can rewrite token bytes) forces the
+        host prologue."""
         if not data:
             return True
         a = np.frombuffer(data, np.uint8)
+        if sep is not None:
+            return not bool((a >= 0x80).any())
         bad = (a >= 0x80) | ((a < 0x20) & (a != 9) & (a != 10)
                              & (a != 13))
         return not bool(bad.any())
@@ -493,13 +504,17 @@ class JAXExecutor:
             return False
         fm, mp = plan.text_chain
         expect = []
-        for line in prefix.decode("utf-8", "replace").splitlines():
+        # EXACT TextFileRDD line iteration: \n-separated, trailing \r
+        # stripped (str.splitlines would also split on \x0b etc.)
+        for raw in prefix.split(b"\n")[:-1]:
+            line = raw.rstrip(b"\r\n").decode("utf-8", "replace")
             for w in fm.f(line):
                 rec = mp.f(w)
                 if rec[1] != 1:
                     return False
                 expect.append(rec[0])
-        got = [td.decode(int(t)) for t in td.encode(prefix)]
+        sep = getattr(plan, "canonical_sep", None)
+        got = [td.decode(int(t)) for t in td.encode(prefix, sep=sep)]
         return got == expect
 
     def _encode_rows(self, plan, top, sp, td):
@@ -524,16 +539,17 @@ class JAXExecutor:
         (bytecode-proven chain + per-split byte-safety scan + a sample
         verification), the user's own generators otherwise."""
         if state["canonical"]:
+            sep = getattr(plan, "canonical_sep", None)
             data = self._read_text_split(plan.text_rdd, sp)
             if not state["checked"] and self._tokenizer_safe(
-                    data[:4096]):
+                    data[:4096], sep):
                 state["checked"] = True
                 if not self._verify_canonical(plan, data, td):
                     logger.info("canonical tokenizer diverges from the "
                                 "user chain; using the host prologue")
                     state["canonical"] = False
-            if state["canonical"] and self._tokenizer_safe(data):
-                ids = td.encode(data)
+            if state["canonical"] and self._tokenizer_safe(data, sep):
+                ids = td.encode(data, sep=sep)
                 return [np.asarray(ids, np.int64),
                         np.ones(len(ids), np.int64)]
         return self._encode_rows(plan, plan.stage.rdd, sp, td)
@@ -596,16 +612,18 @@ class JAXExecutor:
                            for sp in rest)
             return results
 
+        sep = getattr(plan, "canonical_sep", None)
+
         def work(sp):
             # C++ only in workers: read + byte-scan + tokenize into a
             # PRIVATE dict (ctypes releases the GIL).  Byte-unsafe
             # splits are handed back for the driver-thread prologue.
             data = self._read_text_split(plan.text_rdd, sp)
-            if not self._tokenizer_safe(data):
+            if not self._tokenizer_safe(data, sep):
                 return None
             from dpark_tpu.native import TokenDict
             ltd = TokenDict()
-            return (ltd, ltd.encode(data))
+            return (ltd, ltd.encode(data, sep=sep))
 
         with cf.ThreadPoolExecutor(max_workers=nw) as pool:
             done = list(pool.map(work, rest))
@@ -803,7 +821,12 @@ class JAXExecutor:
         merge_fn, _ = self._merge_probe(plan)
         if monoid is not None or merge_fn is not None:
             return ("combine", _prefetch_iter(waves))
-        return None                     # untraceable merge: in-core only
+        # UNTRACEABLE merge (object-valued combiner semantics the
+        # tracer can't see): ride the spilled-run stream — device
+        # exchange of created combiners, key-sorted runs on host disk,
+        # user's merge_combiners folded per key at export (the
+        # reference's external merger; VERDICT r2 ask #7)
+        return ("nocombine", _prefetch_iter(waves))
 
     def _merge_probe(self, plan):
         """Memoized (merge_fn, monoid) for the plan's shuffle write —
@@ -983,10 +1006,16 @@ class JAXExecutor:
                         path, [col[d, lo:hi] for col in cols[1:]])
                     runs[int(u)].append(path)
             logger.debug("streamed no-combine wave %d", c + 1)
+        host_combine = not fuse.is_list_agg(dep.aggregator)
         return self._register_shuffle(dep, plan, {
             "leaves": [], "counts": None, "offsets": None,
             "host_runs": runs, "spool_dir": spool,
-            "no_combine": True,
+            "no_combine": not host_combine,
+            # untraceable merge: runs hold CREATED combiners (the
+            # create op ran device-side); export folds equal keys with
+            # the user's merge_combiners
+            "host_combine": host_combine,
+            "agg": dep.aggregator if host_combine else None,
             "encoded_keys": getattr(plan, "encoded_keys", False),
             "single_map": True,
         })
@@ -1061,6 +1090,7 @@ class JAXExecutor:
         mean = int(host_counts.sum()) // max(1, host_counts.size)
         slot = layout.round_capacity(min(max(64, 2 * mean),
                                          max(1, max_run)))
+        self.exchange_real_rows += int(host_counts.sum())
         narrow = self._narrow_plan(leaves, counts)
         exchange = self._compile_exchange(
             tuple(str(l.dtype) for l in leaves), nleaves, slot, cap,
@@ -1080,6 +1110,7 @@ class JAXExecutor:
             cnt_rounds.append(recv_cnt)
             self.exchange_wire_bytes += (
                 self.ndev * self.ndev * slot * wire_itemsize)
+            self.exchange_slot_rows += self.ndev * self.ndev * slot
             if int(np.asarray(jax.device_get(overflow))[0]) == 0:
                 break
             if len(recv_rounds) > 512:
@@ -1326,11 +1357,35 @@ class JAXExecutor:
             order = np.argsort(cols[0], kind="stable")
             lists = [c[order].tolist() for c in cols]
             flat2 = jax.tree_util.tree_structure((0, 0))
-            if store["out_treedef"] == flat2:
+            treedef = store["out_treedef"]
+            if store.get("host_combine"):
+                # fold the user's merge_combiners over each sorted key
+                # group: values in the runs are already CREATED
+                # combiners, so this is exactly the reference's
+                # external merge of sorted runs — O(1) state per key
+                mc = store["agg"].merge_combiners
+                rows = []
+                cur_k = cur_c = None
+                have = False
+                for i in range(len(lists[0])):
+                    if treedef == flat2:
+                        k, v = lists[0][i], lists[1][i]
+                    else:
+                        rec = jax.tree_util.tree_unflatten(
+                            treedef, [pl[i] for pl in lists])
+                        k, v = rec[0], rec[1]
+                    if have and k == cur_k:
+                        cur_c = mc(cur_c, v)
+                    else:
+                        if have:
+                            rows.append((cur_k, cur_c))
+                        cur_k, cur_c, have = k, v, True
+                if have:
+                    rows.append((cur_k, cur_c))
+            elif treedef == flat2:
                 # flat (k, v) records — one zip, no per-row treedef work
                 rows = [(k, [v]) for k, v in zip(lists[0], lists[1])]
             else:
-                treedef = store["out_treedef"]
                 rows = []
                 for i in range(len(lists[0])):
                     rec = jax.tree_util.tree_unflatten(

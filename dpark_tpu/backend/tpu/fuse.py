@@ -397,17 +397,47 @@ def _is_whitespace_split(f):
     return f is str.split or _code_matches(f, lambda line: line.split())
 
 
+def _const_split_sep(f):
+    """The separator when f is exactly `lambda line: line.split(SEP)`
+    with a single-byte ASCII constant (not \\n or \\r), else None.
+    Bytecode must equal the template's; only the string const (the
+    separator itself) may differ — it is extracted, not assumed."""
+    code = getattr(f, "__code__", None)
+    if code is None or getattr(f, "__closure__", None):
+        return None
+    t = (lambda line: line.split("\x00")).__code__
+    if not (code.co_code == t.co_code
+            and code.co_names == t.co_names
+            and code.co_argcount == t.co_argcount):
+        return None
+    strs = [c for c in code.co_consts if isinstance(c, str)]
+    others = [c for c in code.co_consts if not isinstance(c, str)]
+    t_others = [c for c in t.co_consts if not isinstance(c, str)]
+    if len(strs) != 1 or others != t_others:
+        return None
+    sep = strs[0]
+    if len(sep) == 1 and ord(sep) < 0x80 and sep not in "\n\r":
+        return sep
+    return None
+
+
 def _is_pair_one(f):
     return _code_matches(f, lambda w: (w, 1))
 
 
 def canonical_wordcount(chain):
-    """chain is exactly flatMap(whitespace split) -> map(w -> (w, 1))."""
+    """The separator string when chain is exactly
+    flatMap(split) -> map(w -> (w, 1)): "" for whitespace split,
+    a 1-char string for a constant-separator split, None otherwise."""
     if len(chain) != 2:
-        return False
+        return None
     fm, mp = chain
-    return (isinstance(fm, FlatMappedRDD) and isinstance(mp, MappedRDD)
-            and _is_whitespace_split(fm.f) and _is_pair_one(mp.f))
+    if not (isinstance(fm, FlatMappedRDD) and isinstance(mp, MappedRDD)
+            and _is_pair_one(mp.f)):
+        return None
+    if _is_whitespace_split(fm.f):
+        return ""
+    return _const_split_sep(fm.f)
 
 
 def _sample_text_record(top):
@@ -445,10 +475,6 @@ def analyze_text_stage(stage, ndev, executor_or_store):
     text_rdd, chain = extracted
     dep = stage.shuffle_dep
     logical_spill = False
-    if dep.partitioner.num_partitions > ndev:
-        if not (is_list_agg(dep.aggregator) and _big_text(stage)):
-            return None              # small input: object path
-        logical_spill = True         # spilled-run stream handles r>ndev
     epi_spec = partitioner_spec(dep.partitioner)
     if epi_spec is None:
         return None
@@ -488,6 +514,19 @@ def analyze_text_stage(stage, ndev, executor_or_store):
         if layout.key_leaf_index(cur_treedef, cur_specs) is None:
             return None
 
+    if dep.partitioner.num_partitions > ndev:
+        # more logical partitions than devices: only the spilled-run
+        # stream supports this — list aggregators (group/partitionBy)
+        # and UNTRACEABLE merges (combiner applied host-side at export)
+        # both ride it; traceable merges pre-reduce per device and need
+        # r <= ndev.  Small inputs go to the object path here.
+        if not _big_text(stage):
+            return None
+        if not is_list_agg(dep.aggregator) \
+                and merge_traceable(dep.aggregator, cur_specs[1:]):
+            return None
+        logical_spill = True
+
     plan = StagePlan(("text", None), ops, ("shuffle_write", dep),
                      treedef, specs, cur_treedef, cur_specs, stage)
     plan.src_combine = False
@@ -498,8 +537,10 @@ def analyze_text_stage(stage, ndev, executor_or_store):
     plan.text_chain = chain
     plan.encoded_keys = key_is_str
     plan.logical_spill = logical_spill
-    plan.canonical = (key_is_str and type(text_rdd) is TextFileRDD
-                      and canonical_wordcount(chain))
+    sep = (canonical_wordcount(chain)
+           if key_is_str and type(text_rdd) is TextFileRDD else None)
+    plan.canonical = sep is not None
+    plan.canonical_sep = sep or None      # "" (whitespace) -> None
     plan.program_key = plan.program_key + (False, False, epi_spec)
     return plan
 
@@ -522,6 +563,20 @@ def _leaves_merge_fn(merge, nleaves):
     def merged(va_leaves, vb_leaves):
         return list(vfn(*(list(va_leaves) + list(vb_leaves))))
     return merged
+
+
+def merge_traceable(aggregator, val_specs):
+    """True when merge_combiners traces over the given value leaf
+    specs — the gate between the device-combining stream and the
+    spilled-run stream with the combiner applied at export."""
+    try:
+        merge_fn = _leaves_merge_fn(aggregator.merge_combiners,
+                                    len(val_specs))
+        vstructs = _batched_spec_struct(val_specs)
+        jax.eval_shape(lambda *v: merge_fn(list(v), list(v)), *vstructs)
+        return True
+    except Exception:
+        return False
 
 
 def _big_columnar(pc):
@@ -673,16 +728,6 @@ def analyze_stage(stage, ndev, executor_or_store):
     logical_spill = False
     if stage.is_shuffle_map:
         dep = stage.shuffle_dep
-        if dep.partitioner.num_partitions > ndev:
-            # more logical partitions than devices: only the spilled
-            # no-combine stream supports this (rid rides the exchange,
-            # runs land per logical partition).  Small inputs go to the
-            # object path HERE, not via an executor error.
-            if not (is_list_agg(dep.aggregator)
-                    and source[0] == "ingest"
-                    and _big_columnar(source[1])):
-                return None
-            logical_spill = True
         epi_spec = partitioner_spec(dep.partitioner)
         if epi_spec is None:
             return None
@@ -709,6 +754,21 @@ def analyze_stage(stage, ndev, executor_or_store):
             if epi_spec[0] == "hash" and layout.key_leaf_index(
                     cur_treedef, cur_specs) is None:
                 return None
+        if dep.partitioner.num_partitions > ndev:
+            # more logical partitions than devices: only the spilled
+            # no-combine stream supports this (rid rides the exchange,
+            # runs land per logical partition) — list aggregators and
+            # UNTRACEABLE merges (combiner applied host-side at export)
+            # both ride it; traceable merges pre-reduce per device and
+            # need r <= ndev.  Small inputs go to the object path HERE,
+            # not via an executor error.
+            if not (source[0] == "ingest"
+                    and _big_columnar(source[1])):
+                return None
+            if not is_list_agg(dep.aggregator) \
+                    and merge_traceable(dep.aggregator, cur_specs[1:]):
+                return None
+            logical_spill = True
         epilogue = ("shuffle_write", dep)
 
     plan = StagePlan(source, ops, epilogue, treedef, specs,

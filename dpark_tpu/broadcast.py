@@ -7,15 +7,25 @@ the id; workers lazily fetch on first deref.  The reference distributes
 Layout: the value pickles+compresses once, then splits into CHUNK-sized
 pieces under workdir/broadcast (b<id>.meta + b<id>.<i>).  Same-host
 workers read the files directly; remote workers fetch the chunks over
-TCP from the origin's bucket server (dpark_tpu/dcn.py), whose address
-rides along in the pickled handle.  On the TPU backend a broadcast value
-that is a jax.Array (or numpy) is realised as a replicated device array
-via jax.device_put with a fully-replicated sharding — the ICI equivalent
-of the reference's tree broadcast.
+TCP (dpark_tpu/dcn.py).  On the TPU backend a broadcast value that is a
+jax.Array (or numpy) is realised as a replicated device array via
+jax.device_put with a fully-replicated sharding — the ICI equivalent of
+the reference's tree broadcast.
+
+P2P fan-out (the reference's defining broadcast mechanism): when a
+tracker is configured (DPARK_TRACKER), every host that HOLDS a chunk is
+registered per chunk under "bcast:<bid>:<i>", and fetchers pick a
+random NON-ORIGIN holder for each chunk when one exists — so the origin
+serves each chunk O(1) times and the serving capacity grows with every
+completed fetch.  Fetchers that run a bucket server register themselves
+chunk-by-chunk AS THEY FETCH, so a large value fans out through peers
+even while the first fetch is still in flight.  Without a tracker the
+handle falls back to fetching everything from the origin.
 """
 
 import os
 import pickle
+import random
 import struct
 import threading
 
@@ -25,6 +35,17 @@ CHUNK = 1 << 20                      # ~1MB compressed per chunk
 
 _local_values = {}          # bid -> value, populated in creating process
 _lock = threading.Lock()
+_trackers = {}              # tracker addr -> TrackerClient (per process)
+
+
+def _tracker_for(addr):
+    if addr is None:
+        return None
+    cli = _trackers.get(addr)
+    if cli is None:
+        from dpark_tpu.tracker import TrackerClient
+        cli = _trackers[addr] = TrackerClient(addr)
+    return cli
 
 
 class Broadcast:
@@ -35,11 +56,19 @@ class Broadcast:
         self.bid = Broadcast._next_id[0]
         self._value = value
         self._origin = None
+        self._tracker_addr = None
         _local_values[self.bid] = value
-        self._write_chunks(value)
+        nchunks = self._write_chunks(value)
         from dpark_tpu.env import env
         if env.bucket_server is not None:
             self._origin = env.bucket_server.addr
+        if env.tracker_client is not None and self._origin is not None:
+            # one RPC regardless of value size: the ORIGIN is an
+            # implicit holder of every chunk (fetchers fall back to it
+            # whenever the per-chunk holder set has no peers), so only
+            # the chunk count needs publishing here
+            self._tracker_addr = env.tracker_addr
+            env.tracker_client.set("bcast_meta:%d" % self.bid, nchunks)
 
     def _dir(self):
         from dpark_tpu.env import env
@@ -55,6 +84,7 @@ class Broadcast:
                 f.write(blob[i * CHUNK:(i + 1) * CHUNK])
         with atomic_file(os.path.join(d, "b%d.meta" % self.bid)) as f:
             f.write(struct.pack("!I", nchunks))
+        return nchunks
 
     def _read_local(self):
         d = self._dir()
@@ -68,30 +98,76 @@ class Broadcast:
         return pickle.loads(decompress(b"".join(parts)))
 
     def _fetch_remote(self):
-        """Chunked fetch over ONE TCP connection to the origin's bucket
-        server.  The fetched chunks are re-written into the LOCAL
-        workdir so CO-LOCATED workers (same workdir) read files instead
-        of re-fetching.  Handles still point every remote host at the
-        single origin — the reference's tree/P2P fan-out (re-routing
-        fetchers to peers that already hold the value) is not
-        implemented."""
+        """Chunked fetch with P2P holder selection.
+
+        With a tracker: each chunk is pulled from a random NON-ORIGIN
+        holder when one exists (origin only as first/fallback source),
+        and if this process serves a bucket server it registers itself
+        as a holder chunk-by-chunk as the bytes land — fan-out grows
+        while the fetch is still running.  Chunks are grouped by chosen
+        holder so each peer is one connection (fetch_many).  Without a
+        tracker: everything from the origin over one connection.
+
+        Fetched chunks are also re-written into the LOCAL workdir so
+        CO-LOCATED workers (same workdir) read files instead of
+        re-fetching."""
         from dpark_tpu import dcn
-        meta = dcn.fetch(self._origin, ("bcast_meta", self.bid))
-        (nchunks,) = struct.unpack("!I", meta)
-        parts = dcn.fetch_many(
-            self._origin,
-            [("bcast", self.bid, i) for i in range(nchunks)])
-        try:
-            d = self._dir()
-            for i, blob in enumerate(parts):
+        from dpark_tpu.env import env
+        tracker = _tracker_for(self._tracker_addr)
+        nchunks = None
+        if tracker is not None:
+            nchunks = tracker.get("bcast_meta:%d" % self.bid)
+        if nchunks is None:
+            meta = dcn.fetch(self._origin, ("bcast_meta", self.bid))
+            (nchunks,) = struct.unpack("!I", meta)
+        my_uri = env.bucket_server.addr if env.bucket_server else None
+        d = self._dir()
+        parts = [None] * nchunks
+
+        def land(i, blob):
+            parts[i] = blob
+            try:
                 with atomic_file(os.path.join(
                         d, "b%d.%d" % (self.bid, i))) as f:
                     f.write(blob)
+            except OSError:
+                return                   # read-only workdir: no cache,
+                                         # and never register as holder
+            if tracker is not None and my_uri is not None:
+                tracker.add_item("bcast:%d:%d" % (self.bid, i), my_uri)
+
+        # per-chunk source re-planning over pooled connections:
+        # concurrent fetchers start at RANDOM offsets, so they land
+        # different chunks first, register them, and feed each other
+        # while still fetching — the holder query happens per chunk,
+        # not once up front
+        pool = dcn.FetchPool()
+        start = random.randrange(nchunks)
+        try:
+            for i in [(start + j) % nchunks for j in range(nchunks)]:
+                src = self._origin
+                if tracker is not None:
+                    peers = sorted({h for h in (tracker.get(
+                        "bcast:%d:%d" % (self.bid, i)) or [])
+                        if h != my_uri and h != self._origin})
+                    if peers:
+                        src = random.choice(peers)
+                try:
+                    blob = pool.fetch(src, ("bcast", self.bid, i))
+                except (IOError, OSError):
+                    if src == self._origin:
+                        raise              # origin down: unrecoverable
+                    blob = pool.fetch(self._origin,
+                                      ("bcast", self.bid, i))
+                land(i, blob)
+        finally:
+            pool.close()
+        try:
             with atomic_file(os.path.join(
                     d, "b%d.meta" % self.bid)) as f:
                 f.write(struct.pack("!I", nchunks))
         except OSError:
-            pass                         # read-only workdir: skip cache
+            pass
         return pickle.loads(decompress(b"".join(parts)))
 
     @property
@@ -111,10 +187,12 @@ class Broadcast:
         return self._value
 
     def __getstate__(self):
-        return (self.bid, self._origin)
+        return (self.bid, self._origin, self._tracker_addr)
 
     def __setstate__(self, state):
-        self.bid, self._origin = state
+        if len(state) == 2:              # handle from an older writer
+            state = state + (None,)
+        self.bid, self._origin, self._tracker_addr = state
         self._value = _local_values.get(self.bid)
 
     def clear(self):

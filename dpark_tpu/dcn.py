@@ -162,6 +162,8 @@ class BucketServer(FramedServer):
 
     def __init__(self, workdir, host="0.0.0.0", port=0):
         self.workdir = workdir
+        self.bcast_serves = {}        # (bid, chunk) -> times served
+        self._serves_lock = threading.Lock()
         super().__init__(self._serve, host, port,
                          name="dpark-bucket-server")
 
@@ -210,8 +212,19 @@ class BucketServer(FramedServer):
             path = os.path.join(self.workdir, "broadcast",
                                 "b%d.%d" % (bid, i))
             with open(path, "rb") as f:
-                return f.read()
+                data = f.read()
+            with self._serves_lock:   # handler threads are concurrent
+                self.bcast_serves[(bid, i)] = \
+                    self.bcast_serves.get((bid, i), 0) + 1
+            return data
         raise ValueError("unknown request %r" % (req[0],))
+
+
+class ServerError(IOError):
+    """The peer answered with an application-level error (status 1) or
+    a response that failed MAC verification — as opposed to a transport
+    failure.  Retrying the same request on a fresh connection cannot
+    help, so connection-pool retry logic must let this through."""
 
 
 def _request(sock, req):
@@ -226,10 +239,10 @@ def _request(sock, req):
         want = hmac.new(secret, bytes([status]) + payload,
                         hashlib.sha256).digest()
         if not hmac.compare_digest(tag, want):
-            raise IOError("bucket server: response MAC mismatch")
+            raise ServerError("bucket server: response MAC mismatch")
     if status:
-        raise IOError("bucket server: %s"
-                      % payload.decode("utf-8", "replace"))
+        raise ServerError("bucket server: %s"
+                          % payload.decode("utf-8", "replace"))
     return payload
 
 
@@ -253,3 +266,40 @@ def fetch_many(uri, reqs, timeout=30):
     without per-chunk connect/teardown."""
     with _connect(uri, timeout) as sock:
         return [_request(sock, req) for req in reqs]
+
+
+class FetchPool:
+    """One open connection per uri, reused across requests — the
+    P2P broadcast fetch re-plans its source per chunk, which would
+    otherwise mean one TCP handshake per chunk."""
+
+    def __init__(self, timeout=30):
+        self.timeout = timeout
+        self._socks = {}
+
+    def fetch(self, uri, req):
+        sock = self._socks.get(uri)
+        if sock is None:
+            sock = self._socks[uri] = _connect(uri, self.timeout)
+        try:
+            return _request(sock, req)
+        except ServerError:
+            raise        # application error: the connection is fine
+                         # and a resend would just fail again
+        except (ConnectionError, OSError):
+            # one reconnect: the cached socket may be stale
+            self.close_uri(uri)
+            sock = self._socks[uri] = _connect(uri, self.timeout)
+            return _request(sock, req)
+
+    def close_uri(self, uri):
+        sock = self._socks.pop(uri, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self):
+        for uri in list(self._socks):
+            self.close_uri(uri)

@@ -49,13 +49,22 @@ def _file_rendezvous(path, process_id, timeout=120):
             f.write(addr)
         os.replace(tmp, path)
         return addr
-    deadline = time.time() + timeout
+    # freshness guard: a rank can start before rank 0 has replaced a
+    # LEFTOVER file from a previous run, and joining a dead (or still
+    # running) old coordinator hangs until jax's timeout.  Accept only
+    # files written within a slack window of this rank's own start —
+    # launcher-coordinated ranks start together, so the fresh publish
+    # always qualifies while a file from a run minutes ago never does.
+    started = time.time()
+    slack = 120.0
+    deadline = started + timeout
     while time.time() < deadline:
         try:
-            with open(path) as f:
-                addr = f.read().strip()
-            if addr:
-                return addr
+            if os.path.getmtime(path) >= started - slack:
+                with open(path) as f:
+                    addr = f.read().strip()
+                if addr:
+                    return addr
         except OSError:
             pass
         time.sleep(0.05)

@@ -54,6 +54,8 @@ class DparkEnv:
         self.shuffle_fetcher = None       # set by shuffle.py on start
         self.session_id = None
         self.bucket_server = None         # DCN data plane, opt-in
+        self.tracker_client = None        # DCN metadata plane, opt-in
+        self.tracker_addr = None
 
     def start(self, is_master=True, environ=None):
         if self.started:
@@ -73,6 +75,12 @@ class DparkEnv:
         if environ.get("DPARK_BUCKET_SERVER") \
                 or os.environ.get("DPARK_BUCKET_SERVER"):
             self.start_bucket_server()
+        addr = environ.get("DPARK_TRACKER") \
+            or os.environ.get("DPARK_TRACKER")
+        if addr:
+            from dpark_tpu.tracker import TrackerClient
+            self.tracker_client = TrackerClient(addr)
+            self.tracker_addr = addr
 
     def start_bucket_server(self, port=0):
         """Serve this process's shuffle buckets + broadcast chunks over
@@ -115,6 +123,10 @@ class DparkEnv:
         if self.bucket_server is not None:
             self.bucket_server.stop()
             self.bucket_server = None
+        if self.tracker_client is not None:
+            self.tracker_client.close()
+            self.tracker_client = None
+            self.tracker_addr = None
 
     @property
     def host(self):

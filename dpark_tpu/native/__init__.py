@@ -79,6 +79,10 @@ def get_lib():
         lib.tokendict_encode.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int64]
+        lib.tokendict_encode_sep.restype = ctypes.c_int64
+        lib.tokendict_encode_sep.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_uint8, ctypes.c_void_p, ctypes.c_int64]
         lib.tokendict_get.restype = ctypes.c_int64
         lib.tokendict_get.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
@@ -185,18 +189,43 @@ class TokenDict:
             return self._lib.tokendict_size(self._h)
         return len(self._rev)
 
-    def encode(self, buf):
-        """Tokenize bytes on whitespace -> int64 id array."""
+    def encode(self, buf, sep=None):
+        """Tokenize bytes -> int64 id array.
+
+        sep=None: whitespace runs (str.split() over ASCII bytes).
+        sep=<1-byte str/bytes>: per \\n-line (trailing \\r stripped,
+        TextFileRDD's rule), split on EVERY separator occurrence —
+        exact str.split(sep) semantics incl. empty fields."""
         if isinstance(buf, str):
             buf = buf.encode("utf-8")
+        if sep is not None and isinstance(sep, str):
+            sep = sep.encode("utf-8")
         if self._h:
-            max_tokens = max(1, len(buf) // 2 + 1)
+            if sep is None:
+                max_tokens = max(1, len(buf) // 2 + 1)
+                out = np.empty(max_tokens, dtype=np.int64)
+                cnt = self._lib.tokendict_encode(
+                    self._h, buf, len(buf), out.ctypes.data,
+                    max_tokens)
+                return out[:cnt]
+            # fields per line = seps + 1; lines <= \n count + 1
+            max_tokens = buf.count(b"\n") + buf.count(sep) + 2
             out = np.empty(max_tokens, dtype=np.int64)
-            cnt = self._lib.tokendict_encode(
-                self._h, buf, len(buf), out.ctypes.data, max_tokens)
+            cnt = self._lib.tokendict_encode_sep(
+                self._h, buf, len(buf), sep[0], out.ctypes.data,
+                max_tokens)
             return out[:cnt]
         ids = []
-        for tok in buf.split():
+        if sep is None:
+            toks = buf.split()
+        else:
+            toks = []
+            lines = buf.split(b"\n")
+            if lines and lines[-1] == b"":
+                lines.pop()
+            for ln in lines:
+                toks.extend(ln.rstrip(b"\r").split(sep))
+        for tok in toks:
             tid = self._map.get(tok)
             if tid is None:
                 tid = len(self._rev)

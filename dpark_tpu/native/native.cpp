@@ -150,6 +150,47 @@ int64_t tokendict_encode(void* h, const uint8_t* buf, int64_t n,
     return count;
 }
 
+// Single-byte-separator tokenizer: split buf into \n-lines (stripping
+// trailing \r runs, like TextFileRDD's rstrip(b"\r\n")), then each
+// line on `sep`, encoding EVERY field INCLUDING empty ones — exact
+// str.split(sep) semantics, which unlike whitespace split preserves
+// empty fields between consecutive separators and yields [""] for an
+// empty line.  Backs canonical chains like
+// flatMap(lambda l: l.split("\t")).
+int64_t tokendict_encode_sep(void* h, const uint8_t* buf, int64_t n,
+                             uint8_t sep, int64_t* out,
+                             int64_t max_tokens) {
+    TokenDict* d = (TokenDict*)h;
+    int64_t count = 0;
+    int64_t i = 0;
+    while (i < n && count < max_tokens) {
+        int64_t line_end = i;
+        while (line_end < n && buf[line_end] != '\n') line_end++;
+        int64_t e = line_end;
+        while (e > i && buf[e - 1] == '\r') e--;
+        int64_t start = i;
+        for (int64_t j = i; j <= e && count < max_tokens; j++) {
+            if (j == e || buf[j] == sep) {
+                std::string tok((const char*)buf + start,
+                                (size_t)(j - start));
+                auto it = d->map.find(tok);
+                int64_t id;
+                if (it == d->map.end()) {
+                    id = (int64_t)d->rev.size();
+                    d->rev.push_back(tok);
+                    d->map.emplace(std::move(tok), id);
+                } else {
+                    id = it->second;
+                }
+                out[count++] = id;
+                start = j + 1;
+            }
+        }
+        i = line_end + 1;
+    }
+    return count;
+}
+
 // Encode ONE exact string (no tokenization — the key may contain
 // whitespace) to its dense id, assigning a new id on first sight.
 int64_t tokendict_put(void* h, const uint8_t* buf, int64_t n) {

@@ -546,8 +546,17 @@ def _process_worker(task_bytes, snapshot, environ):
 
 
 class MultiProcessScheduler(DAGScheduler):
-    """Fork-pool master (reference: -m process).  Exercises the full
-    serialize/ship/track path and is the CPU baseline for benchmarks."""
+    """Process-pool master (reference: -m process).  Exercises the full
+    serialize/ship/track path and is the CPU baseline for benchmarks.
+
+    Workers fork from a FORKSERVER, not from the driver: the driver has
+    usually initialized jax (multithreaded — forking it is the classic
+    latent deadlock), while the forkserver process only ever imports
+    modules and starts no backend threads, so forking it is safe and
+    keeps per-task worker startup cheap.  Worker state therefore does
+    NOT inherit driver memory: everything a task needs travels in
+    task_bytes + the map-output snapshot + environ (broadcast derefs go
+    through workdir files / TCP, same as a real remote worker)."""
 
     def __init__(self, threads=None):
         super().__init__()
@@ -557,8 +566,38 @@ class MultiProcessScheduler(DAGScheduler):
     def start(self):
         super().start()
         if self.pool is None:
-            ctx = multiprocessing.get_context("fork")
-            self.pool = ctx.Pool(self.num_workers)
+            ctx = multiprocessing.get_context("forkserver")
+            ctx.set_forkserver_preload(["dpark_tpu.schedule"])
+            # suppress the worker bootstrap's __main__ re-import: our
+            # serializer ships __main__-defined closures BY VALUE, so
+            # workers never need the user's script — and re-importing
+            # it breaks outright for <stdin>/-c programs and re-runs
+            # script module bodies otherwise
+            import sys
+            main_mod = sys.modules.get("__main__")
+            had_file = main_mod is not None \
+                and hasattr(main_mod, "__file__")
+            saved_file = getattr(main_mod, "__file__", None)
+            # __spec__ must EXIST for the spawn prep (it reads the
+            # attribute unconditionally) but None makes it skip the
+            # module-name path; no __file__ skips the path path
+            had_spec = main_mod is not None \
+                and hasattr(main_mod, "__spec__")
+            saved_spec = getattr(main_mod, "__spec__", None)
+            if main_mod is not None:
+                if had_file:
+                    del main_mod.__file__
+                main_mod.__spec__ = None
+            try:
+                self.pool = ctx.Pool(self.num_workers)
+            finally:
+                if main_mod is not None:
+                    if had_file:
+                        main_mod.__file__ = saved_file
+                    if had_spec:
+                        main_mod.__spec__ = saved_spec
+                    else:
+                        del main_mod.__spec__
 
     def stop(self):
         super().stop()

@@ -2,14 +2,25 @@
 
 Reference parity: dpark/tracker.py — a tiny zmq REQ/REP KV server carrying
 map-output and cache locations between driver and executors (SURVEY.md
-section 2.8).  This implementation speaks length-prefixed pickle over a
+section 2.8).  This implementation speaks length-prefixed JSON over a
 plain TCP socket (no zmq dependency): the single-host masters use the
 in-process MapOutputTracker in env.py; this server is the DCN metadata
 plane for multi-host deployments (driver runs TrackerServer, remote hosts
 use TrackerClient).
+
+Wire safety: frames are JSON, never pickle — the tracker listens on the
+network and unpickling untrusted bytes is arbitrary code execution (same
+rule as dpark_tpu/dcn.py).  Binary values (e.g. pickled Broadcast
+handles a DEPLOYMENT chooses to stash) survive via a base64 wrapper; the
+tracker itself never deserializes them.  DPARK_DCN_SECRET, when set,
+MACs every frame in both directions with HMAC-SHA256.
 """
 
-import pickle
+import base64
+import hashlib
+import hmac
+import json
+import os
 import socket
 import socketserver
 import struct
@@ -24,6 +35,8 @@ import uuid as _uuid
 
 
 class GetValueMessage:
+    op = "get"
+
     def __init__(self, key):
         self.key = key
 
@@ -37,6 +50,8 @@ class _Mutation:
 
 
 class SetValueMessage(_Mutation):
+    op = "set"
+
     def __init__(self, key, value):
         super().__init__()
         self.key = key
@@ -44,6 +59,8 @@ class SetValueMessage(_Mutation):
 
 
 class AddItemMessage(_Mutation):
+    op = "add"
+
     def __init__(self, key, item):
         super().__init__()
         self.key = key
@@ -51,6 +68,8 @@ class AddItemMessage(_Mutation):
 
 
 class RemoveItemMessage(_Mutation):
+    op = "remove"
+
     def __init__(self, key, item):
         super().__init__()
         self.key = key
@@ -58,18 +77,76 @@ class RemoveItemMessage(_Mutation):
 
 
 class StopTrackerMessage:
-    pass
+    op = "stop"
 
 
-def _send_msg(sock, obj):
-    data = pickle.dumps(obj, -1)
+def _wrap(v):
+    """JSON-encodable view of a value; bytes ride as base64 (opaque to
+    the tracker — never deserialized server-side)."""
+    if isinstance(v, bytes):
+        return {"__b64__": base64.b64encode(v).decode("ascii")}
+    if isinstance(v, (list, tuple)):
+        return [_wrap(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _wrap(x) for k, x in v.items()}
+    return v
+
+
+def _unwrap(v):
+    if isinstance(v, dict):
+        if set(v) == {"__b64__"}:
+            return base64.b64decode(v["__b64__"])
+        return {k: _unwrap(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_unwrap(x) for x in v]
+    return v
+
+
+def _msg_to_frame(msg):
+    if isinstance(msg, GetValueMessage):
+        body = [msg.op, msg.key]
+    elif isinstance(msg, SetValueMessage):
+        body = [msg.op, msg.msg_id, msg.key, _wrap(msg.value)]
+    elif isinstance(msg, (AddItemMessage, RemoveItemMessage)):
+        body = [msg.op, msg.msg_id, msg.key, _wrap(msg.item)]
+    elif isinstance(msg, StopTrackerMessage):
+        body = [msg.op]
+    else:
+        raise TypeError("unknown tracker message %r" % (msg,))
+    return json.dumps(body, separators=(",", ":")).encode()
+
+
+def _secret():
+    return os.environ.get("DPARK_DCN_SECRET", "").encode()
+
+
+def _send_raw(sock, data):
+    secret = _secret()
+    if secret:
+        data = hmac.new(secret, data, hashlib.sha256).digest() + data
     sock.sendall(struct.pack("<I", len(data)) + data)
 
 
-def _recv_msg(sock):
+def _send_msg(sock, obj):
+    _send_raw(sock, json.dumps(_wrap(obj),
+                               separators=(",", ":")).encode())
+
+
+def _recv_frame(sock):
     header = _recv_exact(sock, 4)
     (n,) = struct.unpack("<I", header)
-    return pickle.loads(_recv_exact(sock, n))
+    data = _recv_exact(sock, n)
+    secret = _secret()
+    if secret:
+        tag, data = data[:32], data[32:]
+        want = hmac.new(secret, data, hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise PermissionError("tracker frame MAC mismatch")
+    return data
+
+
+def _recv_msg(sock):
+    return _unwrap(json.loads(_recv_frame(sock).decode("utf-8")))
 
 
 def _recv_exact(sock, n):
@@ -94,10 +171,14 @@ class TrackerServer:
             def handle(self):
                 try:
                     while True:
-                        msg = _recv_msg(self.request)
-                        reply = outer._handle(msg)
+                        try:
+                            body = _recv_msg(self.request)
+                        except (ValueError, PermissionError,
+                                json.JSONDecodeError):
+                            return     # malformed/unauthenticated frame
+                        reply, stop = outer._handle(body)
                         _send_msg(self.request, reply)
-                        if isinstance(msg, StopTrackerMessage):
+                        if stop:
                             outer._server.shutdown()
                             return
                 except (ConnectionError, OSError):
@@ -130,32 +211,36 @@ class TrackerServer:
             self._thread.join(2)
             self._thread = None
 
-    def _handle(self, msg):
+    def _handle(self, body):
+        """body is the decoded JSON frame [op, ...]; returns
+        (reply, stop_server)."""
+        op = body[0] if body else None
         with self.lock:
-            if isinstance(msg, GetValueMessage):
-                return self.data.get(msg.key)
-            if isinstance(msg, _Mutation):
-                if msg.msg_id in self._applied:
-                    return self._applied[msg.msg_id]    # retry replay
-                if isinstance(msg, SetValueMessage):
-                    self.data[msg.key] = msg.value
-                elif isinstance(msg, AddItemMessage):
-                    self.data.setdefault(msg.key, []).append(msg.item)
-                elif isinstance(msg, RemoveItemMessage):
-                    items = self.data.get(msg.key, [])
-                    if msg.item in items:
-                        items.remove(msg.item)
-                self._applied[msg.msg_id] = True
-                self._applied_order.append(msg.msg_id)
+            if op == "get":
+                return self.data.get(body[1]), False
+            if op in ("set", "add", "remove"):
+                msg_id, key, value = body[1], body[2], body[3]
+                if msg_id in self._applied:
+                    return self._applied[msg_id], False  # retry replay
+                if op == "set":
+                    self.data[key] = value
+                elif op == "add":
+                    self.data.setdefault(key, []).append(value)
+                else:
+                    items = self.data.get(key, [])
+                    if value in items:
+                        items.remove(value)
+                self._applied[msg_id] = True
+                self._applied_order.append(msg_id)
                 if len(self._applied_order) > 100_000:
                     old = self._applied_order[:50_000]
                     del self._applied_order[:50_000]
                     for mid in old:
                         self._applied.pop(mid, None)
-                return True
-            if isinstance(msg, StopTrackerMessage):
-                return True
-        return None
+                return True, False
+            if op == "stop":
+                return True, True
+        return None, False
 
 
 class TrackerClient:
@@ -171,15 +256,16 @@ class TrackerClient:
         return self._sock
 
     def call(self, msg):
+        frame = _msg_to_frame(msg)
         with self._lock:
             try:
                 sock = self._conn()
-                _send_msg(sock, msg)
+                _send_raw(sock, frame)
                 return _recv_msg(sock)
             except (ConnectionError, OSError):
                 self.close()
                 sock = self._conn()
-                _send_msg(sock, msg)
+                _send_raw(sock, frame)
                 return _recv_msg(sock)
 
     def get(self, key):

@@ -111,3 +111,122 @@ def test_aggregator_visible_next_superstep(ctx):
     msgs = ctx.parallelize([], 2)
     Bagel.run(ctx, verts, msgs, compute, aggregator=MaxAggregator())
     assert seen and all(a == 4.0 for a in seen)
+
+
+# ----------------------------------------------------------------------
+# fast driver-resident object path (VERDICT r2 ask #4): same semantics
+# as the RDD algebra, no per-superstep shuffle jobs
+# ----------------------------------------------------------------------
+
+def _run_both_paths(ctx, make_inputs, compute, **kw):
+    """The same program through the fast path and the RDD path."""
+    import dpark_tpu.bagel as bagel_mod
+    verts, msgs = make_inputs()
+    fast = dict(Bagel.run(ctx, verts, msgs, compute, **kw).collect())
+    was = bagel_mod.FAST_OBJECT_RUN
+    bagel_mod.FAST_OBJECT_RUN = False
+    try:
+        verts, msgs = make_inputs()
+        rdd = dict(Bagel.run(ctx, verts, msgs, compute, **kw).collect())
+    finally:
+        bagel_mod.FAST_OBJECT_RUN = was
+    return fast, rdd
+
+
+def test_fast_path_matches_rdd_path_pagerank(ctx):
+    def make():
+        verts, msgs, _ = make_graph(ctx, GRAPH)
+        return verts, msgs
+    fast, rdd = _run_both_paths(
+        ctx, make, PRCompute(4), combiner=BasicCombiner(operator.add))
+    assert set(fast) == set(rdd)
+    for vid in fast:
+        assert abs(fast[vid].value - rdd[vid].value) < 1e-12
+        assert fast[vid].active == rdd[vid].active
+
+
+def test_fast_path_matches_rdd_path_sssp(ctx):
+    """List-combiner mail, inactive vertices woken by messages, and a
+    vertex with no outgoing edges."""
+    inf = float("inf")
+    chain = {0: [1, 2], 1: [3], 2: [3], 3: []}
+
+    def make():
+        verts = ctx.parallelize(
+            [(i, Vertex(i, 0.0 if i == 0 else inf,
+                        [Edge(t) for t in targets]))
+             for i, targets in chain.items()], 2)
+        return verts, ctx.parallelize([], 2)
+
+    fast, rdd = _run_both_paths(ctx, make, SPCompute())
+    assert {v: fast[v].value for v in fast} \
+        == {v: rdd[v].value for v in rdd}
+
+
+def test_fast_path_drops_unknown_targets(ctx):
+    """Messages to ids not in the graph vanish on both paths."""
+    def compute(vert, mail, agg, superstep):
+        active = superstep < 2
+        return (Vertex(vert.id, (vert.value
+                                 + (sum(mail) if mail else 0)),
+                       vert.outEdges, active),
+                [Message(99, 1), Message(1 - vert.id, 1)]
+                if active else [])
+
+    def make():
+        verts = ctx.parallelize(
+            [(i, Vertex(i, 0, [])) for i in range(2)], 2)
+        return verts, ctx.parallelize([], 2)
+
+    fast, rdd = _run_both_paths(ctx, make, compute)
+    assert {v: fast[v].value for v in fast} \
+        == {v: rdd[v].value for v in rdd}
+
+
+def test_fast_path_initial_messages_and_aggregator(ctx):
+    seen = []
+
+    def compute(vert, mail, agg, superstep):
+        seen.append(agg)
+        val = vert.value + (sum(mail) if mail else 0)
+        return (Vertex(vert.id, val, vert.outEdges, False), [])
+
+    def make():
+        verts = ctx.parallelize(
+            [(i, Vertex(i, float(i), [])) for i in range(4)], 2)
+        msgs = ctx.parallelize([(0, 10.0), (0, 5.0), (3, 1.0)], 2)
+        return verts, msgs
+
+    fast, rdd = _run_both_paths(ctx, make, compute,
+                                aggregator=MaxAggregator())
+    assert {v: fast[v].value for v in fast} \
+        == {v: rdd[v].value for v in rdd}
+    assert fast[0].value == 15.0 and fast[3].value == 4.0
+    assert 3.0 in seen                      # aggregator ran on both
+
+
+def test_fast_path_falls_back_on_id_rebinding(ctx):
+    """compute returning a vertex with a different id is only modeled
+    by the RDD path (key stays, id attr changes): the fast path must
+    detect it and fall back with identical results."""
+    def compute(vert, mail, agg, superstep):
+        return (Vertex(vert.id + 100, vert.value + 1, [], False), [])
+
+    verts = ctx.parallelize(
+        [(i, Vertex(i, float(i), [])) for i in range(3)], 2)
+    msgs = ctx.parallelize([], 2)
+    out = dict(Bagel.run(ctx, verts, msgs, compute).collect())
+    assert sorted(out) == [0, 1, 2]          # keys preserved
+    assert all(out[k].id == k + 100 for k in out)
+
+
+def test_fast_path_schedules_no_superstep_jobs(ctx):
+    """The point of the fast path: zero RDD jobs inside the superstep
+    loop (the RDD path schedules >= 2 per superstep)."""
+    verts, msgs, n = make_graph(ctx, GRAPH)
+    ctx.start()
+    before = ctx.scheduler._next_job_id
+    Bagel.run(ctx, verts, msgs, PRCompute(n, steps=5),
+              combiner=BasicCombiner(operator.add))
+    jobs = ctx.scheduler._next_job_id - before
+    assert jobs <= 3, jobs            # count + two collects, no loop jobs

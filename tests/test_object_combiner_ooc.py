@@ -1,0 +1,124 @@
+"""Out-of-core streaming for UNTRACEABLE combiners (VERDICT r2 ask #7).
+
+The reference's disk-spilling external merger handles any combiner at
+any size.  Here, a big source whose merge_combiners cannot trace (no
+jnp semantics — e.g. math.gcd needs concrete ints) rides the spilled-
+run stream: created combiners exchange on device, key-sorted runs land
+on host disk per logical partition, and the user's merge folds each
+sorted key group at export — O(1) combine state per key, input never
+materialized whole.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def tctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu")
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def small_chunks():
+    """Shrink the wave size so modest test data exercises the stream."""
+    import dpark_tpu.conf as conf
+    was = conf.STREAM_CHUNK_ROWS, conf.STREAM_TEXT_BYTES
+    conf.STREAM_CHUNK_ROWS = 512
+    conf.STREAM_TEXT_BYTES = 20000
+    yield
+    conf.STREAM_CHUNK_ROWS, conf.STREAM_TEXT_BYTES = was
+
+
+def _expect_gcd(keys, vals):
+    out = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        out[k] = math.gcd(out[k], v) if k in out else v
+    return out
+
+
+def test_untraceable_merge_streams_columnar(tctx, small_chunks):
+    """math.gcd: associative+commutative but untraceable and not a
+    classified monoid.  Big columnar input, r > mesh: must stream via
+    host-combined spill runs, with exact parity."""
+    from dpark_tpu import Columns
+    n = 16000
+    i = np.arange(n, dtype=np.int64)
+    keys = (i * 7) % 97
+    vals = (i % 5 + 1) * 6
+    got = dict(tctx.parallelize(Columns(keys, vals), 8)
+               .reduceByKey(math.gcd, 24).collect())
+    assert got == _expect_gcd(keys, vals)
+    stores = tctx.scheduler.executor.shuffle_store
+    assert any(s.get("host_combine") for s in stores.values()), \
+        "untraceable merge did not take the spilled-run stream"
+
+
+def test_untraceable_merge_streams_r_le_mesh(tctx, small_chunks):
+    from dpark_tpu import Columns
+    n = 12000
+    i = np.arange(n, dtype=np.int64)
+    keys = i % 53
+    vals = (i % 7 + 1) * 10
+    got = dict(tctx.parallelize(Columns(keys, vals), 8)
+               .reduceByKey(math.gcd, 4).collect())
+    assert got == _expect_gcd(keys, vals)
+    stores = tctx.scheduler.executor.shuffle_store
+    assert any(s.get("host_combine") for s in stores.values())
+
+
+def test_untraceable_merge_small_stays_in_core(tctx):
+    """Small inputs keep the in-core path (no spill directory)."""
+    from dpark_tpu import Columns
+    i = np.arange(400, dtype=np.int64)
+    got = dict(tctx.parallelize(Columns(i % 11, i % 3 + 1), 8)
+               .reduceByKey(math.gcd, 4).collect())
+    assert got == _expect_gcd(i % 11, i % 3 + 1)
+    stores = tctx.scheduler.executor.shuffle_store
+    assert not any(s.get("host_combine") for s in stores.values())
+
+
+def test_untraceable_merge_streams_text(tctx, small_chunks, tmp_path):
+    """Text source + untraceable merge: host prologue feeds the same
+    spilled stream (create runs device-side, merge folds at export)."""
+    p = str(tmp_path / "nums.txt")
+    with open(p, "w") as f:
+        for i in range(6000):
+            f.write("%d %d\n" % (i % 41, (i % 6 + 1) * 4))
+
+    def parse(line):
+        a, b = line.split()
+        return (int(a), int(b))
+
+    got = dict(tctx.textFile(p, splitSize=4000)
+               .map(parse)
+               .reduceByKey(math.gcd, 16).collect())
+
+    from dpark_tpu import DparkContext
+    lctx = DparkContext("local")
+    expect = dict(lctx.textFile(p, splitSize=4000)
+                  .map(parse)
+                  .reduceByKey(math.gcd, 16).collect())
+    lctx.stop()
+    assert got == expect
+
+
+def test_untraceable_merge_downstream_group(tctx, small_chunks):
+    """The export feeds downstream host stages: count over the reduced
+    RDD and a join against it."""
+    from dpark_tpu import Columns
+    n = 8000
+    i = np.arange(n, dtype=np.int64)
+    keys = i % 37
+    vals = (i % 4 + 1) * 9
+    r = tctx.parallelize(Columns(keys, vals), 8).reduceByKey(
+        math.gcd, 16)
+    assert r.count() == 37
+    expect = _expect_gcd(keys, vals)
+    top = dict(r.filter(lambda kv: kv[0] < 5).collect())
+    assert top == {k: v for k, v in expect.items() if k < 5}

@@ -238,6 +238,55 @@ def test_long_first_line_not_trusted(tctx, tmp_path):
     assert "x\u00a0y" not in got
 
 
+def test_separator_split_rides_device(tctx, tmp_path):
+    """flatMap(lambda l: l.split('\\t')) + (w,1): the constant-
+    separator C++ tokenizer (VERDICT r2 ask #9's 'one more native
+    tokenizer shape').  Exact str.split(sep) semantics incl. EMPTY
+    fields between consecutive separators."""
+    p = str(tmp_path / "tsv.txt")
+    with open(p, "w") as f:
+        for i in range(3000):
+            f.write("a\tb b\t\tc%d\n" % (i % 4))   # empty field + space
+            if i % 7 == 0:
+                f.write("\n")                       # empty line -> ['']
+
+    def run(ctx):
+        return dict(ctx.textFile(p, splitSize=9000)
+                    .flatMap(lambda line: line.split("\t"))
+                    .map(lambda w: (w, 1))
+                    .reduceByKey(lambda x, y: x + y, 4).collect())
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect
+    assert got["b b"] == 3000          # space is NOT a separator here
+    assert got[""] == 3000 + (3000 + 6) // 7   # empties counted
+    assert _text_path_used(tctx)
+
+
+def test_separator_split_comma(tctx, tmp_path):
+    p = str(tmp_path / "c.txt")
+    with open(p, "w") as f:
+        for i in range(2000):
+            f.write("x,y%d,,z\n" % (i % 3))
+
+    def run(ctx):
+        return dict(ctx.textFile(p, splitSize=7000)
+                    .flatMap(lambda line: line.split(","))
+                    .map(lambda w: (w, 1))
+                    .reduceByKey(lambda x, y: x + y, 4).collect())
+
+    from dpark_tpu import DparkContext
+    got = run(tctx)
+    lctx = DparkContext("local")
+    expect = run(lctx)
+    lctx.stop()
+    assert got == expect and got[""] == 2000
+
+
 def test_parallel_ingest_matches_serial(tmp_path):
     """VERDICT r2 ask #2: splits tokenize concurrently into private
     dicts merged in split order — results AND the global id assignment
