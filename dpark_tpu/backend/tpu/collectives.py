@@ -58,16 +58,21 @@ def _take(leaves, idx):
 
 def _lex_sort(ops, num_keys):
     """Stable lexicographic sort of `ops` by its first num_keys operands.
-    On TPU a single multi-operand lax.sort (bitonic network carries the
-    payload); on CPU — or when any payload is not rank-1, which XLA Sort
-    cannot carry — sort indices and gather instead."""
-    import jax as _jax
-    if (_jax.default_backend() != "cpu"
-            and all(o.ndim == 1 for o in ops)):
-        return lax.sort(tuple(ops), num_keys=num_keys, is_stable=True)
-    order = jnp.arange(ops[0].shape[0])
+
+    Formulated as permutation-compose + gather on every backend: XLA's
+    multi-operand Sort lowers (on TPU) to a comparison network whose
+    cost grows with total operand bytes — real-chip profiling (round 3,
+    v5e) measured a 4-operand i64 sort at 16M rows ~40x slower than a
+    single i32 sort.  Successive 2-operand (key, iota) argsorts
+    radix-compose the permutation instead, and every operand is
+    gathered exactly once; this also carries rank>1 payloads, which
+    XLA Sort cannot."""
+    order = jnp.arange(ops[0].shape[0], dtype=jnp.int32)
     for k in range(num_keys - 1, -1, -1):
-        order = order[jnp.argsort(ops[k][order], stable=True)]
+        # keep indices i32: under jax_enable_x64 argsort returns i64,
+        # and 64-bit gather indices hit the same emulated-i64 tax
+        order = order[jnp.argsort(ops[k][order],
+                                  stable=True).astype(jnp.int32)]
     return tuple(o[order] for o in ops)
 
 
@@ -94,7 +99,7 @@ def bucketize(key, leaves, n, n_dst, dst=None, r=None):
     valid = jnp.arange(cap) < n
     if dst is None:
         dst = hash_dst(key, n_dst, valid, r)
-    order = jnp.argsort(dst, stable=True)
+    order = jnp.argsort(dst, stable=True).astype(jnp.int32)
     sorted_leaves = _take(leaves, order)
     counts = jnp.bincount(dst, length=n_dst + 1)[:n_dst].astype(jnp.int32)
     offsets = jnp.concatenate(
@@ -263,6 +268,85 @@ def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves,
     offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
     return kk, vv, counts, offsets
+
+
+def bucketize_combine_rid(rid, key, val_leaves, n, n_dst, merge_leaves,
+                          monoid=None):
+    """Map-side pre-combine for the spilled-run stream (r > mesh): sort
+    one device's rows by (device, rid, key) — device = rid % n_dst —
+    merge equal (rid, key) rows, compact.  Cuts exchange volume to
+    O(#distinct keys per wave) before the wire.
+
+    Returns (sorted_leaves=[rid', key'] + vals', counts[n_dst],
+    offsets[n_dst]) with rows device-sorted and combined."""
+    cap = key.shape[0]
+    valid = jnp.arange(cap) < n
+    dev = jnp.where(valid, (rid % n_dst).astype(jnp.int32), n_dst)
+    k = jnp.where(valid, key, _sentinel(key.dtype))
+    rd = jnp.where(valid, rid, _sentinel(rid.dtype))
+    sorted_ops = _lex_sort((dev, rd, k) + tuple(val_leaves), 3)
+    d, rd, k = sorted_ops[0], sorted_ops[1], sorted_ops[2]
+    vs = list(sorted_ops[3:])
+
+    # rid equal implies dev equal, so (rid, key) defines the segment
+    same = (rd[1:] == rd[:-1]) & (k[1:] == k[:-1])
+    starts = jnp.concatenate([jnp.ones((1,), bool), ~same])
+    if monoid is not None:
+        seg, totals = _monoid_segment_totals(starts, vs, monoid)
+        keep = starts & (d < n_dst)
+        reduced = [t[seg] for t in totals]
+    else:
+        scanned = segmented_combine(starts, vs, merge_leaves)
+        is_last = jnp.concatenate([~same, jnp.ones((1,), bool)])
+        keep = is_last & (d < n_dst)
+        reduced = scanned
+    dd_full = jnp.where(keep, d, n_dst)
+    rd_full = jnp.where(keep, rd, _sentinel(rd.dtype))
+    kk_full = jnp.where(keep, k, _sentinel(k.dtype))
+    packed = _lex_sort((~keep, dd_full, rd_full, kk_full)
+                       + tuple(reduced), 1)
+    dd = packed[1]
+    out_leaves = [packed[2], packed[3]] + list(packed[4:])
+    counts = jnp.bincount(dd, length=n_dst + 1)[:n_dst].astype(jnp.int32)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    return out_leaves, counts, offsets
+
+
+def segment_reduce2(rid, key, val_leaves, valid_mask, merge_leaves,
+                    monoid=None):
+    """segment_reduce over the composite (rid, key): merge values of
+    rows equal in BOTH columns.  Used by the spilled-run stream's
+    per-wave pre-reduce, where the logical partition id rides next to
+    the user key (invalid rows carry the rid-dtype sentinel, set by
+    flatten_received, and sort last).
+
+    Returns (rid', key', reduced_val_leaves, n_unique) with uniques
+    packed to the front, sorted by (rid, key)."""
+    m = key.shape[0]
+    sorted_ops = _lex_sort((rid, key) + tuple(val_leaves), 2)
+    rd, k = sorted_ops[0], sorted_ops[1]
+    vs = list(sorted_ops[2:])
+    nvalid = jnp.sum(valid_mask).astype(jnp.int32)
+
+    changed = (rd[1:] != rd[:-1]) | (k[1:] != k[:-1])
+    starts = jnp.concatenate([jnp.ones((1,), bool), changed])
+    if monoid is not None:
+        seg, totals = _monoid_segment_totals(starts, vs, monoid)
+        keep = (starts & (jnp.arange(m) < nvalid)
+                & (rd != _sentinel(rd.dtype)))
+        reduced = [t[seg] for t in totals]
+    else:
+        scanned = segmented_combine(starts, vs, merge_leaves)
+        is_last = jnp.concatenate([changed, jnp.ones((1,), bool)])
+        keep = (is_last & (jnp.arange(m) < nvalid)
+                & (rd != _sentinel(rd.dtype)))
+        reduced = scanned
+    rd_full = jnp.where(keep, rd, _sentinel(rd.dtype))
+    k_full = jnp.where(keep, k, _sentinel(k.dtype))
+    packed = _lex_sort((~keep, rd_full, k_full) + tuple(reduced), 1)
+    return (packed[1], packed[2], list(packed[3:]),
+            jnp.sum(keep).astype(jnp.int32))
 
 
 def segment_reduce(key, val_leaves, valid_mask, merge_leaves,

@@ -820,7 +820,13 @@ class JAXExecutor:
         # probe (shared with compile time), memoized per plan
         merge_fn, _ = self._merge_probe(plan)
         if monoid is not None or merge_fn is not None:
-            return ("combine", _prefetch_iter(waves))
+            if dep.partitioner.num_partitions <= self.ndev:
+                return ("combine", _prefetch_iter(waves))
+            # traceable merge but r exceeds the mesh: the per-device
+            # combined state cannot hold r partitions — ride the
+            # spilled-run stream, which pre-reduces each wave per
+            # (rid, key) on device before spilling
+            return ("nocombine", _prefetch_iter(waves))
         # UNTRACEABLE merge (object-valued combiner semantics the
         # tracer can't see): ride the spilled-run stream — device
         # exchange of created combiners, key-sorted runs on host disk,
@@ -907,6 +913,13 @@ class JAXExecutor:
         # the rid column rides the exchange only when needed: with
         # r <= ndev the receiving device IS the logical partition
         carry_rid = r > ndev
+        # traceable merge riding the spilled stream: pre-combine equal
+        # (rid, key) rows on the map side too, BEFORE the wire (the
+        # program cache is safe to branch on this — program_key encodes
+        # the merge function)
+        merge_fn = monoid = None
+        if carry_rid and not fuse.is_list_agg(plan.epilogue[1].aggregator):
+            merge_fn, monoid = self._merge_probe(plan)
 
         def per_device(counts, *rest):
             n = counts[0]
@@ -923,16 +936,19 @@ class JAXExecutor:
                                             r, valid, r=r)
             else:
                 rid = collectives.hash_dst(k, r, valid, r=r)
-            if carry_rid:
+            if carry_rid and merge_fn is not None:
+                cols, cnts, offs = collectives.bucketize_combine_rid(
+                    rid, k, lv[1:], n, ndev, merge_fn, monoid=monoid)
+            elif carry_rid:
                 dev = jnp.where(valid, rid % ndev,
                                 ndev).astype(jnp.int32)
-                cols = [rid.astype(jnp.int64)] + lv
+                cols, cnts, offs = collectives.bucketize(
+                    k, [rid.astype(jnp.int64)] + lv, n, ndev, dst=dev)
             else:
                 dev = jnp.where(valid, rid, ndev).astype(jnp.int32)
-                cols = lv
-            sorted_lv, cnts, offs = collectives.bucketize(
-                k, cols, n, ndev, dst=dev)
-            out = (cnts, offs) + tuple(sorted_lv)
+                cols, cnts, offs = collectives.bucketize(
+                    k, lv, n, ndev, dst=dev)
+            out = (cnts, offs) + tuple(cols)
             return tuple(jnp.expand_dims(o, 0) for o in out)
 
         n_in = 1 + nleaves_in + (1 if has_bounds else 0)
@@ -966,6 +982,15 @@ class JAXExecutor:
         os.makedirs(spool, exist_ok=True)
         runs = [[] for _ in range(r)]
         bounds = self._bounds_arg(plan)
+        carry_rid = r > self.ndev
+        # TRACEABLE merge riding the spilled stream (r > mesh): each
+        # wave pre-reduces per (rid, key) on device before spilling, so
+        # runs hold one combiner per distinct key per wave instead of
+        # every row; export still folds across waves with the user's
+        # merge_combiners (host_combine below)
+        pre_merge = pre_monoid = None
+        if carry_rid and not fuse.is_list_agg(dep.aggregator):
+            pre_merge, pre_monoid = self._merge_probe(plan)
         for c, parts in enumerate(waves):
             batch = layout.ingest(self.mesh, parts, plan.in_treedef,
                                   plan.in_specs, key_leaf=0)
@@ -976,10 +1001,13 @@ class JAXExecutor:
             outs = jitted(*args)
             cnts, offs = outs[0], outs[1]
             leaves = list(outs[2:])          # [rid +] row leaves
-            carry_rid = r > self.ndev
             recv = self._exchange_all(leaves, cnts, offs)
-            sorted_batch = self._sort_received(
-                plan, recv, nkeys=2 if carry_rid else 1)
+            if pre_merge is not None:
+                sorted_batch = self._prereduce_received(
+                    plan, recv, pre_merge, pre_monoid)
+            else:
+                sorted_batch = self._sort_received(
+                    plan, recv, nkeys=2 if carry_rid else 1)
             # spill NUMPY COLUMNS per logical partition — no Python row
             # objects materialize at spill time (rows arrive sorted by
             # (rid, key); rid boundaries come from searchsorted)
@@ -1065,6 +1093,50 @@ class JAXExecutor:
             assert extra == 1 and isinstance(sample, tuple), sample
             treedef = jtu.tree_structure((0,) + sample)
         return layout.Batch(treedef, leaves, outs[0])
+
+    def _prereduce_received(self, plan, recv, merge_fn, monoid):
+        """Flatten exchange rounds and segment-reduce per (rid, key) on
+        device — the spilled-run stream's per-wave pre-combine for
+        traceable merges with r beyond the mesh.  Returns the same
+        rid-prefixed Batch shape as _sort_received(nkeys=2), with equal
+        (rid, key) rows already merged."""
+        recv_rounds, cnt_rounds, slot = recv
+        rounds = len(recv_rounds)
+        nleaves = len(recv_rounds[0])        # rid + key + value leaves
+        key = ("wave_prereduce", plan.program_key, rounds, slot,
+               nleaves)
+        if key not in self._compiled:
+            def per_device(*args):
+                cnts = [c[0] for c in args[:rounds]]
+                bufs = args[rounds:]
+                recvs = []
+                for r in range(rounds):
+                    recvs.append([bufs[r * nleaves + li][0]
+                                  for li in range(nleaves)])
+                flat, mask = collectives.flatten_received(recvs, cnts)
+                rid, k, vs, n = collectives.segment_reduce2(
+                    flat[0], flat[1], flat[2:], mask, merge_fn,
+                    monoid=monoid)
+                return (jnp.expand_dims(n, 0),
+                        jnp.expand_dims(rid, 0),
+                        jnp.expand_dims(k, 0)) + tuple(
+                    jnp.expand_dims(v, 0) for v in vs)
+
+            fn = _shard_map(per_device, self.mesh,
+                            in_specs=(P(AXIS),) * (rounds
+                                                   + rounds * nleaves),
+                            out_specs=(P(AXIS),) * (1 + nleaves))
+            self._compiled[key] = jax.jit(fn)
+        args = list(cnt_rounds)
+        for r in range(rounds):
+            args.extend(recv_rounds[r])
+        outs = self._compiled[key](*args)
+        import jax.tree_util as jtu
+        sample = jtu.tree_unflatten(
+            plan.out_treedef, list(range(len(plan.out_specs))))
+        assert isinstance(sample, tuple), sample
+        treedef = jtu.tree_structure((0,) + sample)
+        return layout.Batch(treedef, list(outs[1:]), outs[0])
 
     @staticmethod
     def _write_run(path, rows):

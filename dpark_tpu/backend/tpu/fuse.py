@@ -516,14 +516,12 @@ def analyze_text_stage(stage, ndev, executor_or_store):
 
     if dep.partitioner.num_partitions > ndev:
         # more logical partitions than devices: only the spilled-run
-        # stream supports this — list aggregators (group/partitionBy)
-        # and UNTRACEABLE merges (combiner applied host-side at export)
-        # both ride it; traceable merges pre-reduce per device and need
-        # r <= ndev.  Small inputs go to the object path here.
+        # stream supports this (the rid rides the exchange, runs land
+        # per logical partition) — list aggregators, untraceable
+        # merges (combiner folded host-side at export), and TRACEABLE
+        # merges (waves pre-reduce per (rid, key) on device before
+        # spilling) all ride it.  Small inputs go to the object path.
         if not _big_text(stage):
-            return None
-        if not is_list_agg(dep.aggregator) \
-                and merge_traceable(dep.aggregator, cur_specs[1:]):
             return None
         logical_spill = True
 
@@ -563,20 +561,6 @@ def _leaves_merge_fn(merge, nleaves):
     def merged(va_leaves, vb_leaves):
         return list(vfn(*(list(va_leaves) + list(vb_leaves))))
     return merged
-
-
-def merge_traceable(aggregator, val_specs):
-    """True when merge_combiners traces over the given value leaf
-    specs — the gate between the device-combining stream and the
-    spilled-run stream with the combiner applied at export."""
-    try:
-        merge_fn = _leaves_merge_fn(aggregator.merge_combiners,
-                                    len(val_specs))
-        vstructs = _batched_spec_struct(val_specs)
-        jax.eval_shape(lambda *v: merge_fn(list(v), list(v)), *vstructs)
-        return True
-    except Exception:
-        return False
 
 
 def _big_columnar(pc):
@@ -757,16 +741,13 @@ def analyze_stage(stage, ndev, executor_or_store):
         if dep.partitioner.num_partitions > ndev:
             # more logical partitions than devices: only the spilled
             # no-combine stream supports this (rid rides the exchange,
-            # runs land per logical partition) — list aggregators and
-            # UNTRACEABLE merges (combiner applied host-side at export)
-            # both ride it; traceable merges pre-reduce per device and
-            # need r <= ndev.  Small inputs go to the object path HERE,
-            # not via an executor error.
+            # runs land per logical partition) — list aggregators,
+            # untraceable merges (combiner folded host-side at export),
+            # and TRACEABLE merges (waves pre-reduce per (rid, key) on
+            # device before spilling) all ride it.  Small inputs go to
+            # the object path HERE, not via an executor error.
             if not (source[0] == "ingest"
                     and _big_columnar(source[1])):
-                return None
-            if not is_list_agg(dep.aggregator) \
-                    and merge_traceable(dep.aggregator, cur_specs[1:]):
                 return None
             logical_spill = True
         epilogue = ("shuffle_write", dep)

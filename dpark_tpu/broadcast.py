@@ -104,8 +104,10 @@ class Broadcast:
         holder when one exists (origin only as first/fallback source),
         and if this process serves a bucket server it registers itself
         as a holder chunk-by-chunk as the bytes land — fan-out grows
-        while the fetch is still running.  Chunks are grouped by chosen
-        holder so each peer is one connection (fetch_many).  Without a
+        while the fetch is still running.  Each chunk re-plans its
+        holder from the live registry and rides a pooled connection to
+        that peer (connections are reused per peer, requests stay
+        per-chunk so late-arriving holders spread load).  Without a
         tracker: everything from the origin over one connection.
 
         Fetched chunks are also re-written into the LOCAL workdir so

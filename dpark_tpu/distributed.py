@@ -49,24 +49,46 @@ def _file_rendezvous(path, process_id, timeout=120):
             f.write(addr)
         os.replace(tmp, path)
         return addr
-    # freshness guard: a rank can start before rank 0 has replaced a
-    # LEFTOVER file from a previous run, and joining a dead (or still
-    # running) old coordinator hangs until jax's timeout.  Accept only
-    # files written within a slack window of this rank's own start —
-    # launcher-coordinated ranks start together, so the fresh publish
-    # always qualifies while a file from a run minutes ago never does.
-    started = time.time()
-    slack = 120.0
-    deadline = started + timeout
+    # leftover guard, clock-free: a rank can start before rank 0 has
+    # replaced a LEFTOVER file from a previous run, and joining a dead
+    # old coordinator hangs until jax's timeout.  A file that already
+    # existed when this rank began polling is suspect; accept it once
+    # (a) its identity (inode/mtime/size) changes — rank 0 of THIS run
+    # re-published — or (b) the address accepts a TCP connection (the
+    # coordinator is alive; rank 0 publishes before jax binds, so (b)
+    # turns true once initialize() listens).  No wall-clock window: a
+    # rank that starts minutes late (image pull, scheduler delay) or a
+    # shared FS with a skewed clock must still join.  A still-running
+    # coordinator from an OLD run passes (b) — use a fresh path per
+    # run to exclude that, as mrun does.
+    def _ident():
+        st = os.stat(path)
+        return (st.st_ino, st.st_mtime_ns, st.st_size)
+
+    def _alive(addr):
+        import socket
+        host, _, port = addr.rpartition(":")
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=1.0):
+                return True
+        except (OSError, ValueError):
+            return False
+
+    try:
+        suspect = _ident()
+    except OSError:
+        suspect = None
+    deadline = time.time() + timeout
     while time.time() < deadline:
         try:
-            if os.path.getmtime(path) >= started - slack:
-                with open(path) as f:
-                    addr = f.read().strip()
-                if addr:
-                    return addr
+            fresh = suspect is None or _ident() != suspect
+            with open(path) as f:
+                addr = f.read().strip()
         except OSError:
-            pass
+            addr = ""
+        if addr and (fresh or _alive(addr)):
+            return addr
         time.sleep(0.05)
     raise TimeoutError("no coordinator address at %s after %ds"
                        % (path, timeout))

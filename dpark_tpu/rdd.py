@@ -1402,21 +1402,28 @@ class GZipFileRDD(RDD):
     def _magic(self):
         return b"\x1f\x8b", _gzip_magic, _gzip_valid
 
-    def _make_splits(self):
+    def _stream_splits(self, p, base_index):
+        """Group one file's validated stream/member starts into
+        ~splitSize byte-aligned splits (shared with the bz2 stream
+        fallback)."""
         from dpark_tpu import file_manager
         prefix, magic, valid = self._magic()
+        size = file_manager.file_size(p)
+        offs = _scan_magic_offsets(p, prefix, magic, valid) + [size]
+        out = []
+        begin = offs[0]
+        for i in range(1, len(offs)):
+            if offs[i] - begin >= self.split_size or offs[i] == size:
+                if offs[i] > begin:
+                    out.append(TextSplit(base_index + len(out), p,
+                                         begin, offs[i]))
+                begin = offs[i]
+        return out
+
+    def _make_splits(self):
         splits = []
         for p in self.paths:
-            size = file_manager.file_size(p)
-            offs = _scan_magic_offsets(p, prefix, magic, valid) + [size]
-            begin = offs[0]
-            for i in range(1, len(offs)):
-                if offs[i] - begin >= self.split_size \
-                        or offs[i] == size:
-                    if offs[i] > begin:
-                        splits.append(TextSplit(len(splits), p,
-                                                begin, offs[i]))
-                    begin = offs[i]
+            splits.extend(self._stream_splits(p, len(splits)))
         return splits
 
     def _open(self, raw):
@@ -1439,7 +1446,12 @@ class GZipFileRDD(RDD):
 # stored in the 32 bits right after a block magic)
 _BZ2_BLOCK_MAGIC = 0x314159265359
 _BZ2_EOS_MAGIC = 0x177245385090
-_BZ2_TABLE_CACHE = {}        # (path, size) -> per-stream block table
+# (path, size) -> per-stream block table; bounded FIFO — a long-lived
+# driver reading many bz2 files must not accumulate a few MB of block
+# triples per file forever, and a rewritten path (new size) supersedes
+# its old entry at insert time
+_BZ2_TABLE_CACHE = {}
+_BZ2_TABLE_CACHE_MAX = 64
 
 
 def _bz2_scan_bit_magics(path):
@@ -1522,14 +1534,27 @@ def _bz2_block_bytes(path, level, bit_start, bit_end, crcs):
 
 class Bz2BlockSplit:
     """`n` consecutive blocks starting at block `first` of stream
-    `stream` in `path` (indices into the RDD's per-path block table)."""
+    `stream` in `path`.  Carries its own metadata — `level` and the
+    (bit_start, bit_end, crc) triples for its blocks plus a small
+    lookahead for the line-extension walk — so workers decompress
+    without rebuilding the whole-file block table (the bit scan runs
+    once, on the driver); `more` flags blocks past the lookahead, in
+    which case only the pathological line-spans-many-blocks case
+    rescans."""
 
-    def __init__(self, index, path, stream, first, n):
+    LOOKAHEAD = 8
+
+    def __init__(self, index, path, stream, first, n, level, blocks,
+                 look, more):
         self.index = index
         self.path = path
         self.stream = stream
         self.first = first
         self.n = n
+        self.level = level
+        self.blocks = blocks
+        self.look = look
+        self.more = more
 
 
 class BZip2FileRDD(GZipFileRDD):
@@ -1557,9 +1582,10 @@ class BZip2FileRDD(GZipFileRDD):
 
         Cached at MODULE level keyed by file identity, NOT on the RDD:
         the RDD pickles into every task, and a big file's table (one
-        entry per ~100KB block) must not ride each task's bytes.  A
-        worker process rebuilds it once per file with one deterministic
-        scan pass."""
+        entry per ~100KB block) must not ride each task's bytes.  Runs
+        on the driver at split time; each split ships only its own
+        slice (+lookahead), so workers reach here only for the
+        line-spans-past-lookahead fallback (deterministic rescan)."""
         from dpark_tpu import file_manager
         try:
             key = (path, file_manager.file_size(path))
@@ -1597,6 +1623,11 @@ class BZip2FileRDD(GZipFileRDD):
         except Exception as e:
             logger.debug("bz2 block scan fallback for %s: %s", path, e)
             table = None
+        stale = [k for k in _BZ2_TABLE_CACHE if k[0] == path]
+        while stale or len(_BZ2_TABLE_CACHE) >= _BZ2_TABLE_CACHE_MAX:
+            victim = stale.pop() if stale \
+                else next(iter(_BZ2_TABLE_CACHE))
+            _BZ2_TABLE_CACHE.pop(victim, None)
         _BZ2_TABLE_CACHE[key] = table
         return table
 
@@ -1611,37 +1642,34 @@ class BZip2FileRDD(GZipFileRDD):
             for si, (level, blocks) in enumerate(table):
                 first = 0
                 acc = 0
+                K = Bz2BlockSplit.LOOKAHEAD
                 for bi, (b0, b1, _) in enumerate(blocks):
                     acc += (b1 - b0) // 8
                     if acc >= self.split_size or bi == len(blocks) - 1:
+                        end = bi + 1
                         splits.append(Bz2BlockSplit(
-                            len(splits), p, si, first, bi + 1 - first))
-                        first, acc = bi + 1, 0
+                            len(splits), p, si, first, end - first,
+                            level, blocks[first:end],
+                            blocks[end:end + K],
+                            len(blocks) > end + K))
+                        first, acc = end, 0
         return splits
 
-    def _stream_splits(self, p, base_index):
-        """Byte-aligned stream-start splitting (the pre-block-scan
-        behavior), used when the bit scan can't be trusted."""
-        from dpark_tpu import file_manager
-        size = file_manager.file_size(p)
-        prefix, magic, valid = self._magic()
-        offs = _scan_magic_offsets(p, prefix, magic, valid) + [size]
-        out = []
-        begin = offs[0]
-        for i in range(1, len(offs)):
-            if offs[i] - begin >= self.split_size or offs[i] == size:
-                if offs[i] > begin:
-                    out.append(TextSplit(base_index + len(out), p,
-                                         begin, offs[i]))
-                begin = offs[i]
-        return out
+    def _tail_blocks(self, split):
+        """Block metadata after `split`, for the line-extension walk:
+        the shipped lookahead, then (pathological long-line case only)
+        the rest of the stream from the full table."""
+        yield from split.look
+        if split.more:
+            blocks = self._block_table(split.path)[split.stream][1]
+            skip = split.first + split.n + len(split.look)
+            yield from blocks[skip:]
 
     def compute(self, split):
         if not isinstance(split, Bz2BlockSplit):
             yield from super().compute(split)      # stream fallback
             return
-        level, blocks = self._block_table(split.path)[split.stream]
-        sel = blocks[split.first:split.first + split.n]
+        level, sel = split.level, split.blocks
         data = _bz2.decompress(_bz2_block_bytes(
             split.path, level, sel[0][0], sel[-1][1],
             [c for _, _, c in sel]))
@@ -1659,9 +1687,7 @@ class BZip2FileRDD(GZipFileRDD):
             else:
                 data = data[nl + 1:]
         if extend:
-            j = split.first + split.n
-            while j < len(blocks):
-                b0, b1, crc = blocks[j]
+            for b0, b1, crc in self._tail_blocks(split):
                 nxt = _bz2.decompress(_bz2_block_bytes(
                     split.path, level, b0, b1, [crc]))
                 nl = nxt.find(b"\n")
@@ -1669,7 +1695,6 @@ class BZip2FileRDD(GZipFileRDD):
                     data += nxt[:nl + 1]
                     break
                 data += nxt
-                j += 1
         if data:
             body = data[:-1] if data.endswith(b"\n") else data
             for line in body.split(b"\n"):

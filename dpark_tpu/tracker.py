@@ -173,10 +173,13 @@ class TrackerServer:
                     while True:
                         try:
                             body = _recv_msg(self.request)
-                        except (ValueError, PermissionError,
-                                json.JSONDecodeError):
+                        except (ValueError, TypeError,
+                                PermissionError, json.JSONDecodeError):
                             return     # malformed/unauthenticated frame
-                        reply, stop = outer._handle(body)
+                        try:
+                            reply, stop = outer._handle(body)
+                        except (TypeError, KeyError, IndexError):
+                            return     # well-formed JSON, wrong shape
                         _send_msg(self.request, reply)
                         if stop:
                             outer._server.shutdown()

@@ -217,6 +217,51 @@ def test_three_rank_p2p_broadcast(tmp_path):
         srv.stop()
 
 
+def test_rendezvous_rejects_stale_accepts_fresh(tmp_path):
+    """A dead LEFTOVER coordinator file is never joined; rank 0's fresh
+    publish (identity change) is."""
+    import threading
+    import time
+    from dpark_tpu.distributed import _file_rendezvous
+    path = str(tmp_path / "coord")
+    with open(path, "w") as f:
+        f.write("127.0.0.1:1")                  # dead leftover
+    os.utime(path, (time.time() - 3600,) * 2)
+    got = {}
+
+    def rank1():
+        got["addr"] = _file_rendezvous(path, 1, timeout=30)
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    time.sleep(0.5)                  # rank 1 snapshots the leftover
+    addr0 = _file_rendezvous(path, 0)
+    t.join(30)
+    assert got["addr"] == addr0 != "127.0.0.1:1"
+
+
+def test_rendezvous_accepts_old_but_alive_address(tmp_path):
+    """A rank that starts long after rank 0 published (old mtime, no
+    identity change) must still join once the coordinator is LIVE —
+    the round-3 review found the old wall-clock freshness window
+    rejected exactly this."""
+    import socket
+    import time
+    from dpark_tpu.distributed import _file_rendezvous
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    addr = "127.0.0.1:%d" % srv.getsockname()[1]
+    path = str(tmp_path / "coord")
+    with open(path, "w") as f:
+        f.write(addr)
+    os.utime(path, (time.time() - 3600,) * 2)   # published "long ago"
+    try:
+        assert _file_rendezvous(path, 3, timeout=30) == addr
+    finally:
+        srv.close()
+
+
 def test_two_rank_exchange_over_tcp(tmp_path):
     """Two ranks, separate workdirs: distributed.py bootstrap, shuffle
     buckets exchanged over the TCP data plane, multi-chunk broadcast
