@@ -243,31 +243,66 @@ def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves,
     if dst is None:
         dst = hash_dst(key, n_dst, valid, r)
     k = jnp.where(valid, key, _sentinel(key.dtype))
-    # one lexicographic (dst, key) sort carrying all value leaves
-    sorted_ops = _lex_sort((dst, k) + tuple(val_leaves), 2)
-    d, k = sorted_ops[0], sorted_ops[1]
-    vs = list(sorted_ops[2:])
+    ks, vv, counts, offsets = _bucketize_combine_cols(
+        dst, [k], val_leaves, n_dst, merge_leaves, monoid)
+    return ks[0], vv, counts, offsets
 
-    same = (k[1:] == k[:-1]) & (d[1:] == d[:-1])
-    starts = jnp.concatenate([jnp.ones((1,), bool), ~same])
+
+def _changed_adjacent(cols):
+    """(m-1,) bool: any of the key columns differs from its neighbor."""
+    changed = cols[0][1:] != cols[0][:-1]
+    for c in cols[1:]:
+        changed = changed | (c[1:] != c[:-1])
+    return changed
+
+
+def _segment_merge(key_cols, val_leaves, keep_valid, merge_leaves,
+                   monoid):
+    """Shared segment-combine core over rows sorted by `key_cols`:
+    merge values of adjacent rows equal in ALL key columns, keep one
+    row per segment (keep_valid(row_flags) restricts which), compact
+    kept rows to the front (stable).
+
+    Returns (packed_key_cols, packed_val_leaves, keep_mask) — the keep
+    mask is returned so callers derive counts their own way."""
+    changed = _changed_adjacent(key_cols)
+    starts = jnp.concatenate([jnp.ones((1,), bool), changed])
+    vs = list(val_leaves)
     if monoid is not None:
         seg, totals = _monoid_segment_totals(starts, vs, monoid)
-        keep = starts & (d < n_dst)
+        keep = keep_valid(starts)
         reduced = [t[seg] for t in totals]
     else:
         scanned = segmented_combine(starts, vs, merge_leaves)
-        is_last = jnp.concatenate([~same, jnp.ones((1,), bool)])
-        keep = is_last & (d < n_dst)
+        is_last = jnp.concatenate([changed, jnp.ones((1,), bool)])
+        keep = keep_valid(is_last)
         reduced = scanned
-    kk_full = jnp.where(keep, k, _sentinel(k.dtype))
+    return keep, reduced
+
+
+def _bucketize_combine_cols(dst, key_cols, val_leaves, n_dst,
+                            merge_leaves, monoid):
+    """Sort by (dst, *key_cols) carrying values, merge rows equal in
+    every key column, compact; dst and key_cols must already carry the
+    sentinel / sentinel-bucket on invalid rows.  Returns
+    (key_cols', vals', counts[n_dst], offsets[n_dst])."""
+    nk = len(key_cols)
+    sorted_ops = _lex_sort((dst,) + tuple(key_cols) + tuple(val_leaves),
+                           1 + nk)
+    d = sorted_ops[0]
+    ks = list(sorted_ops[1:1 + nk])
+    keep, reduced = _segment_merge(
+        [d] + ks, sorted_ops[1 + nk:],
+        lambda flags: flags & (d < n_dst), merge_leaves, monoid)
     dd_full = jnp.where(keep, d, n_dst)
-    packed = _lex_sort((~keep, dd_full, kk_full) + tuple(reduced), 1)
-    dd, kk = packed[1], packed[2]
-    vv = list(packed[3:])
+    k_fulls = [jnp.where(keep, k, _sentinel(k.dtype)) for k in ks]
+    packed = _lex_sort((~keep, dd_full) + tuple(k_fulls)
+                       + tuple(reduced), 1)
+    dd = packed[1]
     counts = jnp.bincount(dd, length=n_dst + 1)[:n_dst].astype(jnp.int32)
     offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
-    return kk, vv, counts, offsets
+    return list(packed[2:2 + nk]), list(packed[2 + nk:]), counts, offsets
 
 
 def bucketize_combine_rid(rid, key, val_leaves, n, n_dst, merge_leaves,
@@ -282,35 +317,33 @@ def bucketize_combine_rid(rid, key, val_leaves, n, n_dst, merge_leaves,
     cap = key.shape[0]
     valid = jnp.arange(cap) < n
     dev = jnp.where(valid, (rid % n_dst).astype(jnp.int32), n_dst)
-    k = jnp.where(valid, key, _sentinel(key.dtype))
     rd = jnp.where(valid, rid, _sentinel(rid.dtype))
-    sorted_ops = _lex_sort((dev, rd, k) + tuple(val_leaves), 3)
-    d, rd, k = sorted_ops[0], sorted_ops[1], sorted_ops[2]
-    vs = list(sorted_ops[3:])
+    k = jnp.where(valid, key, _sentinel(key.dtype))
+    ks, vv, counts, offsets = _bucketize_combine_cols(
+        dev, [rd, k], val_leaves, n_dst, merge_leaves, monoid)
+    return ks + vv, counts, offsets
 
-    # rid equal implies dev equal, so (rid, key) defines the segment
-    same = (rd[1:] == rd[:-1]) & (k[1:] == k[:-1])
-    starts = jnp.concatenate([jnp.ones((1,), bool), ~same])
-    if monoid is not None:
-        seg, totals = _monoid_segment_totals(starts, vs, monoid)
-        keep = starts & (d < n_dst)
-        reduced = [t[seg] for t in totals]
-    else:
-        scanned = segmented_combine(starts, vs, merge_leaves)
-        is_last = jnp.concatenate([~same, jnp.ones((1,), bool)])
-        keep = is_last & (d < n_dst)
-        reduced = scanned
-    dd_full = jnp.where(keep, d, n_dst)
-    rd_full = jnp.where(keep, rd, _sentinel(rd.dtype))
-    kk_full = jnp.where(keep, k, _sentinel(k.dtype))
-    packed = _lex_sort((~keep, dd_full, rd_full, kk_full)
-                       + tuple(reduced), 1)
-    dd = packed[1]
-    out_leaves = [packed[2], packed[3]] + list(packed[4:])
-    counts = jnp.bincount(dd, length=n_dst + 1)[:n_dst].astype(jnp.int32)
-    offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
-    return out_leaves, counts, offsets
+
+def _segment_reduce_cols(key_cols, val_leaves, valid_mask, merge_leaves,
+                         monoid):
+    """segment_reduce over a composite key (rows equal in ALL columns
+    merge); key_cols[0] carries the sentinel on invalid rows.  Returns
+    (packed_key_cols, reduced_vals, n_unique), uniques at the front
+    sorted by the key columns."""
+    m = key_cols[0].shape[0]
+    nk = len(key_cols)
+    sorted_ops = _lex_sort(tuple(key_cols) + tuple(val_leaves), nk)
+    ks = list(sorted_ops[:nk])
+    nvalid = jnp.sum(valid_mask).astype(jnp.int32)
+    keep, reduced = _segment_merge(
+        ks, sorted_ops[nk:],
+        lambda flags: (flags & (jnp.arange(m) < nvalid)
+                       & (ks[0] != _sentinel(ks[0].dtype))),
+        merge_leaves, monoid)
+    k_fulls = [jnp.where(keep, k, _sentinel(k.dtype)) for k in ks]
+    packed = _lex_sort((~keep,) + tuple(k_fulls) + tuple(reduced), 1)
+    return (list(packed[1:1 + nk]), list(packed[1 + nk:]),
+            jnp.sum(keep).astype(jnp.int32))
 
 
 def segment_reduce2(rid, key, val_leaves, valid_mask, merge_leaves,
@@ -323,30 +356,9 @@ def segment_reduce2(rid, key, val_leaves, valid_mask, merge_leaves,
 
     Returns (rid', key', reduced_val_leaves, n_unique) with uniques
     packed to the front, sorted by (rid, key)."""
-    m = key.shape[0]
-    sorted_ops = _lex_sort((rid, key) + tuple(val_leaves), 2)
-    rd, k = sorted_ops[0], sorted_ops[1]
-    vs = list(sorted_ops[2:])
-    nvalid = jnp.sum(valid_mask).astype(jnp.int32)
-
-    changed = (rd[1:] != rd[:-1]) | (k[1:] != k[:-1])
-    starts = jnp.concatenate([jnp.ones((1,), bool), changed])
-    if monoid is not None:
-        seg, totals = _monoid_segment_totals(starts, vs, monoid)
-        keep = (starts & (jnp.arange(m) < nvalid)
-                & (rd != _sentinel(rd.dtype)))
-        reduced = [t[seg] for t in totals]
-    else:
-        scanned = segmented_combine(starts, vs, merge_leaves)
-        is_last = jnp.concatenate([changed, jnp.ones((1,), bool)])
-        keep = (is_last & (jnp.arange(m) < nvalid)
-                & (rd != _sentinel(rd.dtype)))
-        reduced = scanned
-    rd_full = jnp.where(keep, rd, _sentinel(rd.dtype))
-    k_full = jnp.where(keep, k, _sentinel(k.dtype))
-    packed = _lex_sort((~keep, rd_full, k_full) + tuple(reduced), 1)
-    return (packed[1], packed[2], list(packed[3:]),
-            jnp.sum(keep).astype(jnp.int32))
+    ks, vv, n = _segment_reduce_cols([rid, key], val_leaves, valid_mask,
+                                     merge_leaves, monoid)
+    return ks[0], ks[1], vv, n
 
 
 def segment_reduce(key, val_leaves, valid_mask, merge_leaves,
@@ -361,28 +373,6 @@ def segment_reduce(key, val_leaves, valid_mask, merge_leaves,
     Returns (unique_keys, reduced_val_leaves, n_unique) with uniques packed
     to the front (sorted ascending by key).
     """
-    m = key.shape[0]
-    sorted_ops = _lex_sort((key,) + tuple(val_leaves), 1)
-    k = sorted_ops[0]
-    vs = list(sorted_ops[1:])
-    nvalid = jnp.sum(valid_mask).astype(jnp.int32)
-
-    starts = jnp.concatenate(
-        [jnp.ones((1,), bool), k[1:] != k[:-1]])
-    if monoid is not None:
-        seg, totals = _monoid_segment_totals(starts, vs, monoid)
-        keep = (starts & (jnp.arange(m) < nvalid)
-                & (k != _sentinel(k.dtype)))
-        reduced = [t[seg] for t in totals]
-    else:
-        scanned = segmented_combine(starts, vs, merge_leaves)
-        is_last = jnp.concatenate(
-            [k[1:] != k[:-1], jnp.ones((1,), bool)])
-        keep = (is_last & (jnp.arange(m) < nvalid)
-                & (k != _sentinel(k.dtype)))
-        reduced = scanned
-    uk_full = jnp.where(keep, k, _sentinel(k.dtype))
-    packed = _lex_sort((~keep, uk_full) + tuple(reduced), 1)
-    uk = packed[1]
-    uv = list(packed[2:])
-    return uk, uv, jnp.sum(keep).astype(jnp.int32)
+    ks, vv, n = _segment_reduce_cols([key], val_leaves, valid_mask,
+                                     merge_leaves, monoid)
+    return ks[0], vv, n

@@ -12,6 +12,8 @@ downstream host-path stages (untraceable user code) can consume them
 through the ordinary ShuffleFetcher protocol.
 """
 
+import os
+
 import numpy as np
 
 import jax
@@ -104,6 +106,26 @@ class JAXExecutor:
         # (parity contract with the local master)
         jax.config.update("jax_enable_x64", True)
         self.mesh = layout.make_mesh(devices)
+        # persistent XLA compilation cache: stream programs compile per
+        # (size class, slot) and a real-chip compile runs 30-150s
+        # (BENCH_REAL_r03.md) — pay each once per program EVER, not
+        # once per process.  Device backends only: XLA:CPU AOT entries
+        # are machine-feature-sensitive (observed "could lead to
+        # SIGILL" loads), and CPU compiles are cheap anyway.
+        # DPARK_COMPILE_CACHE overrides the location; "0" disables.
+        platform = self.mesh.devices.flat[0].platform
+        cache_dir = os.environ.get(
+            "DPARK_COMPILE_CACHE",
+            os.path.expanduser("~/.cache/dpark_tpu/xla-%s" % platform))
+        if cache_dir and cache_dir != "0" and platform != "cpu":
+            try:
+                os.makedirs(cache_dir, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir",
+                                  cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
+            except Exception as e:
+                logger.debug("compilation cache unavailable: %s", e)
         self.ndev = int(self.mesh.devices.size)
         self.shuffle_store = {}       # sid -> stored map output metadata
         self._store_bytes = 0
@@ -1048,15 +1070,17 @@ class JAXExecutor:
             "single_map": True,
         })
 
-    def _sort_received(self, plan, recv, nkeys=1):
-        """Flatten exchange rounds and sort per device by the first
-        `nkeys` leaves -> Batch (extra leading leaves beyond
-        plan.out_specs, e.g. the rid column, ride along)."""
+    def _run_recv_program(self, plan, recv, tag, extra_key, body):
+        """Shared scaffolding for compiled programs consuming the
+        exchange output (_sort_received / _prereduce_received): slice
+        per-round receive buffers per device, run body(recvs, cnts) ->
+        (count, leaves...), cache the jitted program per
+        (tag, program_key, rounds, slot, nleaves, *extra_key)."""
         recv_rounds, cnt_rounds, slot = recv
         rounds = len(recv_rounds)
         nleaves = len(recv_rounds[0])
-        key = ("wave_sort", plan.program_key, rounds, slot, nleaves,
-               nkeys)
+        key = (tag, plan.program_key, rounds, slot,
+               nleaves) + tuple(extra_key)
         if key not in self._compiled:
             def per_device(*args):
                 cnts = [c[0] for c in args[:rounds]]
@@ -1065,12 +1089,8 @@ class JAXExecutor:
                 for r in range(rounds):
                     recvs.append([bufs[r * nleaves + li][0]
                                   for li in range(nleaves)])
-                flat, mask = collectives.flatten_received(recvs, cnts)
-                packed = collectives._lex_sort(tuple(flat), nkeys)
-                n = jnp.sum(mask).astype(jnp.int32)
-                out = (jnp.expand_dims(n, 0),) + tuple(
-                    jnp.expand_dims(l, 0) for l in packed)
-                return out
+                outs = body(recvs, cnts)
+                return tuple(jnp.expand_dims(o, 0) for o in outs)
 
             fn = _shard_map(per_device, self.mesh,
                             in_specs=(P(AXIS),) * (rounds
@@ -1080,18 +1100,35 @@ class JAXExecutor:
         args = list(cnt_rounds)
         for r in range(rounds):
             args.extend(recv_rounds[r])
-        outs = self._compiled[key](*args)
+        return self._compiled[key](*args)
+
+    def _rid_prefixed_treedef(self, plan):
+        """plan.out_treedef with the rid column prepended FLAT: egested
+        rows read (rid, k, v...) so callers can strip row[0]."""
+        import jax.tree_util as jtu
+        sample = jtu.tree_unflatten(
+            plan.out_treedef, list(range(len(plan.out_specs))))
+        assert isinstance(sample, tuple), sample
+        return jtu.tree_structure((0,) + sample)
+
+    def _sort_received(self, plan, recv, nkeys=1):
+        """Flatten exchange rounds and sort per device by the first
+        `nkeys` leaves -> Batch (extra leading leaves beyond
+        plan.out_specs, e.g. the rid column, ride along)."""
+        def body(recvs, cnts):
+            flat, mask = collectives.flatten_received(recvs, cnts)
+            packed = collectives._lex_sort(tuple(flat), nkeys)
+            n = jnp.sum(mask).astype(jnp.int32)
+            return (n,) + tuple(packed)
+
+        outs = self._run_recv_program(plan, recv, "wave_sort",
+                                      (nkeys,), body)
         leaves = list(outs[1:])
         extra = len(leaves) - len(plan.out_specs)
         treedef = plan.out_treedef
         if extra:
-            # prepend the rid column FLAT: egested rows read
-            # (rid, k, v...) so callers can strip row[0]
-            import jax.tree_util as jtu
-            sample = jtu.tree_unflatten(
-                treedef, list(range(len(plan.out_specs))))
-            assert extra == 1 and isinstance(sample, tuple), sample
-            treedef = jtu.tree_structure((0,) + sample)
+            assert extra == 1, extra
+            treedef = self._rid_prefixed_treedef(plan)
         return layout.Batch(treedef, leaves, outs[0])
 
     def _prereduce_received(self, plan, recv, merge_fn, monoid):
@@ -1100,43 +1137,17 @@ class JAXExecutor:
         traceable merges with r beyond the mesh.  Returns the same
         rid-prefixed Batch shape as _sort_received(nkeys=2), with equal
         (rid, key) rows already merged."""
-        recv_rounds, cnt_rounds, slot = recv
-        rounds = len(recv_rounds)
-        nleaves = len(recv_rounds[0])        # rid + key + value leaves
-        key = ("wave_prereduce", plan.program_key, rounds, slot,
-               nleaves)
-        if key not in self._compiled:
-            def per_device(*args):
-                cnts = [c[0] for c in args[:rounds]]
-                bufs = args[rounds:]
-                recvs = []
-                for r in range(rounds):
-                    recvs.append([bufs[r * nleaves + li][0]
-                                  for li in range(nleaves)])
-                flat, mask = collectives.flatten_received(recvs, cnts)
-                rid, k, vs, n = collectives.segment_reduce2(
-                    flat[0], flat[1], flat[2:], mask, merge_fn,
-                    monoid=monoid)
-                return (jnp.expand_dims(n, 0),
-                        jnp.expand_dims(rid, 0),
-                        jnp.expand_dims(k, 0)) + tuple(
-                    jnp.expand_dims(v, 0) for v in vs)
+        def body(recvs, cnts):
+            flat, mask = collectives.flatten_received(recvs, cnts)
+            rid, k, vs, n = collectives.segment_reduce2(
+                flat[0], flat[1], flat[2:], mask, merge_fn,
+                monoid=monoid)
+            return (n, rid, k) + tuple(vs)
 
-            fn = _shard_map(per_device, self.mesh,
-                            in_specs=(P(AXIS),) * (rounds
-                                                   + rounds * nleaves),
-                            out_specs=(P(AXIS),) * (1 + nleaves))
-            self._compiled[key] = jax.jit(fn)
-        args = list(cnt_rounds)
-        for r in range(rounds):
-            args.extend(recv_rounds[r])
-        outs = self._compiled[key](*args)
-        import jax.tree_util as jtu
-        sample = jtu.tree_unflatten(
-            plan.out_treedef, list(range(len(plan.out_specs))))
-        assert isinstance(sample, tuple), sample
-        treedef = jtu.tree_structure((0,) + sample)
-        return layout.Batch(treedef, list(outs[1:]), outs[0])
+        outs = self._run_recv_program(plan, recv, "wave_prereduce",
+                                      (), body)
+        return layout.Batch(self._rid_prefixed_treedef(plan),
+                            list(outs[1:]), outs[0])
 
     @staticmethod
     def _write_run(path, rows):
@@ -1158,6 +1169,20 @@ class JAXExecutor:
         nleaves = len(leaves)
         cap = leaves[0].shape[1]
         host_counts = np.asarray(jax.device_get(counts))
+        if self.ndev == 1:
+            # single-device mesh: the exchange is the identity — the
+            # bucketized valid prefix IS the received data.  Skip the
+            # narrowing probe (there is no wire), the collective
+            # program, and the overflow readback; each is a dispatch
+            # round-trip (66 ms through the real-chip tunnel,
+            # BENCH_REAL_r03.md) per wave for no data movement.
+            self.exchange_real_rows += int(host_counts.sum())
+            self.exchange_slot_rows += cap
+            # consumers expect per-device (R=1, slot, ...) receive
+            # buffers and (R=1,) counts — counts is already the (1, 1)
+            # per-bucket array, leaves gain the source-device axis
+            recv = [l.reshape((1, 1) + l.shape[1:]) for l in leaves]
+            return [recv], [counts], cap
         max_run = int(host_counts.max()) if host_counts.size else 1
         mean = int(host_counts.sum()) // max(1, host_counts.size)
         slot = layout.round_capacity(min(max(64, 2 * mean),

@@ -178,8 +178,14 @@ class TrackerServer:
                             return     # malformed/unauthenticated frame
                         try:
                             reply, stop = outer._handle(body)
-                        except (TypeError, KeyError, IndexError):
-                            return     # well-formed JSON, wrong shape
+                        except (TypeError, KeyError, IndexError) as e:
+                            # well-formed JSON, wrong shape — but the
+                            # same exceptions from a genuine handler
+                            # bug on internal traffic must be visible
+                            logger.warning(
+                                "tracker dropped frame %.80r: %s",
+                                body, e)
+                            return
                         _send_msg(self.request, reply)
                         if stop:
                             outer._server.shutdown()

@@ -489,3 +489,54 @@ def test_tuple_key_join_falls_back(tctx):
         .join(lctx.parallelize([((i % 3, i % 2), -i) for i in range(12)],
                                8), 8).collect())
     assert got == expect
+
+
+def test_single_device_mesh_fast_path():
+    """ndev == 1 (a real single-chip config): the exchange fast path
+    returns the bucketized prefix directly — no collective program, no
+    narrowing probe, zero wire bytes — with full parity on the in-core
+    reduce, the spilled sort stream, and the r > mesh pre-reduce
+    stream.  Runs in a subprocess: the suite's mesh is pinned to 8
+    virtual devices at import time."""
+    import os
+    import subprocess
+    import sys
+    script = r'''
+import os
+os.environ["DPARK_TPU_PLATFORM"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import numpy as np
+from dpark_tpu import DparkContext, Columns, conf
+ctx = DparkContext("tpu"); ctx.start()
+ex = ctx.scheduler.executor
+assert ex.ndev == 1, ex.ndev
+n = 60000
+i = np.arange(n, dtype=np.int64)
+got = dict(ctx.parallelize(Columns((i*7) % 1000, i % 5), 1)
+           .reduceByKey(lambda a, b: a + b, 1).collect())
+expect = {}
+for k, v in zip(((i*7) % 1000).tolist(), (i % 5).tolist()):
+    expect[k] = expect.get(k, 0) + v
+assert got == expect
+conf.STREAM_CHUNK_ROWS = 8000
+keys = np.random.RandomState(3).randint(0, 10**6, n).astype(np.int64)
+got2 = ctx.parallelize(Columns(keys, i), 1).sortByKey(numSplits=6).collect()
+assert [k for k, _ in got2] == sorted(keys.tolist())
+got3 = dict(ctx.parallelize(Columns((i*13) % 37, i % 7), 1)
+            .reduceByKey(lambda a, b: a + b, 6).collect())
+expect3 = {}
+for k, v in zip(((i*13) % 37).tolist(), (i % 7).tolist()):
+    expect3[k] = expect3.get(k, 0) + v
+assert got3 == expect3
+assert ex.exchange_wire_bytes == 0, ex.exchange_wire_bytes
+ctx.stop()
+print("OK_SINGLE_DEV")
+'''
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=280)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK_SINGLE_DEV" in out.stdout
