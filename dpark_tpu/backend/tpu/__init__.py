@@ -208,9 +208,21 @@ class TPUScheduler(DAGScheduler):
     def _run_array_stage(self, stage, tasks, plan, report):
         import time as _time
         t0 = _time.time()
+        wire0 = self.executor.exchange_wire_bytes
+        real0 = self.executor.exchange_real_rows
+        slot0 = self.executor.exchange_slot_rows
         kind, result = self.executor.run_stage(plan)
         note = {"kind": "array",
                 "run_seconds": round(_time.time() - t0, 3)}
+        wire = self.executor.exchange_wire_bytes - wire0
+        slot_rows = self.executor.exchange_slot_rows - slot0
+        if wire or slot_rows:
+            # per-stage exchange accounting (HARDWARE_CHECKLIST.md
+            # items 2-3: the tuning signals, visible in the web UI)
+            note["wire_bytes"] = wire
+            note["pad_efficiency"] = round(
+                (self.executor.exchange_real_rows - real0)
+                / max(1, slot_rows), 4)
         if kind == "shuffle":
             store = self.executor.shuffle_store.get(result)
             if store is not None:

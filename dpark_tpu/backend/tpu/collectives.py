@@ -259,12 +259,13 @@ def _changed_adjacent(cols):
 def _segment_merge(key_cols, val_leaves, keep_valid, merge_leaves,
                    monoid):
     """Shared segment-combine core over rows sorted by `key_cols`:
-    merge values of adjacent rows equal in ALL key columns, keep one
-    row per segment (keep_valid(row_flags) restricts which), compact
-    kept rows to the front (stable).
+    merge values of adjacent rows equal in ALL key columns, keeping one
+    representative row per segment (keep_valid(row_flags) restricts
+    which rows qualify).
 
-    Returns (packed_key_cols, packed_val_leaves, keep_mask) — the keep
-    mask is returned so callers derive counts their own way."""
+    Returns (keep_mask, reduced_val_leaves), both row-aligned with the
+    input order — callers compact kept rows to the front with their
+    own pack sort and derive counts from the mask."""
     changed = _changed_adjacent(key_cols)
     starts = jnp.concatenate([jnp.ones((1,), bool), changed])
     vs = list(val_leaves)

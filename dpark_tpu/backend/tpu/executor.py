@@ -133,9 +133,13 @@ class JAXExecutor:
         self._result_bytes = 0
         self._hbm_seq = 0             # global LRU clock across both tiers
         self.exchange_wire_bytes = 0  # ICI bytes moved by all_to_all
-        self.exchange_real_rows = 0   # valid rows offered for exchange
+        self._exchange_real_rows = 0  # valid rows offered for exchange
         self.exchange_slot_rows = 0   # padded slots actually moved;
         #   pad efficiency = real/slot (HARDWARE_CHECKLIST.md step 3)
+        # count arrays whose host sum is deferred (the ndev==1 fast
+        # path must not pay a blocking readback per wave just for this
+        # metric); flushed on first metric read
+        self._pending_real_counts = []
         self._compiled = {}
         # let rdd.unpersist() reach device-resident caches
         from dpark_tpu import cache as cache_mod
@@ -153,6 +157,23 @@ class JAXExecutor:
                 logger.info("jax profiler trace -> %s", conf.TRACE_DIR)
             except Exception as e:
                 logger.warning("profiler trace unavailable: %s", e)
+
+    @property
+    def exchange_real_rows(self):
+        """Valid rows offered for exchange.  Reading flushes deferred
+        per-wave count arrays (one batched readback at metric-read
+        time, e.g. the scheduler's per-stage accounting — never inside
+        the wave loop)."""
+        if self._pending_real_counts:
+            pending, self._pending_real_counts = \
+                self._pending_real_counts, []
+            for c in jax.device_get(pending):
+                self._exchange_real_rows += int(np.asarray(c).sum())
+        return self._exchange_real_rows
+
+    @exchange_real_rows.setter
+    def exchange_real_rows(self, value):
+        self._exchange_real_rows = value
 
     # ------------------------------------------------------------------
     # compilation
@@ -900,13 +921,19 @@ class JAXExecutor:
         merge_fn, monoid = self._merge_probe(plan)
         state = None                    # (leaves, counts) combined so far
         bounds = self._bounds_arg(plan)      # loop-invariant
+        cap_floor = slot_floor = 0      # sticky size classes: a smaller
+        # tail wave reuses earlier waves' compiled programs
         for c, parts in enumerate(waves):
             batch = layout.ingest(self.mesh, parts, plan.in_treedef,
-                                  plan.in_specs, key_leaf=0)
+                                  plan.in_specs, key_leaf=0,
+                                  cap_floor=cap_floor)
+            cap_floor = max(cap_floor, batch.cap)
             outs = self._run_narrow(plan, batch, bounds=bounds)
             cnts, offs = outs[0], outs[1]
             leaves = list(outs[2:])
-            recv = self._exchange_all(leaves, cnts, offs)
+            recv = self._exchange_all(leaves, cnts, offs,
+                                      slot_floor=slot_floor)
+            slot_floor = max(slot_floor, recv[2])
             state = self._merge_into_state(plan, state, recv, monoid,
                                            merge_fn)
             logger.debug("streamed wave %d", c + 1)
@@ -1013,9 +1040,13 @@ class JAXExecutor:
         pre_merge = pre_monoid = None
         if carry_rid and not fuse.is_list_agg(dep.aggregator):
             pre_merge, pre_monoid = self._merge_probe(plan)
+        cap_floor = slot_floor = 0      # sticky size classes (see
+        # _run_streamed_shuffle)
         for c, parts in enumerate(waves):
             batch = layout.ingest(self.mesh, parts, plan.in_treedef,
-                                  plan.in_specs, key_leaf=0)
+                                  plan.in_specs, key_leaf=0,
+                                  cap_floor=cap_floor)
+            cap_floor = max(cap_floor, batch.cap)
             jitted = self._compile_stream_nocombine(
                 plan, batch.cap, len(batch.cols), r)
             args = (batch.counts,) + ((bounds,) if bounds is not None
@@ -1023,7 +1054,9 @@ class JAXExecutor:
             outs = jitted(*args)
             cnts, offs = outs[0], outs[1]
             leaves = list(outs[2:])          # [rid +] row leaves
-            recv = self._exchange_all(leaves, cnts, offs)
+            recv = self._exchange_all(leaves, cnts, offs,
+                                      slot_floor=slot_floor)
+            slot_floor = max(slot_floor, recv[2])
             if pre_merge is not None:
                 sorted_batch = self._prereduce_received(
                     plan, recv, pre_merge, pre_monoid)
@@ -1163,30 +1196,34 @@ class JAXExecutor:
         with open(path, "rb") as f:
             return pickle.loads(decompress(f.read()))
 
-    def _exchange_all(self, leaves, counts, offsets):
+    def _exchange_all(self, leaves, counts, offsets, slot_floor=0):
         """Run exchange rounds for already-bucketized buffers; returns
-        (recv_rounds, cnt_rounds, slot)."""
+        (recv_rounds, cnt_rounds, slot).  `slot_floor` pins the slot
+        size class from below (stream loops pass their running max so
+        light tail waves reuse the compiled exchange/merge programs)."""
         nleaves = len(leaves)
         cap = leaves[0].shape[1]
-        host_counts = np.asarray(jax.device_get(counts))
         if self.ndev == 1:
             # single-device mesh: the exchange is the identity — the
             # bucketized valid prefix IS the received data.  Skip the
             # narrowing probe (there is no wire), the collective
-            # program, and the overflow readback; each is a dispatch
-            # round-trip (66 ms through the real-chip tunnel,
-            # BENCH_REAL_r03.md) per wave for no data movement.
-            self.exchange_real_rows += int(host_counts.sum())
+            # program, and every blocking readback (a dispatch
+            # round-trip costs 66 ms through the real-chip tunnel,
+            # BENCH_REAL_r03.md, and this runs per wave); the row
+            # metric readback is deferred to the next metric read.
+            self._pending_real_counts.append(counts)
             self.exchange_slot_rows += cap
             # consumers expect per-device (R=1, slot, ...) receive
             # buffers and (R=1,) counts — counts is already the (1, 1)
             # per-bucket array, leaves gain the source-device axis
             recv = [l.reshape((1, 1) + l.shape[1:]) for l in leaves]
             return [recv], [counts], cap
+        host_counts = np.asarray(jax.device_get(counts))
         max_run = int(host_counts.max()) if host_counts.size else 1
         mean = int(host_counts.sum()) // max(1, host_counts.size)
-        slot = layout.round_capacity(min(max(64, 2 * mean),
-                                         max(1, max_run)))
+        slot = max(layout.round_capacity(min(max(64, 2 * mean),
+                                             max(1, max_run))),
+                   min(slot_floor, layout.round_capacity(cap)))
         self.exchange_real_rows += int(host_counts.sum())
         narrow = self._narrow_plan(leaves, counts)
         exchange = self._compile_exchange(

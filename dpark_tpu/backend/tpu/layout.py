@@ -78,17 +78,22 @@ def record_spec(sample):
     return treedef, specs
 
 
-def ingest(mesh, partitions, treedef, specs, key_leaf=None):
+def ingest(mesh, partitions, treedef, specs, key_leaf=None,
+           cap_floor=0):
     """Host rows -> sharded Batch.
 
     `partitions`: list (len == mesh size) of lists of records.  Each record
     must match `treedef`/`specs`.  When `key_leaf` is given, that leaf is
     checked against KEY_SENTINEL (raises ValueError -> host fallback).
+    `cap_floor` pins the capacity class from below — stream loops pass
+    their running max so a smaller tail wave reuses the compiled
+    programs of earlier waves instead of compiling a new size class.
     """
     ndev = mesh.devices.size
     assert len(partitions) == ndev, (len(partitions), ndev)
     counts = np.array([len(p) for p in partitions], dtype=np.int32)
-    cap = round_capacity(int(counts.max()) if len(counts) else 1)
+    cap = max(round_capacity(int(counts.max()) if len(counts) else 1),
+              cap_floor)
     cols = []
     for li, (dt, shape) in enumerate(specs):
         col = np.zeros((ndev, cap) + shape, dtype=dt)

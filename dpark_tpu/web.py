@@ -29,7 +29,7 @@ _PAGE = """<!doctype html>
 <h2>stages</h2>
 <table id="s"><tr><th>job</th><th>stage</th><th>dag</th><th>rdd</th>
 <th>parts</th><th>kind</th><th>seconds</th><th>device run s</th>
-<th>HBM bytes</th></tr></table>
+<th>HBM bytes</th><th>wire bytes</th><th>pad eff</th></tr></table>
 <script>
 async function tick() {
   const r = await fetch('/api/jobs'); const jobs = await r.json();
@@ -48,7 +48,8 @@ async function tick() {
       const dag = (st.parents && st.parents.length)
         ? st.parents.join(',') + ' → ' + st.id : String(st.id);
       for (const v of [j.id, st.id, dag, st.rdd, st.parts, st.kind,
-                       st.seconds, st.run_seconds, st.hbm_bytes])
+                       st.seconds, st.run_seconds, st.hbm_bytes,
+                       st.wire_bytes, st.pad_efficiency])
         sr.insertCell().textContent = v === undefined ? '' : v;
       sr.className = st.seconds === null ? 'run' : 'done';
     }
