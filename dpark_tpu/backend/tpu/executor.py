@@ -222,11 +222,18 @@ class JAXExecutor:
             k2, v2 = sorted_lv[0], sorted_lv[1:]
         return (cnts, offs, k2) + tuple(v2)
 
-    def _compile_narrow(self, plan, cap, nleaves_in):
+    def _widen_entry(self, plan, lv):
+        """Cast program inputs up to the spec dtypes: ingest may ship
+        int64 leaves over the host->device wire as i32 (layout.ingest's
+        fit scan); compute always runs at spec width."""
+        return [v if v.dtype == dt else v.astype(dt)
+                for v, (dt, _) in zip(lv, plan.in_specs)]
+
+    def _compile_narrow(self, plan, cap, nleaves_in, in_dtypes=()):
         """Program A: (counts, [bounds,] in_leaves) -> ops -> result or
         bucketized shuffle output.  Shapes (ndev, cap, ...), dim 0
         sharded."""
-        key = ("narrow", plan.program_key, cap, nleaves_in)
+        key = ("narrow", plan.program_key, cap, nleaves_in, in_dtypes)
         if key in self._compiled:
             return self._compiled[key]
         ops = plan.ops
@@ -241,7 +248,7 @@ class JAXExecutor:
             n = counts[0]
             bounds = rest[0][0] if has_bounds else None
             leaves = rest[1:] if has_bounds else rest
-            lv = [l[0] for l in leaves]          # squeeze mesh dim
+            lv = self._widen_entry(plan, [l[0] for l in leaves])
             for op in ops:
                 lv, n = op.apply(lv, n)
             if epilogue is None:
@@ -468,7 +475,9 @@ class JAXExecutor:
 
     def _run_narrow(self, plan, batch, bounds=None):
         """Compile + invoke the narrow stage program on one batch."""
-        jitted = self._compile_narrow(plan, batch.cap, len(batch.cols))
+        jitted = self._compile_narrow(
+            plan, batch.cap, len(batch.cols),
+            tuple(str(c.dtype) for c in batch.cols))
         if bounds is None:
             bounds = self._bounds_arg(plan)
         args = (batch.counts,) + ((bounds,) if bounds is not None
@@ -946,12 +955,13 @@ class JAXExecutor:
             "single_map": plan.source[0] == "text",
         })
 
-    def _compile_stream_nocombine(self, plan, cap, nleaves_in, r):
+    def _compile_stream_nocombine(self, plan, cap, nleaves_in, r,
+                                  in_dtypes=()):
         """Map-side program for the spilled-run stream: narrow ops, then
         LOGICAL partition assignment (rid in [0, r), r may exceed the
         mesh), then bucketize by rid % ndev with rid riding along as an
         extra column."""
-        key = ("snc", plan.program_key, cap, nleaves_in, r)
+        key = ("snc", plan.program_key, cap, nleaves_in, r, in_dtypes)
         if key in self._compiled:
             return self._compiled[key]
         ops = plan.ops
@@ -974,7 +984,7 @@ class JAXExecutor:
             n = counts[0]
             bounds = rest[0][0] if has_bounds else None
             leaves = rest[1:] if has_bounds else rest
-            lv = [l[0] for l in leaves]
+            lv = self._widen_entry(plan, [l[0] for l in leaves])
             for op in ops:
                 lv, n = op.apply(lv, n)
             k = lv[0]
@@ -1048,7 +1058,8 @@ class JAXExecutor:
                                   cap_floor=cap_floor)
             cap_floor = max(cap_floor, batch.cap)
             jitted = self._compile_stream_nocombine(
-                plan, batch.cap, len(batch.cols), r)
+                plan, batch.cap, len(batch.cols), r,
+                tuple(str(c.dtype) for c in batch.cols))
             args = (batch.counts,) + ((bounds,) if bounds is not None
                                       else ()) + tuple(batch.cols)
             outs = jitted(*args)

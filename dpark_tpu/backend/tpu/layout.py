@@ -94,9 +94,39 @@ def ingest(mesh, partitions, treedef, specs, key_leaf=None,
     counts = np.array([len(p) for p in partitions], dtype=np.int32)
     cap = max(round_capacity(int(counts.max()) if len(counts) else 1),
               cap_floor)
+    # host->device wire narrowing: int64 scalar leaves whose values
+    # provably fit int32 ride the PCIe/tunnel at i32 (halving H2D
+    # bytes — the projected large-scale bound, FEASIBILITY_100GB.md);
+    # the stage program widens back to the spec dtype at entry, so
+    # compute semantics are unchanged.  Columnar partitions only (the
+    # big-data path, where the min/max scan is one vectorized pass).
+    from dpark_tpu import conf as _conf
+    tight = [None] * len(specs)
+    col_stats = {}
+    all_columnar = _conf.NARROW_EXCHANGE and any(
+        len(p) for p in partitions) and all(
+        getattr(p, "columns", None) is not None
+        and len(p.columns) == len(specs)
+        for p in partitions if len(p))
+    if all_columnar:
+        i32 = np.iinfo(np.int32)
+        for li, (dt, shape) in enumerate(specs):
+            if np.dtype(dt) == np.int64 and shape == ():
+                los, his = [], []
+                for p in partitions:
+                    if len(p):
+                        c = np.asarray(p.columns[li])
+                        if c.size:
+                            los.append(int(c.min()))
+                            his.append(int(c.max()))
+                lo = min(los) if los else 0
+                hi = max(his) if his else 0
+                col_stats[li] = (lo, hi)
+                if lo >= i32.min and hi <= i32.max:
+                    tight[li] = np.dtype(np.int32)
     cols = []
     for li, (dt, shape) in enumerate(specs):
-        col = np.zeros((ndev, cap) + shape, dtype=dt)
+        col = np.zeros((ndev, cap) + shape, dtype=tight[li] or dt)
         cols.append(col)
     flat_scalars = all(shape == () for _, shape in specs)
     for d, part in enumerate(partitions):
@@ -133,9 +163,15 @@ def ingest(mesh, partitions, treedef, specs, key_leaf=None,
             if np.isinf(kc).any() or np.isnan(kc).any():
                 raise ValueError("inf/nan float key collides with device "
                                  "padding; taking the host path")
-        elif int(kc.max()) == int(np.iinfo(kc.dtype).max):
-            raise ValueError("key equal to the device sentinel; "
-                             "taking the host path")
+        else:
+            # sentinel check against the SPEC dtype (a narrowed i32
+            # column can never hold the i64 sentinel; reuse the fit
+            # scan's max instead of rescanning)
+            hi = (col_stats[key_leaf][1] if key_leaf in col_stats
+                  else int(kc.max()))
+            if hi == int(np.iinfo(np.dtype(specs[key_leaf][0])).max):
+                raise ValueError("key equal to the device sentinel; "
+                                 "taking the host path")
     sharding = NamedSharding(mesh, P(AXIS))
     dev_cols = [jax.device_put(c, sharding) for c in cols]
     dev_counts = jax.device_put(counts, NamedSharding(mesh, P(AXIS)))

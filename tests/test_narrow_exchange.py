@@ -115,3 +115,40 @@ def test_narrow_in_sort_and_group(tctx):
     for k, v in data[:4000]:
         expect.setdefault(k, []).append(v)
     assert grouped == {k: sorted(v) for k, v in expect.items()}
+
+
+def test_ingest_narrows_h2d_wire(tctx):
+    """Columnar int64 leaves whose values fit i32 ship to the device
+    at i32 (H2D bytes halve); the program widens at entry, so results
+    are exact — including at the int32 boundary, where narrowing must
+    NOT engage."""
+    import numpy as np
+    from dpark_tpu import Columns
+    from dpark_tpu.backend.tpu import layout
+    ex = tctx.scheduler.executor
+    i = np.arange(4096, dtype=np.int64)
+
+    # fits i32: the ingested batch's columns must be int32 on device
+    pc = tctx.parallelize(Columns(i % 1000, i % 7), 8)
+    batch = layout.ingest(ex.mesh, pc._slices,
+                          *layout.record_spec((0, 0)), key_leaf=0)
+    assert all(str(c.dtype) == "int32" for c in batch.cols), \
+        [c.dtype for c in batch.cols]
+    got = dict(pc.reduceByKey(lambda a, b: a + b, 8).collect())
+    expect = {}
+    for k, v in zip((i % 1000).tolist(), (i % 7).tolist()):
+        expect[k] = expect.get(k, 0) + v
+    assert got == expect
+
+    # beyond i32: values stay int64 on the wire and results are exact
+    big = np.int64(2**31) + i               # > int32 max
+    pc2 = tctx.parallelize(Columns(i % 50, big), 8)
+    batch2 = layout.ingest(ex.mesh, pc2._slices,
+                           *layout.record_spec((0, 0)), key_leaf=0)
+    assert str(batch2.cols[0].dtype) == "int32"    # keys fit
+    assert str(batch2.cols[1].dtype) == "int64"    # values do not
+    got2 = dict(pc2.reduceByKey(lambda a, b: a + b, 8).collect())
+    expect2 = {}
+    for k, v in zip((i % 50).tolist(), big.tolist()):
+        expect2[k] = expect2.get(k, 0) + v
+    assert got2 == expect2
