@@ -211,11 +211,13 @@ class TPUScheduler(DAGScheduler):
         wire0 = self.executor.exchange_wire_bytes
         real0 = self.executor.exchange_real_rows
         slot0 = self.executor.exchange_slot_rows
+        islot0 = self.executor.ingest_slot_rows
         kind, result = self.executor.run_stage(plan)
         note = {"kind": "array",
                 "run_seconds": round(_time.time() - t0, 3)}
         wire = self.executor.exchange_wire_bytes - wire0
         slot_rows = self.executor.exchange_slot_rows - slot0
+        ingest_rows = self.executor.ingest_slot_rows - islot0
         if wire or slot_rows:
             # per-stage exchange accounting (HARDWARE_CHECKLIST.md
             # items 2-3: the tuning signals, visible in the web UI)
@@ -223,6 +225,13 @@ class TPUScheduler(DAGScheduler):
             note["pad_efficiency"] = round(
                 (self.executor.exchange_real_rows - real0)
                 / max(1, slot_rows), 4)
+        elif ingest_rows:
+            # single-chip identity exchange: no wire moved; report the
+            # ingest slot fill under its own name so the UI never
+            # presents ingest padding as wire padding
+            note["ingest_pad_efficiency"] = round(
+                (self.executor.exchange_real_rows - real0)
+                / max(1, ingest_rows), 4)
         if kind == "shuffle":
             store = self.executor.shuffle_store.get(result)
             if store is not None:

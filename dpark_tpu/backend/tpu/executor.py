@@ -134,12 +134,24 @@ class JAXExecutor:
         self._hbm_seq = 0             # global LRU clock across both tiers
         self.exchange_wire_bytes = 0  # ICI bytes moved by all_to_all
         self._exchange_real_rows = 0  # valid rows offered for exchange
-        self.exchange_slot_rows = 0   # padded slots actually moved;
+        self.exchange_slot_rows = 0   # padded slots moved over the wire;
         #   pad efficiency = real/slot (HARDWARE_CHECKLIST.md step 3)
+        # slots that never cross a wire (ndev==1 identity exchange) are
+        # tracked separately so single-chip runs measure ingest padding
+        # under its own name, not as bogus wire padding
+        self.ingest_slot_rows = 0
         # count arrays whose host sum is deferred (the ndev==1 fast
         # path must not pay a blocking readback per wave just for this
-        # metric); flushed on first metric read
+        # metric); flushed on first metric read, or opportunistically
+        # once the list exceeds a small bound so an embedder that never
+        # reads the metric doesn't pin device buffers forever
         self._pending_real_counts = []
+        self._PENDING_COUNTS_MAX = 64
+        # slots already compiled per leaf config: sizing snaps to a
+        # cached slot within the padding tolerance so data-size drift
+        # between jobs reuses programs instead of recompiling adjacent
+        # 1/16-octave classes
+        self._slot_memo = {}
         self._compiled = {}
         # let rdd.unpersist() reach device-resident caches
         from dpark_tpu import cache as cache_mod
@@ -896,7 +908,7 @@ class JAXExecutor:
     def _wave_iter_columnar(self, plan):
         from dpark_tpu.rdd import _ColumnarSlice
         slices = plan.source[1]._slices
-        chunk = conf.STREAM_CHUNK_ROWS
+        chunk = conf.stream_chunk_rows(fuse._columnar_row_bytes(slices))
         nchunks = (max(len(s) for s in slices) + chunk - 1) // chunk
         for c in range(nchunks):
             yield [
@@ -943,10 +955,15 @@ class JAXExecutor:
             recv = self._exchange_all(leaves, cnts, offs,
                                       slot_floor=slot_floor)
             slot_floor = max(slot_floor, recv[2])
+            if state is not None:
+                # deferred from the PREVIOUS wave: its async counts
+                # copy has been in flight through this wave's ingest +
+                # narrow + exchange, so this read doesn't stall
+                state = self._shrink_state(state)
             state = self._merge_into_state(plan, state, recv, monoid,
                                            merge_fn)
             logger.debug("streamed wave %d", c + 1)
-        leaves, counts = state
+        leaves, counts = self._shrink_state(state)
         return self._register_shuffle(dep, plan, {
             "leaves": leaves, "counts": counts,
             "pre_reduced": True,        # device d holds reduce part d
@@ -1223,7 +1240,9 @@ class JAXExecutor:
             # BENCH_REAL_r03.md, and this runs per wave); the row
             # metric readback is deferred to the next metric read.
             self._pending_real_counts.append(counts)
-            self.exchange_slot_rows += cap
+            if len(self._pending_real_counts) > self._PENDING_COUNTS_MAX:
+                self.exchange_real_rows  # property read drains the list
+            self.ingest_slot_rows += cap
             # consumers expect per-device (R=1, slot, ...) receive
             # buffers and (R=1,) counts — counts is already the (1, 1)
             # per-bucket array, leaves gain the source-device axis
@@ -1232,9 +1251,20 @@ class JAXExecutor:
         host_counts = np.asarray(jax.device_get(counts))
         max_run = int(host_counts.max()) if host_counts.size else 1
         mean = int(host_counts.sum()) // max(1, host_counts.size)
-        slot = max(layout.round_capacity(min(max(64, 2 * mean),
-                                             max(1, max_run))),
-                   min(slot_floor, layout.round_capacity(cap)))
+        # slot sizing: fine (1/16-octave) classes — power-of-two slots
+        # alone cost up to 2x wire padding (the measured 0.5 pad
+        # efficiency of BENCH_r03); uniform loads now pad <=6.25%.
+        # Sizing first snaps to an ALREADY-COMPILED slot within the
+        # same tolerance, so a few percent of data drift between jobs
+        # reuses the cached exchange/reduce programs instead of
+        # compiling the adjacent fine class.
+        ideal = min(max(64, 2 * mean), max(1, max_run))
+        memo = self._slot_memo.setdefault(
+            (tuple(str(l.dtype) for l in leaves), nleaves), set())
+        cached = [s for s in memo if ideal <= s <= ideal + (ideal >> 4)]
+        slot = min(cached) if cached else layout.round_capacity_fine(ideal)
+        slot = max(slot, min(slot_floor, layout.round_capacity_fine(cap)))
+        memo.add(slot)
         self.exchange_real_rows += int(host_counts.sum())
         narrow = self._narrow_plan(leaves, counts)
         exchange = self._compile_exchange(
@@ -1247,19 +1277,21 @@ class JAXExecutor:
             for li in range(nleaves))
         sent = jax.device_put(
             np.zeros((self.ndev, self.ndev), np.int32), self._sharding())
+        # the round count is KNOWN on the host (each round moves up to
+        # `slot` rows of every src->dst bucket, so ceil(max_bucket/slot)
+        # rounds drain everything) — no per-round blocking overflow
+        # readback serializing dispatch against a 66 ms tunnel RTT
+        # (VERDICT r3 #2); the program's overflow output is ignored
+        rounds = max(1, -(-max_run // slot))
         recv_rounds, cnt_rounds = [], []
-        while True:
+        for _ in range(rounds):
             outs = exchange(offsets, counts, sent, *leaves)
-            recv_cnt, sent, overflow = outs[0], outs[1], outs[2]
+            recv_cnt, sent = outs[0], outs[1]
             recv_rounds.append(list(outs[3:]))
             cnt_rounds.append(recv_cnt)
             self.exchange_wire_bytes += (
                 self.ndev * self.ndev * slot * wire_itemsize)
             self.exchange_slot_rows += self.ndev * self.ndev * slot
-            if int(np.asarray(jax.device_get(overflow))[0]) == 0:
-                break
-            if len(recv_rounds) > 512:
-                raise RuntimeError("shuffle exchange did not converge")
         return recv_rounds, cnt_rounds, slot
 
     def _merge_into_state(self, plan, state, recv, monoid,
@@ -1320,7 +1352,23 @@ class JAXExecutor:
             args.extend(recv_rounds[r])
         outs = self._compiled[key](*args)
         counts, leaves = outs[0], list(outs[1:])
-        # shrink to the next size class to bound state growth
+        # start the counts D2H without blocking: the caller shrinks the
+        # state one wave later (_shrink_state), by which point the
+        # transfer has ridden along behind the merge — the wave loop
+        # never stalls on a 66 ms tunnel round-trip just for a slice
+        # bound (VERDICT r3 #2: no per-wave blocking syncs)
+        try:
+            counts.copy_to_host_async()
+        except AttributeError:
+            pass
+        return (leaves, counts)
+
+    def _shrink_state(self, state):
+        """Slice the combined state down to the size class its counts
+        need — bounds state growth across waves and keeps the merge
+        program's state_cap compile key sticky.  The counts readback
+        was issued async at merge time; reading it here is (near-)free."""
+        leaves, counts = state
         host_n = int(np.asarray(jax.device_get(counts)).max() or 1)
         want_cap = layout.round_capacity(host_n)
         if leaves[0].shape[1] > want_cap:

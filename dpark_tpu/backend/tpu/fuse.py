@@ -563,15 +563,29 @@ def _leaves_merge_fn(merge, nleaves):
     return merged
 
 
+def _columnar_row_bytes(slices):
+    """Bytes per record across a slice's columns (for HBM wave sizing)."""
+    for s in slices:
+        cols = getattr(s, "columns", None)
+        if cols is not None and len(s):
+            import numpy as np
+            return sum(np.asarray(c).dtype.itemsize
+                       * int(np.prod(np.asarray(c).shape[1:] or (1,)))
+                       for c in cols)
+    return 16
+
+
 def _big_columnar(pc):
     """ParallelCollection big enough for the wave stream (the r > ndev
-    spill requires streaming)."""
+    spill requires streaming).  The threshold is the EFFECTIVE chunk
+    (HBM-sized on a real device) so data that fits one wave keeps the
+    lower-overhead in-core path."""
     from dpark_tpu import conf
     from dpark_tpu.rdd import _ColumnarSlice
     slices = pc._slices
     return (all(isinstance(s, _ColumnarSlice) for s in slices)
             and max((len(s) for s in slices), default=0)
-            > conf.STREAM_CHUNK_ROWS)
+            > conf.stream_chunk_rows(_columnar_row_bytes(slices)))
 
 
 def _split_bytes(sp):

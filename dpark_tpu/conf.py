@@ -61,7 +61,48 @@ SHUFFLE_HBM_BUDGET = 2 << 30
 # this many rows per device runs in ingest->combine->exchange waves, so
 # the working set in HBM is one chunk plus the combined state (the >HBM
 # pipeline of SURVEY.md 7.2 item 4)
-STREAM_CHUNK_ROWS = 4 << 20
+# "auto" sizes waves to device HBM at run time (stream_chunk_rows);
+# assigning a number pins the wave size exactly (tests/benchmarks force
+# small chunks to exercise multi-wave machinery at toy sizes)
+STREAM_CHUNK_ROWS = "auto"
+_STREAM_CHUNK_ROWS_FALLBACK = 4 << 20
+
+
+def _hbm_bytes_limit():
+    """Per-device accelerator memory, or 0 when unknown (CPU backends
+    report none).  Only called once a backend is already live."""
+    global _HBM_LIMIT_CACHE
+    if _HBM_LIMIT_CACHE is None:
+        limit = 0
+        try:
+            import jax
+            dev = jax.local_devices()[0]
+            if dev.platform != "cpu":
+                stats = dev.memory_stats() or {}
+                limit = int(stats.get("bytes_limit", 0))
+        except Exception:
+            limit = 0
+        _HBM_LIMIT_CACHE = limit
+    return _HBM_LIMIT_CACHE
+
+
+_HBM_LIMIT_CACHE = None
+
+
+def stream_chunk_rows(row_bytes=16):
+    """Effective wave size in rows per device: an explicitly assigned
+    STREAM_CHUNK_ROWS wins; "auto" sizes the wave to the device's own
+    HBM (VERDICT r3 #2: waves must amortize the 66 ms dispatch tunnel
+    RTT — size them to memory, not to a CPU-tuned constant).  Raw wave
+    bytes/device = HBM/16; the wave working set (ingest + bucketized +
+    receive + merge copies, ~6x) then peaks well under half of HBM."""
+    if STREAM_CHUNK_ROWS != "auto":
+        return STREAM_CHUNK_ROWS
+    limit = _hbm_bytes_limit()
+    if not limit:
+        return _STREAM_CHUNK_ROWS_FALLBACK
+    return max(_STREAM_CHUNK_ROWS_FALLBACK,
+               limit // (16 * max(1, row_bytes)))
 
 # text-source stages bigger than this stream in waves of splits instead
 # of materializing the whole encoded dataset (same out-of-core pipeline)

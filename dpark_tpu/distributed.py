@@ -32,6 +32,7 @@ def _file_rendezvous(path, process_id, timeout=120):
     stranger) and publishes host:port by atomic rename; other ranks
     poll the path.  Multi-host deployments put the path on the shared
     FS (the reference's workdir-on-MooseFS pattern)."""
+    nonce = os.environ.get("DPARK_RUN_NONCE", "")
     if process_id == 0:
         import socket
         from dpark_tpu.dcn import _routable_host
@@ -46,7 +47,11 @@ def _file_rendezvous(path, process_id, timeout=120):
         addr = "%s:%d" % (_routable_host(), port)
         tmp = "%s.tmp.%d" % (path, os.getpid())
         with open(tmp, "w") as f:
-            f.write(addr)
+            # second line: per-run nonce (when the launcher provides
+            # one) — joiners require an exact match, so freshness never
+            # depends on a TCP probe that an unrelated service re-bound
+            # to the recorded port could also pass
+            f.write(addr + ("\n" + nonce if nonce else ""))
         os.replace(tmp, path)
         return addr
     # leftover guard, clock-free: a rank can start before rank 0 has
@@ -82,13 +87,26 @@ def _file_rendezvous(path, process_id, timeout=120):
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
-            fresh = suspect is None or _ident() != suspect
             with open(path) as f:
-                addr = f.read().strip()
+                lines = f.read().splitlines()
+            addr = lines[0].strip() if lines else ""
+            file_nonce = lines[1].strip() if len(lines) > 1 else ""
         except OSError:
-            addr = ""
-        if addr and (fresh or _alive(addr)):
-            return addr
+            addr, file_nonce = "", ""
+        if nonce:
+            # launcher gave every rank the run's nonce: accept only a
+            # file carrying it (an unrelated listener at a recycled
+            # port can pass _alive(); it cannot forge the nonce), then
+            # gate on liveness alone
+            if addr and file_nonce == nonce and _alive(addr):
+                return addr
+        elif addr:
+            try:
+                fresh = suspect is None or _ident() != suspect
+            except OSError:
+                fresh = False
+            if fresh or _alive(addr):
+                return addr
         time.sleep(0.05)
     raise TimeoutError("no coordinator address at %s after %ds"
                        % (path, timeout))

@@ -1452,6 +1452,10 @@ _BZ2_EOS_MAGIC = 0x177245385090
 # its old entry at insert time
 _BZ2_TABLE_CACHE = {}
 _BZ2_TABLE_CACHE_MAX = 64
+# evict+insert happens under this lock: _block_table is reachable from
+# the text-ingest ThreadPoolExecutor (line-spans-past-lookahead rescan),
+# and iterating the dict while another thread inserts raises
+_BZ2_TABLE_CACHE_LOCK = __import__("threading").Lock()
 
 
 def _bz2_scan_bit_magics(path):
@@ -1623,12 +1627,13 @@ class BZip2FileRDD(GZipFileRDD):
         except Exception as e:
             logger.debug("bz2 block scan fallback for %s: %s", path, e)
             table = None
-        stale = [k for k in _BZ2_TABLE_CACHE if k[0] == path]
-        while stale or len(_BZ2_TABLE_CACHE) >= _BZ2_TABLE_CACHE_MAX:
-            victim = stale.pop() if stale \
-                else next(iter(_BZ2_TABLE_CACHE))
-            _BZ2_TABLE_CACHE.pop(victim, None)
-        _BZ2_TABLE_CACHE[key] = table
+        with _BZ2_TABLE_CACHE_LOCK:
+            stale = [k for k in list(_BZ2_TABLE_CACHE) if k[0] == path]
+            while stale or len(_BZ2_TABLE_CACHE) >= _BZ2_TABLE_CACHE_MAX:
+                victim = stale.pop() if stale \
+                    else next(iter(_BZ2_TABLE_CACHE))
+                _BZ2_TABLE_CACHE.pop(victim, None)
+            _BZ2_TABLE_CACHE[key] = table
         return table
 
     def _make_splits(self):
