@@ -63,10 +63,22 @@ class DevicePregel:
     def __init__(self, executor, ids, values, edges, compute, send,
                  combine="add", edge_values=None, active=None,
                  initial_messages=None, aggregator=None,
-                 max_superstep=80):
+                 max_superstep=80, static_superstep=False,
+                 send_gate_leaf=None):
         if combine not in PREGEL_MONOIDS:
             raise ValueError(
                 "combine must be one of %s" % (PREGEL_MONOIDS,))
+        # static_superstep: compile one step program PER superstep with
+        # `s` as a Python int (user compute branches on it — e.g. the
+        # columnarized object-Bagel adapter); default traces s as data
+        # so one program serves every superstep
+        self.static_superstep = bool(static_superstep)
+        # send_gate_leaf: index of a bool vertex-state leaf that
+        # REPLACES post-compute `active` as the send mask (the object
+        # contract delivers messages from a vertex that emitted and
+        # then halted, and nothing from an active vertex that emitted
+        # none — neither is expressible with the active gate alone)
+        self.send_gate = send_gate_leaf
         self.ex = executor
         self.ndev = executor.ndev
         self.mesh = executor.mesh
@@ -267,7 +279,10 @@ class DevicePregel:
             evs = [v[0] for v in rest[nv:]]
             ev = jnp.arange(cap_e) < ecnt[0]
             sv = [v[slot] for v in vals]
-            sa = a[slot] & ev
+            if self.send_gate is not None:
+                sa = vals[self.send_gate][slot].astype(bool) & ev
+            else:
+                sa = a[slot] & ev
             msg = self.send(
                 rewrap(sv, self.v_tuple),
                 rewrap(evs, self.e_tuple) if ne else None, edeg[0])
@@ -285,7 +300,7 @@ class DevicePregel:
         nm = len(self.msg_dtypes)
         return self._jit(("gen",), per_device, 6 + nv + ne, 4 + nm)
 
-    def _p_step(self, rounds, slot):
+    def _p_step(self, rounds, slot, s_static=None):
         """Deliver combined messages, run the vertex compute, count the
         still-active vertices.  aggregated (if any) is computed from the
         PRE-compute state and psum'd across the mesh."""
@@ -294,9 +309,17 @@ class DevicePregel:
         nv = len(self.values)
         nm = len(self.msg_dtypes)
         nleaves = 1 + nm                        # dst key + msg leaves
+        static = self.static_superstep
 
-        def per_device(sstep, vcnt, vid, act, *rest):
-            s = sstep[0]
+        def per_device(*all_args):
+            if static:
+                vcnt, vid, act = all_args[:3]
+                rest = all_args[3:]
+                s = s_static
+            else:
+                sstep, vcnt, vid, act = all_args[:4]
+                rest = all_args[4:]
+                s = sstep[0]
             cnt = vcnt[0]
             ids = vid[0]
             a = act[0]
@@ -355,8 +378,9 @@ class DevicePregel:
                                        jnp.reshape(n_active, (1,)))
             return tuple(jnp.expand_dims(o, 0) for o in out)
 
-        n_in = 4 + nv + rounds + rounds * nleaves
-        return self._jit(("step", rounds, slot), per_device,
+        n_in = (3 if static else 4) + nv + rounds + rounds * nleaves
+        return self._jit(("step", rounds, slot,
+                          s_static if static else None), per_device,
                          n_in, nv + 2)
 
     # ------------------------------------------------------------------
@@ -376,22 +400,24 @@ class DevicePregel:
         s = 0
         n_active = None
         while s < self.max_superstep:
-            sstep = jax.device_put(
-                np.full((self.ndev,), s, np.int32), sh)
+            if self.static_superstep:
+                head = [self.vcnt, self.vid, self.active]
+            else:
+                head = [jax.device_put(
+                    np.full((self.ndev,), s, np.int32), sh),
+                    self.vcnt, self.vid, self.active]
             if pending is not None and total_msgs > 0:
                 counts, offsets, kk, vv = pending
                 recv_rounds, cnt_rounds, slot = self.ex._exchange_all(
                     [kk] + vv, counts, offsets)
                 rounds = len(recv_rounds)
-                step = self._p_step(rounds, slot)
-                args = [sstep, self.vcnt, self.vid, self.active] \
-                    + self.values + list(cnt_rounds)
+                step = self._p_step(rounds, slot, s_static=s)
+                args = head + self.values + list(cnt_rounds)
                 for r in range(rounds):
                     args.extend(recv_rounds[r])
             else:
-                step = self._p_step(0, 0)
-                args = [sstep, self.vcnt, self.vid, self.active] \
-                    + self.values
+                step = self._p_step(0, 0, s_static=s)
+                args = head + self.values
             outs = step(*args)
             self.values = list(outs[:nv])
             self.active = outs[nv]

@@ -208,9 +208,8 @@ class JAXExecutor:
         if fuse.is_list_agg(dep.aggregator):
             return None, None
         try:
-            nval = len(plan.out_specs) - 1
             merge_fn = fuse._leaves_merge_fn(
-                dep.aggregator.merge_combiners, nval)
+                dep.aggregator.merge_combiners, plan.out_treedef)
             structs = fuse._batched_spec_struct(plan.out_specs[1:])
             jax.eval_shape(lambda *v: merge_fn(list(v), list(v)),
                            *structs)
@@ -379,9 +378,8 @@ class JAXExecutor:
         dep = plan.source[1]
         merge_fn = monoid = None
         if plan.src_combine:
-            nval = len(plan.in_specs) - 1
             merge_fn = fuse._leaves_merge_fn(
-                dep.aggregator.merge_combiners, nval)
+                dep.aggregator.merge_combiners, plan.in_treedef)
             try:
                 monoid = fuse.classify_merge(
                     dep.aggregator.merge_combiners)

@@ -303,6 +303,11 @@ def extract_chain(top, cached_ids=()):
     cur = top
     passthrough = False
     while True:
+        if getattr(cur, "_snapshot_path", None) is not None:
+            # snapshot(): the user asked for disk materialization with
+            # cross-run reuse — the object path honors the read/write;
+            # fusing past it would silently skip both
+            return None
         if cur.id in cached_ids:
             ops.reverse()
             return cur, ops, passthrough
@@ -543,18 +548,33 @@ def analyze_text_stage(stage, ndev, executor_or_store):
     return plan
 
 
-def _leaves_merge_fn(merge, nleaves):
-    """User merge_combiners (value, value) -> value lifted to leaf lists,
-    vmapped for use inside segment scans."""
+def _leaves_merge_fn(merge, record_treedef):
+    """User merge_combiners (value, value) -> value lifted to leaf
+    lists, vmapped for use inside segment scans.  The value's REAL
+    pytree structure is rebuilt from the record treedef before calling
+    the user function — a nested combiner like avg's (sum, (s, c))
+    must see its own shape, not a flat leaf tuple (flattening broke
+    every nested-accumulator aggregate, e.g. Table avg)."""
+    import jax.tree_util as jtu
+    children = jtu.treedef_children(record_treedef)
+    if len(children) == 2:
+        vdef = children[1]               # records are (k, value)
+        nleaves = vdef.num_leaves
+
+        def _unwrap(leaves):
+            return jtu.tree_unflatten(vdef, list(leaves))
+    else:                                # flat (k, v1, v2, ...) record
+        nleaves = record_treedef.num_leaves - 1
+
+        def _unwrap(leaves):
+            return leaves[0] if nleaves == 1 else tuple(leaves)
+
     def leaf_merge(*flat):
         va = flat[:nleaves]
         vb = flat[nleaves:]
-        out = merge(_maybe_unwrap(va), _maybe_unwrap(vb))
+        out = merge(_unwrap(va), _unwrap(vb))
         out_leaves = jax.tree_util.tree_leaves(out)
         return tuple(out_leaves)
-
-    def _maybe_unwrap(leaves):
-        return leaves[0] if nleaves == 1 else tuple(leaves)
 
     vfn = jax.vmap(leaf_merge)
 
@@ -698,7 +718,7 @@ def analyze_stage(stage, ndev, executor_or_store):
             src_combine = True
             try:
                 merge_fn = _leaves_merge_fn(
-                    dep.aggregator.merge_combiners, len(specs) - 1)
+                    dep.aggregator.merge_combiners, treedef)
                 vstructs = _batched_spec_struct(specs[1:])
                 jax.eval_shape(
                     lambda *v: merge_fn(list(v), list(v)), *vstructs)

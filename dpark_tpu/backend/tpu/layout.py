@@ -233,12 +233,53 @@ def ingest(mesh, partitions, treedef, specs, key_leaf=None,
     return Batch(treedef, dev_cols, dev_counts)
 
 
+@jax.jit
+def _masked_minmax(c, counts):
+    """(min, max) over the VALID rows of a (ndev, cap) column (padding
+    content — e.g. the int64 key sentinel — must not block narrowing)."""
+    valid = jnp.arange(c.shape[1])[None, :] < counts[:, None]
+    lo = jnp.min(jnp.where(valid, c, jnp.iinfo(c.dtype).max))
+    hi = jnp.max(jnp.where(valid, c, jnp.iinfo(c.dtype).min))
+    return jnp.stack([lo, hi])
+
+
+@jax.jit
+def _cast_i32(c):
+    return c.astype(jnp.int32)
+
+
+def _egest_read(c, dev_counts):
+    """One column device->host, narrowed to int32 on the wire when the
+    column is large and every valid value fits: the real-chip tunnel
+    egests at ~37 MB/s (BENCH_REAL_r03.md), so halving D2H bytes on
+    int64 results halves collect() wall time.  Row lists are built via
+    .tolist() downstream, so the narrowed dtype is invisible to
+    callers; padding may wrap in the cast — no caller reads past the
+    per-device counts."""
+    if (conf.NARROW_EXCHANGE and c.ndim == 2
+            and c.dtype == jnp.int64
+            and int(c.nbytes) >= conf.EGEST_NARROW_MIN_BYTES):
+        lo, hi = host_read(_masked_minmax(c, dev_counts))
+        i32 = np.iinfo(np.int32)
+        if lo >= i32.min and hi <= i32.max:
+            return host_read(_cast_i32(c))
+    return host_read(c)
+
+
 def egest(batch):
     """Sharded Batch -> list of per-partition row lists (host).
     Multi-controller meshes replicate through host_read, so every rank
     egests the same full result set."""
     counts = host_read(batch.counts)
-    host_cols = [host_read(c) for c in batch.cols]
+    total = sum(int(c.nbytes) for c in batch.cols)
+    if total >= conf.EGEST_WARN_BYTES:
+        from dpark_tpu.utils.log import get_logger
+        get_logger("layout").warning(
+            "egesting %.1f MB of device results to the host; on a "
+            "tunneled chip this path runs at ~37 MB/s — prefer "
+            "reducing on device (reduceByKey/aggregate) before "
+            "collect(), or saveAs* sinks", total / (1 << 20))
+    host_cols = [_egest_read(c, batch.counts) for c in batch.cols]
     # fast paths: scalar records, and arbitrarily-nested TUPLE records
     # (e.g. join's (k, (a, b))) rebuild with zips instead of a per-row
     # tree_unflatten

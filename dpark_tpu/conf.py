@@ -122,6 +122,18 @@ DEFAULT_DTYPE = "int32"
 # stays i64 either way; set 0 to disable (e.g. when bisecting parity).
 NARROW_EXCHANGE = os.environ.get("DPARK_NARROW_EXCHANGE", "1") != "0"
 
+# device->host egest: int64 scalar columns at least this large are
+# min/max-probed and ride the link as int32 when every valid value fits
+# (the axon tunnel reads back at ~37 MB/s — BENCH_REAL_r03.md — so
+# halving collect() bytes halves its wall time).  Tests shrink this to
+# exercise the path at toy sizes.
+EGEST_NARROW_MIN_BYTES = 8 << 20
+
+# collect()s bigger than this log a reduce-before-collect warning
+# (the reference's executor result-size limit analog, SURVEY.md
+# section 2.1 executor row: oversized inline results get flagged)
+EGEST_WARN_BYTES = 256 << 20
+
 # when set, the tpu executor writes a jax.profiler trace here for the
 # whole session (view with tensorboard / xprof)
 TRACE_DIR = os.environ.get("DPARK_TRACE_DIR")

@@ -109,9 +109,25 @@ class RDD:
     def iterator(self, split):
         if self._checkpoint_rdd is not None:
             return self._checkpoint_rdd.iterator(split)
+        if getattr(self, "_snapshot_path", None) is not None:
+            return self._snapshot_iterator(split)
         if self.should_cache:
             return _cache.get_or_compute(self, split)
         return self.compute(split)
+
+    def _snapshot_iterator(self, split):
+        """Read the split from its snapshot file, computing + writing it
+        (atomic tmp+rename) on first touch.  Lineage stays intact —
+        a vanished snapshot silently recomputes."""
+        path = os.path.join(self._snapshot_path,
+                            "part-%05d" % split.index)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return iter(pickle.load(f))
+        rows = list(self.compute(split))
+        with atomic_file(path) as f:
+            pickle.dump(rows, f, -1)
+        return iter(rows)
 
     def preferred_locations(self, split):
         return []
@@ -317,6 +333,25 @@ class RDD:
             env.cache.drop(self.id, len(self._splits))
         for drop in list(_cache.DEVICE_CACHES.values()):
             drop(self.id)
+        return self
+
+    def snapshot(self, path=None):
+        """Disk-materialize each partition at FIRST computation and read
+        it back on every later one — checkpoint's little sibling
+        (reference: dpark/rdd.py RDD.snapshot [L], SURVEY.md section
+        2.2): no lineage truncation, no eager job; a snapshot directory
+        that survives across runs short-circuits recomputation, and a
+        vanished one silently recomputes from lineage."""
+        if getattr(self, "_snapshot_path", None) is not None:
+            return self
+        if path is None:
+            base = self.ctx.checkpoint_dir
+            if base is None:
+                raise ValueError("no snapshot dir: pass path or call "
+                                 "ctx.setCheckpointDir")
+            path = os.path.join(base, "snapshot-rdd-%d" % self.id)
+        os.makedirs(path, exist_ok=True)
+        self._snapshot_path = path
         return self
 
     def checkpoint(self, path=None):

@@ -48,6 +48,46 @@ _SAFE_BUILTINS = {
 }
 
 
+def _branchless_min(a, b):
+    """min that the device tracer can see through: `a if a <= b else b`
+    forces a concrete bool, so group-by min/max would demote the whole
+    aggregate shuffle to the host object path (VERDICT r3 #8 — the
+    Table DSL must inherit the core's device speed).  Host objects
+    (strings, dates) keep exact Python comparison semantics."""
+    try:
+        import jax
+        if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
+            import jax.numpy as jnp
+            return jnp.minimum(a, b)
+    except ImportError:
+        pass
+    return a if a <= b else b
+
+
+def _branchless_max(a, b):
+    try:
+        import jax
+        if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
+            import jax.numpy as jnp
+            return jnp.maximum(a, b)
+    except ImportError:
+        pass
+    return a if a >= b else b
+
+
+def _branchless_div(a, b):
+    """avg's finalize without a concrete-bool branch (device rows only
+    exist for observed keys, so the count is never 0 there; the host
+    path keeps the divide-by-zero -> None convention)."""
+    try:
+        import jax
+        if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
+            return a / b
+    except ImportError:
+        pass
+    return a / b if b else None
+
+
 class _Agg:
     """One aggregate column: (create, merge, combine, finalize)."""
 
@@ -88,9 +128,9 @@ class _Agg:
         if f == "avg":
             return (a[0] + b[0], a[1] + b[1])
         if f == "min":
-            return a if a <= b else b
+            return _branchless_min(a, b)
         if f == "max":
-            return a if a >= b else b
+            return _branchless_max(a, b)
         if f == "first":
             return a
         if f == "adcount":
@@ -104,7 +144,7 @@ class _Agg:
     def finalize(self, acc):
         f = self.func
         if f == "avg":
-            return acc[0] / acc[1] if acc[1] else None
+            return _branchless_div(acc[0], acc[1])
         if f == "adcount":
             return len(acc)
         if f == "group_concat":

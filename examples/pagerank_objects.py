@@ -1,6 +1,8 @@
-"""PageRank via the reference's OBJECT Bagel contract (host path on
-every master; kept for API parity — see examples/pagerank.py for the
-device-native formulation).
+"""PageRank via the reference's OBJECT Bagel contract.  On the tpu
+master, numeric object programs like this one are AUTO-COLUMNARIZED
+onto the device Pregel (Bagel._run_columnar): compute is vmapped per
+degree class and supersteps run as fused mesh programs — see
+examples/pagerank.py for the explicitly device-native formulation.
 
 Usage: python examples/pagerank_objects.py [-m local|process|tpu]
 """
@@ -21,8 +23,12 @@ class PageRank:
         if superstep == 0:
             value = vert.value
         else:
+            # `msg_sum if ... is not None else 0.0` (not `msg_sum or
+            # 0.0`): equivalent on the host paths, and the device
+            # columnarizer can trace it (no truthiness on array values)
             value = ((1 - self.damping) / self.n
-                     + self.damping * (msg_sum or 0.0))
+                     + self.damping
+                     * (msg_sum if msg_sum is not None else 0.0))
         active = superstep < self.steps
         v = Vertex(vert.id, value, vert.outEdges, active)
         if active and vert.outEdges:

@@ -540,3 +540,58 @@ print("OK_SINGLE_DEV")
                          capture_output=True, text=True, timeout=280)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "OK_SINGLE_DEV" in out.stdout
+
+
+def test_egest_narrowed_wire_parity(tctx):
+    """Large int64 results whose values fit int32 ride D2H narrowed
+    (the 37 MB/s tunnel guard, VERDICT r3 #6) — results identical."""
+    from dpark_tpu import conf
+    old = conf.EGEST_NARROW_MIN_BYTES
+    conf.EGEST_NARROW_MIN_BYTES = 1           # force the probe at toy size
+    try:
+        pairs = [(i % 50, i) for i in range(4000)]
+        got = dict(tctx.parallelize(pairs, 8)
+                   .reduceByKey(lambda a, b: a + b, 8).collect())
+        exp = {}
+        for k, v in pairs:
+            exp[k] = exp.get(k, 0) + v
+        assert got == exp
+    finally:
+        conf.EGEST_NARROW_MIN_BYTES = old
+
+
+def test_egest_narrow_skipped_for_big_values(tctx):
+    """Values beyond int32 range must NOT be narrowed."""
+    from dpark_tpu import conf
+    old = conf.EGEST_NARROW_MIN_BYTES
+    conf.EGEST_NARROW_MIN_BYTES = 1
+    try:
+        big = 1 << 40
+        pairs = [(i % 10, big + i) for i in range(100)]
+        got = dict(tctx.parallelize(pairs, 8)
+                   .reduceByKey(lambda a, b: max(a, b), 8).collect())
+        exp = {}
+        for k, v in pairs:
+            exp[k] = max(exp.get(k, 0), v)
+        assert got == exp
+    finally:
+        conf.EGEST_NARROW_MIN_BYTES = old
+
+
+def test_egest_oversize_warning(tctx, caplog):
+    """collect() beyond EGEST_WARN_BYTES logs the reduce-before-collect
+    hint (the reference's executor result-size flag analog)."""
+    import logging
+    from dpark_tpu import conf
+    old = conf.EGEST_WARN_BYTES
+    conf.EGEST_WARN_BYTES = 64                # trip at toy size
+    try:
+        with caplog.at_level(logging.WARNING):
+            out = dict(tctx.parallelize([(i % 5, i) for i in range(100)],
+                                        8)
+                       .reduceByKey(lambda a, b: a + b, 8).collect())
+        assert len(out) == 5
+        assert any("reduce" in r.message and "collect" in r.message
+                   for r in caplog.records)
+    finally:
+        conf.EGEST_WARN_BYTES = old
