@@ -26,6 +26,14 @@ EOF
     LINE=$(echo "$OUT" | tail -1)
     echo "{\"ts\": \"$TS\", \"alive\": true, \"probe\": $LINE}" > "$STATUS"
     echo "$TS ALIVE $LINE" >> "$LOG"
+    # first contact: capture real-hardware bench artifacts NOW (the
+    # r3 chip answered mid-session and went away again)
+    if [ ! -e /root/repo/.real_capture_done ]; then
+      touch /root/repo/.real_capture_done
+      echo "$TS CAPTURE starting" >> "$LOG"
+      bash /root/repo/tools/real_capture.sh
+      echo "$TS CAPTURE done" >> "$LOG"
+    fi
   else
     echo "{\"ts\": \"$TS\", \"alive\": false, \"rc\": $RC}" > "$STATUS"
     echo "$TS DEAD rc=$RC" >> "$LOG"

@@ -1,0 +1,50 @@
+#!/bin/bash
+# One-shot real-hardware bench capture, fired by probe_loop.sh the moment
+# the chip first answers (r3 lesson: the chip answered mid-session; capture
+# artifacts IMMEDIATELY, the window may close).  Never SIGKILLs python on
+# the tunnel (HARDWARE_CHECKLIST) — TERM with a long grace period.
+set -u
+cd /root/repo
+TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+OUT=BENCH_REAL_r04.md
+LOGDIR=.real_capture
+mkdir -p "$LOGDIR"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "== $name ($TS) ==" >> "$LOGDIR/capture.log"
+  timeout --signal=TERM --kill-after=120 "$tmo" "$@" \
+    > "$LOGDIR/$name.out" 2> "$LOGDIR/$name.err"
+  echo "rc=$? for $name" >> "$LOGDIR/capture.log"
+}
+
+{
+  echo "# BENCH_REAL_r04 — real-chip capture at $TS"
+  echo
+  echo "Automatic capture fired by the probe loop on first chip contact."
+  echo "Raw outputs in $LOGDIR/."
+} > "$OUT"
+
+# 1. the canonical driver bench (auto-scales when a real chip answers);
+#    A/B of the _lex_sort reformulation is inside (post-fix code).
+run bench 2400 python bench.py
+{
+  echo; echo "## bench.py"; echo '```'
+  cat "$LOGDIR/bench.out"; echo '```'
+} >> "$OUT"
+
+# 2. OOC: the r3 weak spot (0.0014 GB/s real).  Post-fix wave pipeline.
+run ooc 2400 python benchmarks/ooc_run.py --config wordcount --master tpu --gb 1
+{
+  echo; echo "## ooc_run (1 GB wordcount)"; echo '```'
+  cat "$LOGDIR/ooc.out"; echo '```'
+} >> "$OUT"
+
+# 3. Pregel PageRank (BASELINE config #4 analog on device)
+run pagerank 1200 python benchmarks/pagerank_bench.py --vertices 200000
+{
+  echo; echo "## pagerank_bench"; echo '```'
+  cat "$LOGDIR/pagerank.out"; echo '```'
+} >> "$OUT"
+
+echo "$TS capture complete" >> "$LOGDIR/capture.log"
