@@ -137,6 +137,15 @@ def main():
                 "rank_mass": round(total, 6)}))
             return
     if args.mode in ("both", "device"):
+        if os.environ.get("BENCH_PLATFORM") \
+                and not os.environ.get("DPARK_TPU_PLATFORM"):
+            # an explicitly requested platform must ALSO govern the
+            # in-process run_device jax init: the probe child honors
+            # BENCH_PLATFORM and answers "reachable", but without the
+            # override this process would still dial the real device
+            # backend — and hang on a wedged tunnel
+            os.environ["DPARK_TPU_PLATFORM"] = \
+                os.environ["BENCH_PLATFORM"]
         if not os.environ.get("DPARK_TPU_PLATFORM"):
             # probe for a real device first (a wedged tunnel must not
             # hang the benchmark); fall back to the labeled CPU mesh

@@ -34,7 +34,9 @@ run bench 2400 python bench.py
 } >> "$OUT"
 
 # 2. OOC: the r3 weak spot (0.0014 GB/s real).  Post-fix wave pipeline.
-run ooc 2400 python benchmarks/ooc_run.py --config wordcount --master tpu --gb 1
+#    DPARK_TPU_PLATFORM=tpu: ooc_run defaults to the emulated CPU mesh
+#    otherwise — this capture exists to measure the REAL chip.
+run ooc 2400 env DPARK_TPU_PLATFORM=tpu python benchmarks/ooc_run.py --config wordcount --master tpu --gb 1
 {
   echo; echo "## ooc_run (1 GB wordcount)"; echo '```'
   cat "$LOGDIR/ooc.out"; echo '```'
