@@ -43,6 +43,23 @@ def _even_ranges(n, parts):
     return out
 
 
+def _reslice_parts(slices, ndev):
+    """Re-split host partitions to the mesh width (shuffle-map stages
+    only: the write redistributes by key, so partition boundaries carry
+    no semantics there).  Columnar slices re-slice without building
+    Python rows."""
+    from dpark_tpu.rdd import _ColumnarSlice
+    if slices and all(isinstance(s, _ColumnarSlice) for s in slices):
+        ncols = len(slices[0].columns)
+        cols = [np.concatenate([np.asarray(s.columns[i])
+                                for s in slices])
+                for i in range(ncols)]
+        return [_ColumnarSlice([c[lo:hi] for c in cols])
+                for lo, hi in _even_ranges(len(cols[0]), ndev)]
+    rows = [r for s in slices for r in s]
+    return [rows[lo:hi] for lo, hi in _even_ranges(len(rows), ndev)]
+
+
 def _prefetch_iter(it, depth=1):
     """Run `it` in a background thread, `depth` items ahead: the host
     tokenizes/slices wave k+1 while the device computes wave k.  The
@@ -461,35 +478,49 @@ class JAXExecutor:
         if plan.source[0] == "text":
             outs = self._run_narrow(plan, self._ingest_text(plan))
             return self._finish_stage(plan, outs)
-        if plan.source[0] in ("ingest", "cached"):
-            if plan.source[0] == "cached":
-                meta = self.result_cache[plan.source[1].id]
-                meta["seq"] = self._next_seq()       # LRU touch
-                batch = layout.Batch(meta["treedef"], meta["leaves"],
-                                     meta["counts"])
-                if plan.epilogue is not None:
-                    self._check_cached_keys(batch)
-            else:
-                pc = plan.source[1]
-                # any shuffle write pads with the key sentinel; a real
-                # key equal to it must force the host path
-                key_leaf = 0 if plan.epilogue is not None else None
-                batch = layout.ingest(self.mesh, pc._slices,
-                                      plan.in_treedef, plan.in_specs,
-                                      key_leaf=key_leaf)
+        if plan.source[0] == "union":
+            keyed = plan.epilogue is not None
+            batch = self._concat_batches(
+                [layout.Batch(sp.out_treedef, list(o[1:]), o[0])
+                 for sp in plan.source[1]
+                 for o in (self._source_outs(sp, keyed),)])
             outs = self._run_narrow(plan, batch)
-        elif plan.source[0] == "hbm" and self.shuffle_store[
-                plan.source[1].shuffle_id].get("pre_reduced"):
-            # streamed shuffle already exchanged+combined: device d holds
-            # reduce partition d — just run the stage's narrow tail
+        else:
+            outs = self._source_outs(plan, plan.epilogue is not None)
+        return self._finish_stage(plan, outs)
+
+    def _source_outs(self, plan, keyed):
+        """Load the plan's source and run its narrow/reduce program;
+        shared by whole-stage runs and union-branch materialization."""
+        if plan.source[0] == "ingest":
+            pc = plan.source[1]
+            slices = pc._slices
+            if getattr(plan, "reslice", False):
+                slices = _reslice_parts(slices, self.ndev)
+            # any shuffle write pads with the key sentinel; a real key
+            # equal to it must force the host path
+            batch = layout.ingest(self.mesh, slices, plan.in_treedef,
+                                  plan.in_specs,
+                                  key_leaf=0 if keyed else None)
+            return self._run_narrow(plan, batch)
+        if plan.source[0] == "cached":
+            meta = self.result_cache[plan.source[1].id]
+            meta["seq"] = self._next_seq()           # LRU touch
+            batch = layout.Batch(meta["treedef"], meta["leaves"],
+                                 meta["counts"])
+            if keyed:
+                self._check_cached_keys(batch)
+            return self._run_narrow(plan, batch)
+        if self.shuffle_store[plan.source[1].shuffle_id].get(
+                "pre_reduced"):
+            # streamed shuffle already exchanged+combined: device d
+            # holds reduce partition d — just run the narrow tail
             store = self.shuffle_store[plan.source[1].shuffle_id]
             store["seq"] = self._next_seq()
             batch = layout.Batch(store["out_treedef"], store["leaves"],
                                  store["counts"])
-            outs = self._run_narrow(plan, batch)
-        else:
-            outs = self._run_exchange_and_reduce(plan)
-        return self._finish_stage(plan, outs)
+            return self._run_narrow(plan, batch)
+        return self._run_exchange_and_reduce(plan)
 
     def _run_narrow(self, plan, batch, bounds=None):
         """Compile + invoke the narrow stage program on one batch."""
@@ -814,10 +845,13 @@ class JAXExecutor:
             "offsets": offs,             # (ndev, R)
             "no_combine": fuse.is_list_agg(dep.aggregator),
             "encoded_keys": getattr(plan, "encoded_keys", False),
-            # text ingest redistributes rows across devices, so device
-            # index != logical map partition: the host bridge reads the
-            # whole shuffle through map_id 0
-            "single_map": plan.source[0] == "text",
+            # text ingest, union concat, and resliced ingest all
+            # redistribute rows across devices, so device index !=
+            # logical map partition: the host bridge reads the whole
+            # shuffle through map_id 0 (object-path consumers fetch
+            # every reported map id; non-zero ids return empty)
+            "single_map": (plan.source[0] in ("text", "union")
+                           or getattr(plan, "reslice", False)),
         })
 
     def _register_shuffle(self, dep, plan, store):
@@ -850,6 +884,69 @@ class JAXExecutor:
         for r in range(rounds):
             args.extend(recv_rounds[r])
         return reduce_fn(*args)
+
+    # ------------------------------------------------------------------
+    # union-source stages (the windowed-stream shape, BASELINE config
+    # #4): each branch materializes to a device Batch through its own
+    # sub-plan (epilogue=None, via _source_outs), the batches
+    # concatenate ON DEVICE, and the stage's narrow ops + shuffle write
+    # run over the whole union
+    # ------------------------------------------------------------------
+    def _concat_batches(self, batches):
+        """Per-device concatenation of same-spec Batches into one."""
+        if len(batches) == 1:
+            return batches[0]
+        counts = [layout.host_read(b.counts) for b in batches]
+        total = np.sum(np.stack(counts), axis=0)
+        cap_out = layout.round_capacity(int(total.max()) or 1)
+        caps = tuple(b.cap for b in batches)
+        nleaves = len(batches[0].cols)
+        dtypes = tuple(str(c.dtype) for c in batches[0].cols)
+        jitted = self._compile_concat(len(batches), caps, dtypes,
+                                      nleaves, cap_out)
+        args = [b.counts for b in batches]
+        for b in batches:
+            args.extend(b.cols)
+        outs = jitted(*args)
+        return layout.Batch(batches[0].treedef, list(outs[1:]), outs[0])
+
+    def _compile_concat(self, k, caps, dtypes, nleaves, cap_out):
+        """Program: (counts x k, leaves x k) -> (total, leaves) with each
+        device's valid rows packed contiguously.  Writes go into a
+        sum(caps)-sized scratch (dynamic_update_slice never clamps:
+        offset_j + cap_j <= sum(caps[:j+1])), then slice to cap_out."""
+        key = ("concat", k, caps, dtypes, nleaves, cap_out)
+        if key in self._compiled:
+            return self._compiled[key]
+        scratch = max(sum(caps), cap_out)
+
+        def per_device(*args):
+            cnts = [c[0] for c in args[:k]]
+            leaves = args[k:]
+            total = cnts[0]
+            for j in range(1, k):
+                total = total + cnts[j]
+            outs = []
+            for li in range(nleaves):
+                segs = [leaves[j * nleaves + li][0] for j in range(k)]
+                buf = jnp.zeros((scratch,) + segs[0].shape[1:],
+                                segs[0].dtype)
+                off = jnp.int32(0)
+                for j in range(k):
+                    idx = (off,) + (0,) * (segs[j].ndim - 1)
+                    buf = jax.lax.dynamic_update_slice(
+                        buf, segs[j].astype(buf.dtype), idx)
+                    off = off + cnts[j].astype(jnp.int32)
+                outs.append(buf[:cap_out])
+            out = (jnp.asarray(total, jnp.int32),) + tuple(outs)
+            return tuple(jnp.expand_dims(o, 0) for o in out)
+
+        fn = _shard_map(per_device, self.mesh,
+                        in_specs=(P(AXIS),) * (k + k * nleaves),
+                        out_specs=(P(AXIS),) * (1 + nleaves))
+        jitted = jax.jit(fn)
+        self._compiled[key] = jitted
+        return jitted
 
     # ------------------------------------------------------------------
     # out-of-core streaming shuffle (SURVEY.md 7.2 item 4): input bigger

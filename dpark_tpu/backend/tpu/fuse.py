@@ -23,7 +23,8 @@ from dpark_tpu.rdd import (
     CSVFileRDD, CSVReaderRDD, DerivedRDD, FilteredRDD, FlatMappedRDD,
     FlatMappedValuesRDD, GZipFileRDD, KeyedRDD, MapPartitionsRDD,
     MappedRDD, MappedValuesRDD, ParallelCollection, ShuffledRDD,
-    TextFileRDD, _SortPartFn, _append, _extend, _identity, _mk_list)
+    TextFileRDD, UnionRDD, _SortPartFn, _append, _extend, _identity,
+    _mk_list)
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("tpu.fuse")
@@ -334,7 +335,8 @@ def extract_chain(top, cached_ids=()):
                 and isinstance(cur.f, _SortPartFn) and not cur.with_index:
             ops.append(SortOp(cur.f.ascending))
             cur = cur.prev
-        elif isinstance(cur, (ParallelCollection, ShuffledRDD)):
+        elif isinstance(cur, (ParallelCollection, ShuffledRDD,
+                              UnionRDD)):
             ops.reverse()
             return cur, ops, passthrough
         else:
@@ -642,6 +644,98 @@ def _numeric_key(specs):
     return shape == () and dt.kind in "if"
 
 
+# a union stage materializes every branch before concatenating on
+# device; bound the fan-in so one stage cannot pin arbitrarily many
+# parent batches in HBM at once
+MAX_UNION_SOURCES = 12
+
+
+def _analyze_union_parent(parent, ndev, executor_or_store, cached_ids,
+                          stage):
+    """Sub-plan (epilogue=None) turning ONE UnionRDD branch into a
+    device Batch of its post-ops rows, or None.  The windowed-stream
+    shape — union of per-batch reduceByKey outputs feeding another
+    reduceByKey — is all hbm branches (BASELINE config #4)."""
+    hbm_sids = getattr(executor_or_store, "shuffle_store",
+                       executor_or_store)
+    extracted = extract_chain(parent, cached_ids)
+    if extracted is None:
+        return None
+    src_rdd, ops, passthrough = extracted
+    src_combine = False
+    reslice = False
+    if src_rdd.id in cached_ids:
+        meta = executor_or_store.result_cache_meta(src_rdd.id)
+        treedef, specs = meta["treedef"], meta["specs"]
+        source = ("cached", src_rdd)
+    elif isinstance(src_rdd, ParallelCollection):
+        if src_rdd._slices is None:
+            return None
+        reslice = len(src_rdd._slices) != ndev
+        if _big_columnar(src_rdd):
+            # over-chunk inputs must ride the bounded wave stream; a
+            # union branch materializes in-core, pinning the whole
+            # batch (plus concat scratch) in HBM — decline
+            return None
+        sample = _sample_record(src_rdd)
+        if sample is None:
+            return None
+        try:
+            treedef, specs = layout.record_spec(sample)
+        except (TypeError, ValueError):
+            return None
+        for dt, _ in specs:
+            if dt == np.dtype(object) or dt.kind in "USO":
+                return None
+        source = ("ingest", src_rdd)
+    elif isinstance(src_rdd, ShuffledRDD):
+        dep = src_rdd.dep
+        if dep.shuffle_id not in hbm_sids:
+            return None
+        if dep.partitioner.num_partitions > ndev:
+            return None
+        meta = hbm_sids[dep.shuffle_id]
+        if "host_runs" in meta:
+            return None
+        if meta.get("encoded_keys"):
+            return None              # concat + later ops would leak ids
+        treedef, specs = meta["out_treedef"], meta["out_specs"]
+        if is_list_agg(dep.aggregator):
+            if not passthrough:
+                return None          # (k, [v]) lists cannot concat flat
+        else:
+            src_combine = True
+            try:
+                merge_fn = _leaves_merge_fn(
+                    dep.aggregator.merge_combiners, treedef)
+                vstructs = _batched_spec_struct(specs[1:])
+                jax.eval_shape(
+                    lambda *v: merge_fn(list(v), list(v)), *vstructs)
+            except Exception as e:
+                logger.debug("union branch merge untraceable: %s", e)
+                return None
+        source = ("hbm", dep)
+    else:
+        return None
+    cur_treedef, cur_specs = treedef, specs
+    try:
+        for op in ops:
+            cur_treedef, cur_specs = op.probe(cur_treedef, cur_specs)
+    except Exception as e:
+        logger.debug("union branch not traceable (%s)", e)
+        return None
+    sub = StagePlan(source, ops, None, treedef, specs,
+                    cur_treedef, cur_specs, stage)
+    sub.src_combine = src_combine
+    sub.group_output = False
+    sub.epi_spec = None
+    sub.epi_bounds = None
+    sub.logical_spill = False
+    sub.reslice = reslice
+    sub.program_key = sub.program_key + (src_combine, False, None)
+    return sub
+
+
 def analyze_stage(stage, ndev, executor_or_store):
     """Decide whether `stage` can run on the array path; build its plan.
 
@@ -669,13 +763,24 @@ def analyze_stage(stage, ndev, executor_or_store):
         return None
 
     # -- source record spec ---------------------------------------------
+    reslice = False
     if source_rdd.id in cached_ids:
         meta = executor_or_store.result_cache_meta(source_rdd.id)
         treedef, specs = meta["treedef"], meta["specs"]
         source = ("cached", source_rdd)
         src_combine = False
     elif isinstance(source_rdd, ParallelCollection):
-        if source_rdd._slices is None or len(source_rdd._slices) != ndev:
+        if source_rdd._slices is None:
+            return None
+        reslice = len(source_rdd._slices) != ndev
+        if reslice and (not stage.is_shuffle_map
+                        or _big_columnar(source_rdd)):
+            # result-stage tasks index the RDD's own partition layout;
+            # the wave stream consumes slices as-is — both need the
+            # exact slicing.  A shuffle write redistributes by key, so
+            # the executor re-slices the host rows to the mesh instead
+            # of declining (e.g. parallelize(data, 2).reduceByKey on an
+            # 8-device mesh — the DStream queue batch shape).
             return None
         sample = _sample_record(source_rdd)
         if sample is None:
@@ -726,6 +831,28 @@ def analyze_stage(stage, ndev, executor_or_store):
                 logger.debug("merge_combiners not traceable: %s", e)
                 return None
         source = ("hbm", dep)
+    elif isinstance(source_rdd, UnionRDD):
+        if not stage.is_shuffle_map:
+            return None          # result tasks index the union's splits
+        parents = source_rdd.rdds
+        if not parents or len(parents) > MAX_UNION_SOURCES:
+            return None
+        subs = []
+        for p in parents:
+            sub = _analyze_union_parent(p, ndev, executor_or_store,
+                                        cached_ids, stage)
+            if sub is None:
+                return None
+            subs.append(sub)
+        t0 = subs[0].out_treedef
+        s0 = [(str(dt), shape) for dt, shape in subs[0].out_specs]
+        for sub in subs[1:]:
+            if sub.out_treedef != t0 or s0 != [
+                    (str(dt), shape) for dt, shape in sub.out_specs]:
+                return None      # branches must agree on record type
+        treedef, specs = subs[0].out_treedef, subs[0].out_specs
+        source = ("union", tuple(subs))
+        src_combine = False
     else:
         return None
 
@@ -793,6 +920,7 @@ def analyze_stage(stage, ndev, executor_or_store):
     plan.epi_spec = epi_spec
     plan.epi_bounds = epi_bounds
     plan.logical_spill = logical_spill
+    plan.reslice = reslice
     plan.program_key = plan.program_key + (
         src_combine, group_output, epi_spec)
     return plan

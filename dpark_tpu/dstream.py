@@ -780,7 +780,10 @@ class QueueInputDStream(InputDStream):
         from dpark_tpu.rdd import RDD
         if isinstance(item, RDD):
             return item
-        return self.ssc.ctx.parallelize(item, 2)
+        # default parallelism (== the device mesh on the tpu master):
+        # a hardcoded slice count forfeited the array path for every
+        # queue batch
+        return self.ssc.ctx.parallelize(item)
 
     def compute(self, t):
         if self.queue:

@@ -595,3 +595,112 @@ def test_egest_oversize_warning(tctx, caplog):
                    for r in caplog.records)
     finally:
         conf.EGEST_WARN_BYTES = old
+
+
+def _stage_kinds(tctx):
+    """{rdd_name: kind} for the LAST job's stages."""
+    rec = tctx.scheduler.history[-1]
+    return {s["rdd"]: s.get("kind") for s in rec["stage_info"]}
+
+
+def test_union_of_shuffles_rides_device(tctx):
+    """A union of reduceByKey outputs feeding another reduceByKey (the
+    windowed-stream shape, BASELINE config #4) runs the UNION stage on
+    the array path: branches materialize as device batches, concatenate
+    on device, and the shuffle write rides the mesh."""
+    import operator
+    rows = [(i % 50, i % 7) for i in range(5000)]
+    b1 = tctx.parallelize(rows, 8).reduceByKey(operator.add, 8)
+    b2 = tctx.parallelize(rows, 8).reduceByKey(operator.add, 8)
+    got = dict(b1.union(b2).reduceByKey(operator.add, 8).collect())
+    exp = {}
+    for k, v in rows + rows:
+        exp[k] = exp.get(k, 0) + v
+    assert got == exp
+    kinds = _stage_kinds(tctx)
+    assert kinds.get("UnionRDD") == "array", kinds
+
+
+def test_union_mixed_ingest_and_shuffle_branches(tctx):
+    """Union branches may mix raw parallelize input with reduced HBM
+    shuffles (cold-start window shape); narrow ops on a branch apply
+    before the concat."""
+    import operator
+    rows = [(i % 50, 1) for i in range(4000)]
+    reduced = tctx.parallelize(rows, 8).reduceByKey(operator.add, 8) \
+        .mapValue(lambda v: v * 10)
+    raw = tctx.parallelize(rows, 8)
+    got = dict(raw.union(reduced).reduceByKey(operator.add, 8)
+               .collect())
+    exp = {}
+    for k, v in rows:
+        exp[k] = exp.get(k, 0) + v
+    exp = {k: v + v * 10 for k, v in exp.items()}
+    assert got == exp
+    kinds = _stage_kinds(tctx)
+    assert kinds.get("UnionRDD") == "array", kinds
+
+
+def test_union_result_stage_stays_host(tctx):
+    """collect() directly over a union (result stage) keeps the object
+    path — result tasks index the union's own partition layout."""
+    import operator
+    rows = [(i % 20, 1) for i in range(800)]
+    b1 = tctx.parallelize(rows, 8).reduceByKey(operator.add, 8)
+    b2 = tctx.parallelize(rows, 8).reduceByKey(operator.add, 8)
+    got = sorted(b1.union(b2).collect())
+    exp = {}
+    for k, v in rows:
+        exp[k] = exp.get(k, 0) + v
+    assert got == sorted(list(exp.items()) * 2)
+    kinds = _stage_kinds(tctx)
+    assert kinds.get("UnionRDD") != "array", kinds
+
+
+def test_reslice_wrong_slice_count_rides_device(tctx):
+    """parallelize with numSlices != mesh width feeding a shuffle write
+    re-slices host-side onto the mesh instead of declining the array
+    path (the DStream queue-batch shape)."""
+    import operator
+    rows = [(i % 64, i % 5) for i in range(6000)]
+    for nsl in (2, 3, 16):
+        r = tctx.parallelize(rows, nsl).reduceByKey(operator.add, 8)
+        got = dict(r.collect())
+        exp = {}
+        for k, v in rows:
+            exp[k] = exp.get(k, 0) + v
+        assert got == exp, nsl
+        kinds = _stage_kinds(tctx)
+        assert kinds.get("ParallelCollection") == "array", (nsl, kinds)
+
+
+def test_union_shuffle_feeds_object_consumer(tctx):
+    """An OBJECT-path stage consuming a union-written shuffle fetches
+    through the single_map export (device rows don't correspond to the
+    union's 2x map partitions; without the flag every fetch failed and
+    the scheduler resubmitted the parent forever)."""
+    import operator
+    rows = [(i % 30, 1) for i in range(3000)]
+    b1 = tctx.parallelize(rows, 8).reduceByKey(operator.add, 8)
+    b2 = tctx.parallelize(rows, 8).reduceByKey(operator.add, 8)
+    u = b1.union(b2).reduceByKey(operator.add, 8)
+    # str() is untraceable -> this stage runs object tasks that FETCH
+    # the union's map outputs through the host bridge
+    got = dict(u.map(lambda kv: (kv[0], str(kv[1]))).collect())
+    exp = {}
+    for k, v in rows:
+        exp[k] = exp.get(k, 0) + v
+    assert got == {k: str(v * 2) for k, v in exp.items()}
+
+
+def test_resliced_shuffle_feeds_object_consumer(tctx):
+    """Same single_map guarantee for resliced ingest: 2 logical map
+    partitions redistributed over 8 devices, consumed by object tasks."""
+    import operator
+    rows = [(i % 40, i % 3) for i in range(4000)]
+    r = tctx.parallelize(rows, 2).reduceByKey(operator.add, 8)
+    got = dict(r.map(lambda kv: (kv[0], str(kv[1]))).collect())
+    exp = {}
+    for k, v in rows:
+        exp[k] = exp.get(k, 0) + v
+    assert got == {k: str(v) for k, v in exp.items()}
