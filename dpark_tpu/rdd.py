@@ -194,9 +194,21 @@ class RDD:
         return SampleRDD(self, withReplacement, fraction, seed)
 
     def union(self, *others):
-        rdds = [self]
+        # flatten unions on BOTH sides: a.union(b).union(c) must build
+        # one flat UnionRDD (nested unions defeat the array path's
+        # union-source analysis, and flat is equivalent row-wise).
+        # Never flatten THROUGH a checkpointed/snapshotted/cached union
+        # — reading its .rdds would resurrect the truncated lineage
+        def flat(r):
+            if (isinstance(r, UnionRDD)
+                    and r._checkpoint_rdd is None
+                    and getattr(r, "_snapshot_path", None) is None
+                    and not r.should_cache):
+                return list(r.rdds)
+            return [r]
+        rdds = flat(self)
         for o in others:
-            rdds.extend(o.rdds if isinstance(o, UnionRDD) else [o])
+            rdds.extend(flat(o))
         return UnionRDD(self.ctx, rdds)
 
     def __add__(self, other):

@@ -281,3 +281,57 @@ def test_recovery_timeline_rebase(ctx, tmp_path):
         (t, rdd.collect()))
     ssc2.run_batch(50001.0)
     assert dict(sink[-1][1]) == {"k": 11}    # state carried across gap
+
+
+def test_linear_window_rides_device_end_to_end():
+    """(add, sub) reduceByKeyAndWindow rewrites the incremental update
+    to prev + new - old as ONE flat union-reduce, so on the tpu master
+    EVERY stage of the steady-state window rides the array path —
+    asserted by stage kinds, with values matching the local master."""
+    from dpark_tpu import DparkContext
+
+    def drive(master):
+        c = DparkContext(master)
+        ssc = make_ssc(c, batch=1.0)
+        out = []
+        batches = [[(i % 7, i % 5) for i in range(j * 31, j * 31 + 200)]
+                   for j in range(5)]
+        q = ssc.queueStream(batches)
+        q.reduceByKeyAndWindow(operator.add, 2.0,
+                               invFunc=operator.sub).collect_batches(out)
+        run_batches(ssc, 5)
+        kinds = set()
+        for rec in c.scheduler.history:
+            for s in rec.get("stage_info", []):
+                # the one-time numeric value probe is a one-partition
+                # take(1) job — single-task stages run object tasks by
+                # design; every REAL window stage must be array
+                if rec.get("parts") == 1:
+                    continue
+                kinds.add((s["rdd"], s.get("kind")))
+        c.stop()
+        return [sorted(v) for _, v in out], kinds
+
+    got, kinds = drive("tpu")
+    exp, _ = drive("local")
+    assert got == exp
+    assert {k for k, v in kinds} >= {"UnionRDD", "ShuffledRDD",
+                                     "ParallelCollection"}, kinds
+    assert {v for k, v in kinds} == {"array"}, kinds
+
+
+def test_counter_window_keeps_join_semantics(ctx):
+    """Counter supports + and - but is NOT a group (its - saturates at
+    zero), so the (add, sub) linear rewrite must not apply — the value
+    probe keeps such streams on the leftOuterJoin path (r4 review)."""
+    from collections import Counter
+    ssc = make_ssc(ctx, batch=1.0)
+    out = []
+    q = ssc.queueStream([[("k", Counter(a=1))], [("k", Counter(a=2))],
+                         [("k", Counter(a=4))], [("k", Counter(a=8))]])
+    q.reduceByKeyAndWindow(operator.add, 2.0,
+                           invFunc=operator.sub).collect_batches(out)
+    run_batches(ssc, 4)
+    assert [dict(v) for _, v in out] == [
+        {"k": Counter(a=1)}, {"k": Counter(a=3)},
+        {"k": Counter(a=6)}, {"k": Counter(a=12)}]

@@ -369,3 +369,19 @@ def test_snapshot_on_tpu_master(tmp_path):
         assert sorted(r.collect()) == list(range(1, 41))
     finally:
         c.stop()
+
+
+def test_union_does_not_flatten_through_checkpoint(ctx, tmp_path):
+    """a.union(b).checkpoint() truncates lineage; a later .union(c)
+    must read the checkpointed union, not resurrect its parents
+    (r4 review finding)."""
+    a = ctx.parallelize([1, 2], 2)
+    b = ctx.parallelize([3, 4], 2)
+    u = a.union(b)
+    u.checkpoint(str(tmp_path / "ck"))
+    u.collect()                          # materialize the checkpoint
+    w = u.union(ctx.parallelize([5], 1))
+    from dpark_tpu.rdd import UnionRDD
+    assert isinstance(w.rdds[0], UnionRDD) or len(w.rdds) == 2, \
+        [type(r).__name__ for r in w.rdds]
+    assert sorted(w.collect()) == [1, 2, 3, 4, 5]
