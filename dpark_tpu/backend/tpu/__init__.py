@@ -101,7 +101,10 @@ class TPUScheduler(DAGScheduler):
 
     def _resident_nocombine_deps(self, cg):
         """All of a CoGroupedRDD's inputs as HBM-resident no-combine
-        shuffle deps, or None (narrow side / host-resident / combining)."""
+        shuffle deps, or None (narrow side / host-resident / combining).
+        Keep in sync with fuse._analyze_join_source, the array-path twin
+        of this eligibility (it additionally rejects encoded keys and
+        r > mesh, which the host-seeding paths here tolerate)."""
         from dpark_tpu.backend.tpu import fuse
         deps = []
         for kind, obj in cg._dep_kinds:
@@ -207,7 +210,17 @@ class TPUScheduler(DAGScheduler):
 
     def _run_array_stage(self, stage, tasks, plan, report):
         import time as _time
+        from dpark_tpu.rdd import _count_iter
         t0 = _time.time()
+        # count() needs no rows on the driver — the object path sums
+        # per-executor counts, and the array path can answer straight
+        # from the device counts leaf, skipping the whole egest (on a
+        # tunneled chip that is the difference between one scalar read
+        # and streaming every row at ~37 MB/s)
+        plan.count_only = (not stage.is_shuffle_map and bool(tasks)
+                           and all(isinstance(t, ResultTask)
+                                   and t.func is _count_iter
+                                   for t in tasks))
         wire0 = self.executor.exchange_wire_bytes
         real0 = self.executor.exchange_real_rows
         slot0 = self.executor.exchange_slot_rows
@@ -241,6 +254,10 @@ class TPUScheduler(DAGScheduler):
             uri = "hbm://%d" % result
             for task in tasks:
                 report(task, "success", (uri, {}, {}))
+        elif kind == "counts":
+            note["kind"] = "array+counts"    # observable: no egest ran
+            for task in tasks:
+                report(task, "success", (result[task.partition], {}, {}))
         else:
             rows_per_part = result
             for task in tasks:

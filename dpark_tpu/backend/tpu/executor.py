@@ -511,6 +511,10 @@ class JAXExecutor:
             if keyed:
                 self._check_cached_keys(batch)
             return self._run_narrow(plan, batch)
+        if plan.source[0] == "join":
+            dep_a, dep_b = plan.source[1]
+            batch = self.device_join_batch(dep_a, dep_b)
+            return self._run_narrow(plan, batch)
         if self.shuffle_store[plan.source[1].shuffle_id].get(
                 "pre_reduced"):
             # streamed shuffle already exchanged+combined: device d
@@ -819,6 +823,13 @@ class JAXExecutor:
                 # device stage would see raw ids where the user expects
                 # strings
                 self.store_result(plan.stage.rdd.id, batch)
+            if getattr(plan, "count_only", False) \
+                    and not plan.group_output:
+                # count() consumes only cardinalities: one scalar-leaf
+                # read instead of egesting every row (group_output
+                # counts KEYS, not rows — those still egest)
+                counts = layout.host_read(batch.counts)
+                return ("counts", [int(c) for c in counts])
             rows_per_part = layout.egest(batch)
             if plan.group_output:
                 # bare groupByKey: rows arrive key-sorted; group runs
@@ -1524,6 +1535,21 @@ class JAXExecutor:
         """Per-partition inner join of two HBM-resident no-combine
         shuffles; returns per-partition host rows (k, (va, vb))."""
         store_a = self.shuffle_store[dep_a.shuffle_id]
+        batch = self.device_join_batch(dep_a, dep_b)
+        rows_per_part = layout.egest(batch)
+        if store_a.get("encoded_keys"):
+            # both sides of a str-keyed join encode through the SAME
+            # executor dict, so id equality == string equality; decode
+            # at this host exit like every other
+            rows_per_part = [self._maybe_decode(store_a, rows)
+                             for rows in rows_per_part]
+        return rows_per_part
+
+    def device_join_batch(self, dep_a, dep_b):
+        """Inner join of two HBM no-combine shuffles as a device Batch
+        of (k, (va, vb)) rows — the array-path "join" source (keys stay
+        on device; downstream ops + shuffle writes ride the mesh)."""
+        store_a = self.shuffle_store[dep_a.shuffle_id]
         store_b = self.shuffle_store[dep_b.shuffle_id]
         if store_a.get("encoded_keys", False) != \
                 store_b.get("encoded_keys", False):
@@ -1591,7 +1617,7 @@ class JAXExecutor:
         outs = self._compiled[exp_key](cnt_a, cnt_b, *lv_a, *lv_b)
         counts, leaves = outs[0], list(outs[1:])
 
-        # egest rows (k, va..., vb...) and rebuild (k, (va, vb)) records
+        # rows are (k, va..., vb...); records are (k, (va, vb))
         import jax.tree_util as jtu
         ta = store_a["out_treedef"]
         tb = store_b["out_treedef"]
@@ -1599,15 +1625,7 @@ class JAXExecutor:
         sample_b = jtu.tree_unflatten(tb, list(range(nb)))
         joined_sample = (0, (sample_a[1], sample_b[1]))
         out_treedef = jtu.tree_structure(joined_sample)
-        batch = layout.Batch(out_treedef, leaves, counts)
-        rows_per_part = layout.egest(batch)
-        if store_a.get("encoded_keys"):
-            # both sides of a str-keyed join encode through the SAME
-            # executor dict, so id equality == string equality; decode
-            # at this host exit like every other
-            rows_per_part = [self._maybe_decode(store_a, rows)
-                             for rows in rows_per_part]
-        return rows_per_part
+        return layout.Batch(out_treedef, leaves, counts)
 
     # ------------------------------------------------------------------
     # host bridge

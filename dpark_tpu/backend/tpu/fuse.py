@@ -20,11 +20,11 @@ import jax.numpy as jnp
 from dpark_tpu.backend.tpu import layout
 from dpark_tpu.dependency import HashPartitioner, RangePartitioner
 from dpark_tpu.rdd import (
-    CSVFileRDD, CSVReaderRDD, DerivedRDD, FilteredRDD, FlatMappedRDD,
-    FlatMappedValuesRDD, GZipFileRDD, KeyedRDD, MapPartitionsRDD,
-    MappedRDD, MappedValuesRDD, ParallelCollection, ShuffledRDD,
-    TextFileRDD, UnionRDD, _SortPartFn, _append, _extend, _identity,
-    _mk_list)
+    CoGroupedRDD, CSVFileRDD, CSVReaderRDD, DerivedRDD, FilteredRDD,
+    FlatMappedRDD, FlatMappedValuesRDD, GZipFileRDD, KeyedRDD,
+    MapPartitionsRDD, MappedRDD, MappedValuesRDD, ParallelCollection,
+    ShuffledRDD, TextFileRDD, UnionRDD, _SortPartFn, _append, _extend,
+    _identity, _join_values, _mk_list)
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("tpu.fuse")
@@ -310,6 +310,15 @@ def extract_chain(top, cached_ids=()):
             # fusing past it would silently skip both
             return None
         if cur.id in cached_ids:
+            ops.reverse()
+            return cur, ops, passthrough
+        if isinstance(cur, FlatMappedValuesRDD) \
+                and cur.f is _join_values \
+                and isinstance(cur.prev, CoGroupedRDD) \
+                and len(cur.prev.rdds) == 2:
+            # a.join(b): terminates the chain — analyze_stage checks
+            # both cogroup inputs are HBM-resident and makes this a
+            # device "join" source (expand on device, no host rows)
             ops.reverse()
             return cur, ops, passthrough
         if isinstance(cur, FlatMappedValuesRDD) and cur.f is _identity \
@@ -736,6 +745,51 @@ def _analyze_union_parent(parent, ndev, executor_or_store, cached_ids,
     return sub
 
 
+def _analyze_join_source(join_rdd, ndev, executor_or_store):
+    """(treedef, specs, (dep_a, dep_b)) for an a.join(b) chain source
+    whose cogroup inputs are both HBM-resident plain (k, v) no-combine
+    shuffles, else None.  Mirrors the eligibility the driver-seeded
+    join precompute enforces, but keeps the expansion ON DEVICE as an
+    array-path source."""
+    import jax.tree_util as jtu
+    hbm_sids = getattr(executor_or_store, "shuffle_store",
+                       executor_or_store)
+    cg = join_rdd.prev
+    deps = []
+    for kind, obj in cg._dep_kinds:
+        if kind != "shuffle" or not is_list_agg(obj.aggregator):
+            return None
+        if obj.shuffle_id not in hbm_sids:
+            return None
+        meta = hbm_sids[obj.shuffle_id]
+        if "host_runs" in meta or meta.get("encoded_keys"):
+            # encoded ids must not feed further device ops (the ids
+            # would leak into user compute); host path decodes
+            return None
+        deps.append(obj)
+    if len(deps) != 2:
+        return None
+    if deps[0].partitioner.num_partitions > ndev:
+        return None
+    metas = [hbm_sids[d.shuffle_id] for d in deps]
+    samples = []
+    for meta in metas:
+        sample = jtu.tree_unflatten(
+            meta["out_treedef"], list(range(len(meta["out_specs"]))))
+        if not (isinstance(sample, tuple) and len(sample) == 2
+                and sample[0] == 0):
+            return None              # join kernels need (k, v) records
+        if meta["out_specs"][0][1] != ():
+            return None
+        samples.append(sample)
+    joined = (0, (samples[0][1], samples[1][1]))
+    treedef = jtu.tree_structure(joined)
+    specs = ([metas[0]["out_specs"][0]]
+             + list(metas[0]["out_specs"][1:])
+             + list(metas[1]["out_specs"][1:]))
+    return treedef, specs, (deps[0], deps[1])
+
+
 def analyze_stage(stage, ndev, executor_or_store):
     """Decide whether `stage` can run on the array path; build its plan.
 
@@ -852,6 +906,15 @@ def analyze_stage(stage, ndev, executor_or_store):
                 return None      # branches must agree on record type
         treedef, specs = subs[0].out_treedef, subs[0].out_specs
         source = ("union", tuple(subs))
+        src_combine = False
+    elif isinstance(source_rdd, FlatMappedValuesRDD):
+        # extract_chain only terminates here for the a.join(b) shape
+        joined = _analyze_join_source(source_rdd, ndev,
+                                      executor_or_store)
+        if joined is None:
+            return None
+        treedef, specs, deps = joined
+        source = ("join", deps)
         src_combine = False
     else:
         return None

@@ -704,3 +704,42 @@ def test_resliced_shuffle_feeds_object_consumer(tctx):
     for k, v in rows:
         exp[k] = exp.get(k, 0) + v
     assert got == {k: str(v) for k, v in exp.items()}
+
+
+def test_join_source_pipeline_rides_device(tctx):
+    """a.join(b) feeding further ops + a shuffle write runs the join
+    as an array-path SOURCE (device expansion, no host rows): the
+    TPC-H-shaped join->map->reduce pipeline is all-array."""
+    import operator
+    fact = [(i % 50, i % 7) for i in range(20000)]
+    dim = [(i, i * 3) for i in range(50)]
+    a = tctx.parallelize(fact, 8)
+    b = tctx.parallelize(dim, 8)
+    got = dict(a.join(b, 8)
+               .map(lambda kv: (kv[0], kv[1][0] * kv[1][1]))
+               .reduceByKey(operator.add, 8).collect())
+    exp = {}
+    for k, v in fact:
+        exp[k] = exp.get(k, 0) + v * (k * 3)
+    assert got == exp
+    kinds = _stage_kinds(tctx)
+    assert set(kinds.values()) == {"array"}, kinds
+    assert "MappedRDD" in kinds, kinds    # the join-source stage's top
+
+
+def test_count_answers_from_device_counts(tctx):
+    """count() over an array result stage reads only the counts leaf
+    (no row egest — note kind 'array+counts') and still matches the
+    object path exactly; groupByKey counts KEYS and must keep
+    egesting."""
+    import operator
+    rows = [(i % 100, i % 7) for i in range(30000)]
+    assert tctx.parallelize(rows, 8).filter(
+        lambda kv: kv[0] < 10).count() == 3000
+    assert _stage_kinds(tctx).get("FilteredRDD") == "array+counts"
+    assert tctx.parallelize(rows, 8).reduceByKey(
+        operator.add, 8).count() == 100
+    assert _stage_kinds(tctx).get("ShuffledRDD") == "array+counts"
+    assert tctx.parallelize(rows, 8).groupByKey(8).count() == 100
+    assert _stage_kinds(tctx).get("FlatMappedValuesRDD") \
+        != "array+counts"                     # group counts must egest
