@@ -7,6 +7,15 @@ a TPU master where stages compile to jitted SPMD programs over a jax device
 mesh and shuffles run as ICI collectives (see SURVEY.md and backend/tpu/).
 """
 
+from dpark_tpu.utils import apply_platform_override
+
+# honor DPARK_TPU_PLATFORM for EVERY master before any jax backend
+# init: user code may call jnp on the local/process masters too, and
+# without the override their first jnp call dials the real device
+# backend — which hangs forever on a wedged tunnel.  No-op unless the
+# env var is set.
+apply_platform_override()
+
 from dpark_tpu.context import DparkContext, optParser, parse_options
 from dpark_tpu.rdd import Columns
 

@@ -12,6 +12,7 @@ from dpark_tpu import DparkContext, optParser
 
 
 def make_assign(centers):
+    import jax
     import jax.numpy as jnp
     cx = jnp.asarray([c[0] for c in centers])
     cy = jnp.asarray([c[1] for c in centers])
@@ -20,6 +21,10 @@ def make_assign(centers):
         x, y = p
         d = (x - cx) ** 2 + (y - cy) ** 2
         k = jnp.argmin(d)
+        if not isinstance(k, jax.core.Tracer):
+            # host masters bucket by hash(key): a concrete jnp scalar
+            # is unhashable — the device trace keeps it traced
+            k = int(k)
         return (k, (x, y, 1))
     return assign
 
