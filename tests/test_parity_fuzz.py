@@ -11,7 +11,7 @@ import pytest
 
 OPS = ["map_affine", "filter_mod", "map_swap", "reduce_sum", "reduce_min",
        "reduce_max", "group", "sort", "distinct_keys", "count_tail",
-       "union_extra", "host_partitions"]
+       "union_extra", "host_partitions", "join_dim"]
 
 
 def build_program(rng, depth=4):
@@ -35,6 +35,11 @@ def build_program(rng, depth=4):
             # an untraceable op: forces THIS stage onto the object path,
             # exercising the HBM export bridge mid-pipeline
             prog.append(("host_partitions",))
+        elif op == "join_dim":
+            # inner join with a small dim table, values flattened back
+            # to ints — exercises the device join source + downstream
+            prog.append(("join_dim", rng.randint(2, 40),
+                         rng.choice([2, 4, 8])))
         elif op in ("reduce_sum", "reduce_min", "reduce_max", "group",
                     "sort", "distinct_keys"):
             if shuffled and rng.random() < 0.5:
@@ -80,6 +85,12 @@ def apply_program(ctx, data, prog):
             r = r.union(ctx.parallelize(extra, 8))
         elif op == "host_partitions":
             r = r.mapPartitions(lambda it: list(it))
+        elif op == "join_dim":
+            _, ksp, nsp = step
+            dim = [(i - ksp // 2, i * 3 + 1) for i in range(ksp)]
+            r = (r.map(lambda kv, m=ksp: (kv[0] % m - m // 2, kv[1]))
+                 .join(ctx.parallelize(dim, 8), nsp)
+                 .map(lambda kv: (kv[0], kv[1][0] + kv[1][1])))
     return r
 
 
