@@ -11,7 +11,9 @@ throughput measured in the same session — distance to "actually fast",
 not just distance to the CPU baseline (VERDICT r3 #5).
 
 Additional lines: out-of-core reduceByKey, join/cogroup (BASELINE config
-#2), DStream reduceByKeyAndWindow (config #4).
+#2), DStream reduceByKeyAndWindow (config #4), file wordcount (config
+#0), sortByKey+groupByKey (config #1) — every row of BASELINE.md's
+configs table emits a JSON line.
 
 The process runs execute FIRST, before jax is imported, so their fork
 pools are jax-free (fork after jax import can deadlock).
@@ -237,6 +239,120 @@ def _join_phase():
           flush=True)
 
 
+# BASELINE config #0: wordcount over a REAL text file
+# (textFile -> flatMap -> map -> reduceByKey), deterministic corpus.
+WC_MB = float(os.environ.get("BENCH_WC_MB", 64))
+WC_MB_DEVICE_DEFAULT = 512.0
+WC_WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta",
+            "eta", "theta", "iota", "kappa", "lam", "mu", "nu", "xi"]
+
+
+def _wc_corpus():
+    import hashlib
+    tag = hashlib.md5(("wc-%s" % WC_MB).encode()).hexdigest()[:8]
+    path = "/tmp/dpark_bench_wc_%s.txt" % tag
+    if not os.path.exists(path):
+        import random as _random
+        rng = _random.Random(11)
+        target = int(WC_MB * (1 << 20))
+        with open(path + ".tmp", "w") as f:
+            written = 0
+            while written < target:
+                line = " ".join(rng.choices(WC_WORDS, k=10)) + "\n"
+                f.write(line)
+                written += len(line)
+        os.replace(path + ".tmp", path)
+    return path
+
+
+def _wc_run(ctx, path):
+    t0 = time.perf_counter()
+    n = (ctx.textFile(path)
+         .flatMap(lambda line: line.split())
+         .map(lambda w: (w, 1))
+         .reduceByKey(lambda a, b: a + b, 8).count())
+    dt = time.perf_counter() - t0
+    assert n == len(WC_WORDS), (n, len(WC_WORDS))
+    return dt
+
+
+def bench_wc_process(path):
+    from dpark_tpu import DparkContext
+    nproc = min(8, os.cpu_count() or 4)
+    ctx = DparkContext("process:%d" % nproc)
+    ctx.start()
+    dt = _wc_run(ctx, path)
+    ctx.stop()
+    return dt
+
+
+def _wc_phase():
+    """Child-process entry: tpu wordcount (BASELINE config #0)."""
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import DparkContext
+    path = _wc_corpus()
+    ctx = DparkContext("tpu")
+    ctx.start()
+    _wc_run(ctx, path)                            # warm-up compile
+    dt = _wc_run(ctx, path)
+    ctx.stop()
+    print("WC_RESULT %s" % json.dumps({"t": dt}), flush=True)
+
+
+# BASELINE config #1: sortByKey + groupByKey over synthetic (int, int)
+# pairs — the no-combine exchange paths (range + hash).
+SG_PAIRS = int(os.environ.get("BENCH_SG_PAIRS", 2_000_000))
+SG_PAIRS_DEVICE_DEFAULT = 10_000_000
+SG_KEYS = 100_000
+
+
+def make_sg_data():
+    import numpy as np
+    from dpark_tpu import Columns
+    i = np.arange(SG_PAIRS, dtype=np.int64)
+    return Columns((i * 2654435761) % SG_KEYS, i & 0xFFFF)
+
+
+def _sg_run(ctx, data, n_parts):
+    t0 = time.perf_counter()
+    r = ctx.parallelize(data, n_parts)
+    ns = r.sortByKey(numSplits=n_parts).count()
+    ng = r.groupByKey(n_parts).count()
+    dt = time.perf_counter() - t0
+    assert ns == SG_PAIRS and ng == min(SG_KEYS, SG_PAIRS), (ns, ng)
+    return dt
+
+
+def bench_sg_process():
+    from dpark_tpu import DparkContext
+    data = make_sg_data()
+    nproc = min(8, os.cpu_count() or 4)
+    ctx = DparkContext("process:%d" % nproc)
+    ctx.start()
+    dt = _sg_run(ctx, data, nproc)
+    ctx.stop()
+    return dt
+
+
+def _sg_phase():
+    """Child-process entry: tpu sortByKey+groupByKey (config #1)."""
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import DparkContext
+    data = make_sg_data()
+    ctx = DparkContext("tpu")
+    ctx.start()
+    ndev = ctx.scheduler.executor.ndev
+    _sg_run(ctx, data, ndev)                      # warm-up compile
+    dt = _sg_run(ctx, data, ndev)
+    ctx.stop()
+    print("SG_RESULT %s" % json.dumps({"t": dt, "ndev": ndev}),
+          flush=True)
+
+
 # BASELINE config #4: DStream reduceByKeyAndWindow micro-batches.
 # records per batch x batches, 2-batch window with inverse-reduce.
 STREAM_RECS = int(os.environ.get("BENCH_STREAM_RECS", 200_000))
@@ -403,6 +519,12 @@ def main():
     if "--stream-only" in sys.argv:
         _stream_phase()
         return
+    if "--wc-only" in sys.argv:
+        _wc_phase()
+        return
+    if "--sg-only" in sys.argv:
+        _sg_phase()
+        return
     if "--probe" in sys.argv:
         _probe_phase()
         return
@@ -411,7 +533,7 @@ def main():
     # see _device_reachable) before the emulated fallback.
     # An explicitly requested platform (BENCH_PLATFORM=cpu in CI) keeps
     # the toy size — only an actual device earns the big run.
-    global JOIN_FACT
+    global JOIN_FACT, WC_MB, SG_PAIRS
     reachable = _device_reachable()
     if reachable and os.environ.get("BENCH_PLATFORM") is None:
         if "BENCH_PAIRS" not in os.environ:
@@ -421,12 +543,20 @@ def main():
         if "BENCH_JOIN_FACT" not in os.environ:
             JOIN_FACT = JOIN_FACT_DEVICE_DEFAULT
             os.environ["BENCH_JOIN_FACT"] = str(JOIN_FACT)
+        if "BENCH_WC_MB" not in os.environ:
+            WC_MB = WC_MB_DEVICE_DEFAULT
+            os.environ["BENCH_WC_MB"] = str(WC_MB)
+        if "BENCH_SG_PAIRS" not in os.environ:
+            SG_PAIRS = SG_PAIRS_DEVICE_DEFAULT
+            os.environ["BENCH_SG_PAIRS"] = str(SG_PAIRS)
     data = make_data()
     t_proc = bench_process(data)
     del data                 # the child regenerates its own copy
     extras = os.environ.get("BENCH_EXTRAS", "1") != "0"
     t_join_proc = bench_join_process() if extras else None
     t_stream_proc = bench_stream_process() if extras else None
+    t_wc_proc = bench_wc_process(_wc_corpus()) if extras else None
+    t_sg_proc = bench_sg_process() if extras else None
     emulated = False
     tpu = None
     if reachable:
@@ -546,6 +676,32 @@ def main():
         if emulated:
             sout["emulated_cpu_mesh"] = True
         print(json.dumps(sout))
+    # fifth line: file wordcount, BASELINE config #0
+    got = _run_child("--wc-only", child_timeout,
+                     env=extra_env, ok_prefix="WC_RESULT ")
+    if got is not None:
+        w = json.loads(got)
+        wout = {"metric": _suffix("wordcount_MBps"),
+                "value": round(WC_MB / w["t"], 2),
+                "unit": "MB/s",
+                "vs_baseline": round(t_wc_proc / w["t"], 2),
+                "corpus_mb": WC_MB}
+        if emulated:
+            wout["emulated_cpu_mesh"] = True
+        print(json.dumps(wout))
+    # sixth line: sortByKey + groupByKey, BASELINE config #1
+    got = _run_child("--sg-only", child_timeout,
+                     env=extra_env, ok_prefix="SG_RESULT ")
+    if got is not None:
+        g = json.loads(got)
+        gout = {"metric": _suffix("sortgroup_Mpairs_per_s"),
+                "value": round(SG_PAIRS / g["t"] / 1e6, 4),
+                "unit": "Mpairs/s",
+                "vs_baseline": round(t_sg_proc / g["t"], 2),
+                "pairs": SG_PAIRS, "chips": g.get("ndev")}
+        if emulated:
+            gout["emulated_cpu_mesh"] = True
+        print(json.dumps(gout))
 
 
 if __name__ == "__main__":

@@ -823,12 +823,17 @@ class JAXExecutor:
                 # device stage would see raw ids where the user expects
                 # strings
                 self.store_result(plan.stage.rdd.id, batch)
-            if getattr(plan, "count_only", False) \
-                    and not plan.group_output:
+            if getattr(plan, "count_only", False):
                 # count() consumes only cardinalities: one scalar-leaf
-                # read instead of egesting every row (group_output
-                # counts KEYS, not rows — those still egest)
-                counts = layout.host_read(batch.counts)
+                # read instead of egesting every row.  group_output
+                # counts KEYS — the no-combine reduce leaves each
+                # device's rows key-sorted, so distinct keys count on
+                # device with one boundary scan
+                if plan.group_output:
+                    counts = layout.host_read(
+                        self._distinct_key_counts(batch))
+                else:
+                    counts = layout.host_read(batch.counts)
                 return ("counts", [int(c) for c in counts])
             rows_per_part = layout.egest(batch)
             if plan.group_output:
@@ -864,6 +869,28 @@ class JAXExecutor:
             "single_map": (plan.source[0] in ("text", "union")
                            or getattr(plan, "reslice", False)),
         })
+
+    def _distinct_key_counts(self, batch):
+        """(ndev,) distinct-key counts of a per-device KEY-SORTED batch
+        (the no-combine reduce's row order) — group cardinality without
+        egesting a single row."""
+        cap = batch.cap
+        k0 = batch.cols[0]
+        key = ("distinct", cap, str(k0.dtype))
+        if key not in self._compiled:
+            def per_device(counts, keys):
+                n, k = counts[0], keys[0]
+                idx = jnp.arange(cap)
+                valid = idx < n
+                bound = valid & ((idx == 0) | (k != jnp.roll(k, 1)))
+                return (jnp.expand_dims(
+                    jnp.sum(bound).astype(jnp.int32), 0),)
+            fn = _shard_map(per_device, self.mesh,
+                            in_specs=(P(AXIS),) * 2,
+                            out_specs=(P(AXIS),))
+            self._compiled[key] = jax.jit(fn)
+        (out,) = self._compiled[key](batch.counts, batch.cols[0])
+        return out
 
     def _register_shuffle(self, dep, plan, store):
         """Shared HBM shuffle-store bookkeeping (re-run guard, byte

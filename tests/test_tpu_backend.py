@@ -728,10 +728,10 @@ def test_join_source_pipeline_rides_device(tctx):
 
 
 def test_count_answers_from_device_counts(tctx):
-    """count() over an array result stage reads only the counts leaf
-    (no row egest — note kind 'array+counts') and still matches the
-    object path exactly; groupByKey counts KEYS and must keep
-    egesting."""
+    """count() over an array result stage reads only counts (no row
+    egest — note kind 'array+counts') and still matches the object
+    path exactly; groupByKey counts KEYS via the on-device distinct
+    scan over its key-sorted rows."""
     import operator
     rows = [(i % 100, i % 7) for i in range(30000)]
     assert tctx.parallelize(rows, 8).filter(
@@ -741,5 +741,10 @@ def test_count_answers_from_device_counts(tctx):
         operator.add, 8).count() == 100
     assert _stage_kinds(tctx).get("ShuffledRDD") == "array+counts"
     assert tctx.parallelize(rows, 8).groupByKey(8).count() == 100
-    assert _stage_kinds(tctx).get("FlatMappedValuesRDD") \
-        != "array+counts"                     # group counts must egest
+    kinds = _stage_kinds(tctx)
+    assert "array+counts" in kinds.values(), kinds
+    # distinct-scan edge: every key unique, and a single-key skew
+    assert tctx.parallelize(
+        [(i, 1) for i in range(5000)], 8).groupByKey(8).count() == 5000
+    assert tctx.parallelize(
+        [(7, i) for i in range(5000)], 8).groupByKey(8).count() == 1
