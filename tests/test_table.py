@@ -198,3 +198,25 @@ def test_sql_group_by_rides_device_shuffle():
             assert abs(r.ay - sy / c) < 1e-9
     finally:
         tctx.stop()
+
+
+def test_table_join_rides_device():
+    """Numeric table equi-joins inherit the array-path join source:
+    every stage of select-over-join runs on the device (VERDICT r3 #8
+    sibling — the Table DSL inherits the core's speed)."""
+    from dpark_tpu import DparkContext
+    tctx = DparkContext("tpu")
+    tctx.start()
+    li = tctx.parallelize(
+        [(i % 500, i % 7, (i % 11) * 10) for i in range(20000)], 8) \
+        .asTable(["okey", "qty", "price"], "lineitem")
+    od = tctx.parallelize([(i, i % 3) for i in range(500)], 8) \
+        .asTable(["okey", "prio"], "orders")
+    out = li.join(od, on="okey").select("okey", "qty", "prio").collect()
+    assert len(out) == 20000
+    kinds = set()
+    for rec in tctx.scheduler.history:
+        for s in rec.get("stage_info", []):
+            kinds.add(s.get("kind"))
+    assert kinds == {"array"}, kinds
+    tctx.stop()
