@@ -335,3 +335,36 @@ def test_counter_window_keeps_join_semantics(ctx):
     assert [dict(v) for _, v in out] == [
         {"k": Counter(a=1)}, {"k": Counter(a=3)},
         {"k": Counter(a=6)}, {"k": Counter(a=12)}]
+
+
+def _window_fuzz_run(master, seed):
+    import random as _random
+    from dpark_tpu import DparkContext
+    rng = _random.Random(seed)
+    nb = rng.randint(4, 7)
+    window = float(rng.randint(1, 3))
+    batches = []
+    for _ in range(nb):
+        if rng.random() < 0.25:
+            batches.append([])               # empty micro-batch
+        else:
+            batches.append([(rng.randint(0, 12), rng.randint(-9, 9))
+                            for _ in range(rng.randint(1, 120))])
+    c = DparkContext(master)
+    ssc = make_ssc(c, batch=1.0)
+    out = []
+    q = ssc.queueStream(batches)
+    q.reduceByKeyAndWindow(operator.add, window,
+                           invFunc=operator.sub).collect_batches(out)
+    run_batches(ssc, nb)
+    res = [(t, sorted(v)) for t, v in out]
+    c.stop()
+    return res
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_window_fuzz_parity(seed):
+    """Random incremental windows (sizes, empty batches) must match
+    the local master exactly — the (add, sub) linear rewrite included."""
+    assert _window_fuzz_run("tpu", seed) == _window_fuzz_run("local",
+                                                             seed)
