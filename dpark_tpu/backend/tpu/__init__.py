@@ -210,7 +210,8 @@ class TPUScheduler(DAGScheduler):
 
     def _run_array_stage(self, stage, tasks, plan, report):
         import time as _time
-        from dpark_tpu.rdd import _count_iter
+        from dpark_tpu.backend.tpu import fuse
+        from dpark_tpu.rdd import _count_iter, _PartReduce
         t0 = _time.time()
         # count() needs no rows on the driver — the object path sums
         # per-executor counts, and the array path can answer straight
@@ -221,6 +222,20 @@ class TPUScheduler(DAGScheduler):
                            and all(isinstance(t, ResultTask)
                                    and t.func is _count_iter
                                    for t in tasks))
+        # reduce(f) with a PROVABLE monoid over scalar records likewise
+        # answers from one per-device reduction (ndev scalars on the
+        # wire); unprovable reduces keep the egest + host fold
+        plan.reduce_monoid = None
+        if (not stage.is_shuffle_map and tasks
+                and all(isinstance(t, ResultTask)
+                        and isinstance(t.func, _PartReduce)
+                        for t in tasks)
+                and len({id(t.func.f) for t in tasks}) == 1):
+            try:
+                plan.reduce_monoid = fuse.classify_merge(
+                    tasks[0].func.f)
+            except Exception:
+                plan.reduce_monoid = None
         wire0 = self.executor.exchange_wire_bytes
         real0 = self.executor.exchange_real_rows
         slot0 = self.executor.exchange_slot_rows
@@ -258,6 +273,13 @@ class TPUScheduler(DAGScheduler):
             note["kind"] = "array+counts"    # observable: no egest ran
             for task in tasks:
                 report(task, "success", (result[task.partition], {}, {}))
+        elif kind == "reduced":
+            from dpark_tpu.rdd import _EMPTY
+            note["kind"] = "array+reduced"
+            for task in tasks:
+                v, n = result[task.partition]
+                report(task, "success",
+                       (v if n else _EMPTY, {}, {}))
         else:
             rows_per_part = result
             for task in tasks:

@@ -835,6 +835,39 @@ class JAXExecutor:
                 else:
                     counts = layout.host_read(batch.counts)
                 return ("counts", [int(c) for c in counts])
+            monoid = getattr(plan, "reduce_monoid", None)
+            if (monoid is not None and not plan.group_output
+                    and len(batch.cols) == 1
+                    and batch.cols[0].ndim == 2
+                    # bools have no monoid identity table; integer mul
+                    # overflows almost immediately where the host fold
+                    # used exact Python ints — both keep the egest path
+                    and np.dtype(batch.cols[0].dtype).kind in "if"
+                    and not (monoid == "mul"
+                             and np.dtype(batch.cols[0].dtype).kind
+                             == "i")):
+                # reduce(provable monoid) over scalar records: one
+                # per-device masked reduction, ndev scalars egested
+                vals, lo, hi = (layout.host_read(a) for a in
+                                self._monoid_reduce(batch, monoid))
+                counts = layout.host_read(batch.counts)
+                intk = vals.dtype.kind == "i"
+                safe = True
+                if intk and monoid == "add":
+                    # host fold used exact Python ints: only answer
+                    # from the device when the i64 sum provably cannot
+                    # have wrapped (n * max|v| bound; empty devices
+                    # hold identities — exclude them from the bound)
+                    total = int(counts.sum())
+                    nz = counts > 0
+                    mabs = (max(abs(int(lo[nz].min())),
+                                abs(int(hi[nz].max())))
+                            if nz.any() else 0)
+                    safe = total * mabs < 2 ** 62
+                if safe:
+                    py = float if not intk else int
+                    return ("reduced", [(py(v), int(n))
+                                        for v, n in zip(vals, counts)])
             rows_per_part = layout.egest(batch)
             if plan.group_output:
                 # bare groupByKey: rows arrive key-sorted; group runs
@@ -869,6 +902,34 @@ class JAXExecutor:
             "single_map": (plan.source[0] in ("text", "union")
                            or getattr(plan, "reslice", False)),
         })
+
+    def _monoid_reduce(self, batch, monoid):
+        """Per-device (reduced, min, max) over the valid rows of a
+        single-scalar-leaf batch, each (ndev,) (empty devices yield
+        identities — the caller masks them out via the counts leaf;
+        min/max feed the integer-add overflow bound)."""
+        from dpark_tpu.backend.tpu.bagel import _local_reduce
+        from dpark_tpu.bagel import monoid_identity
+        cap = batch.cap
+        col = batch.cols[0]
+        ident = monoid_identity(monoid, col.dtype)
+        lo_id = monoid_identity("min", col.dtype)
+        hi_id = monoid_identity("max", col.dtype)
+        key = ("monoid_reduce", monoid, cap, str(col.dtype))
+        if key not in self._compiled:
+            def per_device(counts, vals):
+                n, x = counts[0], vals[0]
+                valid = jnp.arange(cap) < n
+                masked = jnp.where(valid, x, ident)
+                lo = jnp.min(jnp.where(valid, x, lo_id))
+                hi = jnp.max(jnp.where(valid, x, hi_id))
+                return tuple(jnp.expand_dims(o, 0) for o in
+                             (_local_reduce(monoid, masked), lo, hi))
+            fn = _shard_map(per_device, self.mesh,
+                            in_specs=(P(AXIS),) * 2,
+                            out_specs=(P(AXIS),) * 3)
+            self._compiled[key] = jax.jit(fn)
+        return self._compiled[key](batch.counts, col)
 
     def _distinct_key_counts(self, batch):
         """(ndev,) distinct-key counts of a per-device KEY-SORTED batch

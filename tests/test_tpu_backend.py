@@ -748,3 +748,48 @@ def test_count_answers_from_device_counts(tctx):
         [(i, 1) for i in range(5000)], 8).groupByKey(8).count() == 5000
     assert tctx.parallelize(
         [(7, i) for i in range(5000)], 8).groupByKey(8).count() == 1
+
+
+def test_reduce_monoid_answers_on_device(tctx):
+    """reduce() with a provable monoid egests ndev scalars (note kind
+    'array+reduced'), matching the object path exactly for ints; an
+    unprovable reduce keeps the egest + host fold."""
+    import operator
+    vals = [((i * 7919) % 1000) - 500 for i in range(10000)]
+    r = tctx.parallelize(vals, 8).map(lambda x: x * 3)
+    assert r.reduce(operator.add) == sum(v * 3 for v in vals)
+    assert _stage_kinds(tctx).get("MappedRDD") == "array+reduced"
+    assert r.reduce(lambda a, b: a if a < b else b) \
+        == min(v * 3 for v in vals)
+    assert r.reduce(lambda a, b: a if a > b else b) \
+        == max(v * 3 for v in vals)
+    # subtraction is not a monoid: must NOT take the reduced path,
+    # and must still fold in partition order like the object path
+    got = tctx.parallelize([10, 1, 2, 3], 2).reduce(operator.sub)
+    assert got == (10 - 1) - (2 - 3)
+    assert _stage_kinds(tctx).get("ParallelCollection") \
+        != "array+reduced"
+
+
+def test_reduce_monoid_edge_semantics(tctx):
+    """Integer-overflow, bool, and int-mul reduces keep the exact host
+    fold (Python big ints) instead of wrapping on device (r4 review)."""
+    import operator
+    # sum would exceed int64: exact big-int answer required
+    big = [2 ** 62, 2 ** 62, 2 ** 62]
+    assert tctx.parallelize(big, 8).reduce(operator.add) == 3 * 2 ** 62
+    # integer product overflows int64 almost immediately
+    assert tctx.parallelize(list(range(1, 30)), 8) \
+        .reduce(operator.mul) == __import__("math").factorial(29)
+    # bool min/max must not crash the stage
+    assert tctx.parallelize([True, False, True], 8).reduce(min) is False
+    # float add stays on device (documented ordering divergence)
+    vals = [0.5 * i for i in range(1000)]
+    got = tctx.parallelize(vals, 8).map(lambda x: x + 0.25) \
+        .reduce(operator.add)
+    assert abs(got - sum(v + 0.25 for v in vals)) < 1e-6
+    # empty devices (identity min/max) must not poison the overflow
+    # bound into a needless fallback
+    few = tctx.parallelize(list(range(16)), 8).filter(lambda x: x < 2)
+    assert few.reduce(operator.add) == 1
+    assert _stage_kinds(tctx).get("FilteredRDD") == "array+reduced"
