@@ -111,9 +111,17 @@ def test_random_program_parity(seed):
     tctx = DparkContext("tpu")
     lctx = DparkContext("local")
     try:
-        got = canonical(apply_program(tctx, data, prog).collect())
-        expect = canonical(apply_program(lctx, data, prog).collect())
+        rt = apply_program(tctx, data, prog)
+        rl = apply_program(lctx, data, prog)
+        got = canonical(rt.collect())
+        expect = canonical(rl.collect())
         assert got == expect, "parity violation for program %r" % (prog,)
+        # ACTIONS too: count (device counts leaf) and monoid reduce
+        # (per-device reduction) must agree with the local master
+        assert rt.count() == rl.count() == len(expect), prog
+        if expect:
+            assert rt.map(lambda kv: kv[1]).reduce(operator.add) \
+                == rl.map(lambda kv: kv[1]).reduce(operator.add), prog
     finally:
         tctx.stop()
         lctx.stop()
