@@ -6,7 +6,7 @@
 set -u
 cd /root/repo
 TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-OUT=BENCH_REAL_r04.md
+OUT=BENCH_REAL_r05.md
 LOGDIR=.real_capture
 mkdir -p "$LOGDIR"
 
@@ -19,7 +19,7 @@ run() {  # run <name> <timeout_s> <cmd...>
 }
 
 {
-  echo "# BENCH_REAL_r04 — real-chip capture at $TS"
+  echo "# BENCH_REAL_r05 — real-chip capture at $TS"
   echo
   echo "Automatic capture fired by the probe loop on first chip contact."
   echo "Raw outputs in $LOGDIR/."
