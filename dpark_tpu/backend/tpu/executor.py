@@ -847,7 +847,12 @@ class JAXExecutor:
                              and np.dtype(batch.cols[0].dtype).kind
                              == "i")):
                 # reduce(provable monoid) over scalar records: one
-                # per-device masked reduction, ndev scalars egested
+                # per-device masked reduction, ndev scalars egested.
+                # Float add/mul REASSOCIATES here (per-device tree
+                # reduction vs the host's partition-order fold): results
+                # can differ from the local master in low-order bits —
+                # parity checks must compare floats with a tolerance
+                # (ADVICE r4; test_parity_fuzz does)
                 vals, lo, hi = (layout.host_read(a) for a in
                                 self._monoid_reduce(batch, monoid))
                 counts = layout.host_read(batch.counts)

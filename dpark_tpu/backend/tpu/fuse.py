@@ -128,21 +128,90 @@ def classify_merge(merge):
     name = templates.get((code.co_code, code.co_consts, code.co_names))
     if name is None:
         return None
+    if not _builtin_globals_ok(merge, code):
+        return None
+    return name
+
+
+def _builtin_globals_ok(f, code):
+    """Every global the bytecode references still resolves to the
+    builtin of that name (shadowed min/max etc. are not provable)."""
     import builtins
-    fglobals = merge.__globals__
+    fglobals = f.__globals__
     fbuiltins = fglobals.get("__builtins__", builtins)
     for g in code.co_names:
         expected = getattr(builtins, g, None)
         if expected is None:
-            return None
-        if g in fglobals:                # shadowed min/max: not provable
+            return False
+        if g in fglobals:
             if fglobals[g] is not expected:
-                return None
+                return False
         elif isinstance(fbuiltins, dict):
             if fbuiltins.get(g) is not expected:
-                return None              # custom __builtins__ dict
+                return False             # custom __builtins__ dict
         elif getattr(fbuiltins, g, None) is not expected:
-            return None
+            return False
+    return True
+
+
+_SEGAGG_DIRECT = None
+_SEGAGG_TEMPLATES = None
+
+
+def _segagg_tables():
+    global _SEGAGG_DIRECT, _SEGAGG_TEMPLATES
+    if _SEGAGG_DIRECT is None:
+        direct = {sum: "sum", len: "count", min: "min", max: "max",
+                  np.sum: "sum", np.mean: "mean",
+                  np.min: "min", np.max: "max"}
+        tmpl = {
+            "sum": [lambda vs: sum(vs)],
+            "count": [lambda vs: len(vs)],
+            "min": [lambda vs: min(vs)],
+            "max": [lambda vs: max(vs)],
+            "mean": [lambda vs: sum(vs) / len(vs)],
+        }
+        templates = {}
+        for name, fns in tmpl.items():
+            for f in fns:
+                c = f.__code__
+                templates[(c.co_code, c.co_consts, c.co_names)] = name
+        _SEGAGG_DIRECT, _SEGAGG_TEMPLATES = direct, templates
+    return _SEGAGG_DIRECT, _SEGAGG_TEMPLATES
+
+
+def classify_segagg(f):
+    """EXACT classification of a mapValues function applied to a
+    groupByKey value LIST as a per-group aggregate (VERDICT r4 #3:
+    group->aggregate chains ride the mesh as segment reductions, no
+    (k, [v]) lists ever materialize).  Same proof obligations as
+    classify_merge — only provable matches qualify:
+
+    * the builtins sum/len/min/max (or np.sum/np.mean/np.min/np.max)
+      by identity;
+    * a closure-free 1-arg function whose bytecode equals ``sum(vs)``,
+      ``len(vs)``, ``min(vs)``, ``max(vs)`` or ``sum(vs)/len(vs)``,
+      with referenced globals verified to still be the builtins;
+    * an explicit hint: ``f.__dpark_segagg__ = "sum"``.
+
+    Returns "sum" | "count" | "min" | "max" | "mean" | None."""
+    hint = getattr(f, "__dpark_segagg__", None)
+    if hint in ("sum", "count", "min", "max", "mean"):
+        return hint
+    direct, templates = _segagg_tables()
+    try:
+        if f in direct:
+            return direct[f]
+    except TypeError:
+        return None
+    code = getattr(f, "__code__", None)
+    if code is None or getattr(f, "__closure__", None):
+        return None
+    if code.co_argcount != 1 or code.co_flags & 0x0C:
+        return None
+    name = templates.get((code.co_code, code.co_consts, code.co_names))
+    if name is None or not _builtin_globals_ok(f, code):
+        return None
     return name
 
 
@@ -245,6 +314,97 @@ class FilterOp:
         return collectives.compact(leaves, mask)
 
 
+class SegAggOp:
+    """groupByKey().mapValues(provable aggregate) consumed ON DEVICE:
+    the no-combine reduce leaves each device's rows key-sorted with the
+    valid prefix first, so one boundary scan + segment scatter yields
+    one (k, agg) row per key — ragged (k, [v]) groups never materialize
+    and no host bridge runs (reference: dpark/rdd.py groupByKey +
+    mapValue; SURVEY.md 2.2 CoGroupedRDD row, 7.1 step 6).
+
+    REQUIRES key-sorted valid-prefix input: analyze_stage only installs
+    this as ops[0] of a no-combine "hbm"-source plan, whose reduce
+    program (_compile_reduce's no-combine branch) sorts rows by key
+    before applying ops — any new install site must preserve that.
+
+    Float NaN caveat: NaN values are treated as absent for min/max
+    (the host fold's result for a NaN-bearing group depends on shuffle
+    arrival order — it ignores NaNs unless one arrives first — so no
+    vectorized form can reproduce it exactly; masking NaN to the
+    identity matches the host in every NaN-not-first case)."""
+
+    def __init__(self, kind):
+        self.kind = kind
+        self.key = ("segagg", kind)
+
+    def probe(self, treedef, specs):
+        import jax.tree_util as jtu
+        if treedef != jtu.tree_structure((0, 0)):
+            raise TypeError("segagg needs flat (k, v) records")
+        (kdt, kshape), (vdt, vshape) = specs
+        if kshape != () or vshape != ():
+            raise TypeError("segagg needs scalar key and value")
+        if kdt.kind not in "if" or vdt.kind not in "if":
+            raise TypeError("segagg needs numeric key and value")
+        if self.kind == "count":
+            odt = np.dtype(np.int64)
+        elif self.kind == "mean":
+            # host semantics: int values true-divide to float; float
+            # values keep their width (np.float32 sum / int is f32)
+            odt = np.dtype(np.float64) if vdt.kind == "i" else vdt
+        elif self.kind == "sum" and vdt.kind == "i":
+            # device sums are 64-bit (the executor's x64 contract:
+            # counting must not wrap at 2**31)
+            odt = np.dtype(np.int64)
+        else:
+            odt = vdt
+        return treedef, [(kdt, kshape), (odt, ())]
+
+    def apply(self, leaves, n):
+        from dpark_tpu.backend.tpu import collectives
+        k, v = leaves[0], leaves[1]
+        cap = k.shape[0]
+        idx = jnp.arange(cap)
+        valid = idx < n
+        ks = jnp.where(valid, k, collectives._sentinel(k.dtype))
+        # segment ids from sorted-key boundaries; invalid rows land in
+        # segment cap-1, past the n_out valid prefix (when every row is
+        # its own segment there are no invalid rows to misplace)
+        starts = valid & ((idx == 0) | (ks != jnp.roll(ks, 1)))
+        seg = jnp.where(valid, jnp.cumsum(starts.astype(jnp.int32)) - 1,
+                        cap - 1)
+        n_out = jnp.sum(starts).astype(jnp.int32)
+        kind = self.kind
+        op_kind = {"sum": "add", "count": "add", "mean": "add",
+                   "min": "min", "max": "max"}[kind]
+        if kind == "count":
+            vals = jnp.ones((cap,), jnp.int64)
+        elif v.dtype.kind == "i" and kind in ("sum", "mean"):
+            vals = v.astype(jnp.int64)   # exact int sums, like the host
+        else:
+            vals = v
+        from dpark_tpu.bagel import monoid_identity
+        ident_v = monoid_identity(op_kind, vals.dtype)
+        mask_v = valid
+        if kind in ("min", "max") and vals.dtype.kind == "f":
+            mask_v = valid & ~jnp.isnan(vals)   # NaN caveat: see class
+        vals = jnp.where(mask_v, vals, ident_v)
+        op = collectives._segment_op(op_kind)
+        agg = op(vals, seg, num_segments=cap)
+        if kind == "mean":
+            cnt = collectives._segment_op("add")(
+                jnp.where(valid, jnp.ones((cap,), jnp.int64),
+                          jnp.zeros((), jnp.int64)),
+                seg, num_segments=cap)
+            # int sums true-divide to f64; float sums keep their width
+            # (jax promotion: f32 / i64 -> f32) — both match the host
+            agg = agg / jnp.maximum(cnt, 1)
+        # per-segment key: min over the segment (all equal); empty
+        # segments keep the sentinel and sit past the valid prefix
+        out_k = collectives._segment_op("min")(ks, seg, num_segments=cap)
+        return [out_k, agg], n_out
+
+
 class StagePlan:
     """Everything needed to run one stage on the array path."""
 
@@ -327,8 +487,10 @@ def extract_chain(top, cached_ids=()):
             passthrough = True
             cur = cur.prev
         elif isinstance(cur, MappedValuesRDD):
-            ops.append(MapOp(_mapvalue_as_record_fn(cur.f),
-                             ("mapvalue", fn_key(cur.f))))
+            op = MapOp(_mapvalue_as_record_fn(cur.f),
+                       ("mapvalue", fn_key(cur.f)))
+            op.mapvalue_f = cur.f    # analyze may consume f as a segagg
+            ops.append(op)
             cur = cur.prev
         elif isinstance(cur, KeyedRDD):
             ops.append(MapOp(_keyby_as_record_fn(cur.f),
@@ -870,9 +1032,24 @@ def analyze_stage(stage, ndev, executor_or_store):
             # through flat; bare groupByKey groups at egest time
             src_combine = False
             if not passthrough:
-                if ops or stage.is_shuffle_map:
+                seg = None
+                if ops:
+                    f0 = getattr(ops[0], "mapvalue_f", None)
+                    kind = (classify_segagg(f0) if f0 is not None
+                            else None)
+                    if kind is not None:
+                        seg = SegAggOp(kind)
+                if seg is not None:
+                    # groupByKey().mapValues(provable aggregate): the
+                    # group list never materializes — a segment scatter
+                    # over the key-sorted no-combine rows yields flat
+                    # (k, agg) records, and the rest of the chain (and
+                    # any shuffle write) continues on device
+                    ops[0] = seg
+                elif ops or stage.is_shuffle_map:
                     return None          # (k, [v]) records: host only
-                group_output = True
+                else:
+                    group_output = True
         else:
             src_combine = True
             try:

@@ -665,12 +665,16 @@ class ReducedWindowedDStream(DerivedDStream):
         if self._linear_ops and self._numeric is None:
             # one-time value probe (a one-partition job on the cached
             # window): plain numbers form a group under (+, -); other
-            # +/- types (Counter saturates) must keep the join path
+            # +/- types (Counter saturates) must keep the join path.
+            # Probe SEVERAL records, not one (ADVICE r4): a stream whose
+            # first reduced value is a number but whose later ones are
+            # not would otherwise silently take the union-negate
+            # rewrite and diverge from the leftOuterJoin+invFunc path
             import numbers
-            probe = prev.take(1)
+            probe = prev.take(5)
             if probe:
-                self._numeric = (
-                    isinstance(probe[0][1], numbers.Number))
+                self._numeric = all(
+                    isinstance(rec[1], numbers.Number) for rec in probe)
         if self._linear_ops and self._numeric:
             # prev + new - old, one union-reduce.  Key-set parity with
             # the join formulation: every key in a leaving slice also

@@ -58,7 +58,11 @@ def _branchless_min(a, b):
         import jax
         if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
             import jax.numpy as jnp
-            return jnp.minimum(a, b)
+            # jnp.where(a <= b, a, b), NOT jnp.minimum: minimum
+            # propagates NaN where the host comparison returns b —
+            # device and host float min must agree on NaN rows
+            # (ADVICE r4)
+            return jnp.where(a <= b, a, b)
     except ImportError:
         pass
     return a if a <= b else b
@@ -69,7 +73,9 @@ def _branchless_max(a, b):
         import jax
         if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
             import jax.numpy as jnp
-            return jnp.maximum(a, b)
+            # mirror the host's `a if a >= b else b` NaN behavior
+            # (ADVICE r4; see _branchless_min)
+            return jnp.where(a >= b, a, b)
     except ImportError:
         pass
     return a if a >= b else b

@@ -1,0 +1,197 @@
+"""Device-side ragged groups (VERDICT r4 #3): groupByKey().mapValues(agg)
+chains run all-array as segment reductions — the (k, [v]) group lists
+never materialize and no host bridge runs.  Every test asserts parity
+with the local master (the golden model, SURVEY.md section 4)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def tctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu")
+    c.start()
+    yield c
+    c.stop()
+
+
+def _stage_kinds(tctx):
+    rec = tctx.scheduler.history[-1]
+    return {s["rdd"]: s.get("kind") for s in rec["stage_info"]}
+
+
+def _groups(rows):
+    exp = {}
+    for k, v in rows:
+        exp.setdefault(k, []).append(v)
+    return exp
+
+
+ROWS = [(i % 53, (i * 7) % 11 - 3) for i in range(4000)]
+
+
+@pytest.mark.parametrize("f,host", [
+    (sum, sum),
+    (len, len),
+    (min, min),
+    (max, max),
+    (lambda vs: sum(vs), sum),
+    (lambda vs: len(vs), len),
+    (lambda vs: sum(vs) / len(vs), lambda vs: sum(vs) / len(vs)),
+])
+def test_groupby_aggregate_rides_device(tctx, f, host):
+    r = tctx.parallelize(ROWS, 8).groupByKey(8).mapValues(f)
+    got = dict(r.collect())
+    exp = {k: host(vs) for k, vs in _groups(ROWS).items()}
+    assert got == exp
+    kinds = _stage_kinds(tctx)
+    assert kinds.get("MappedValuesRDD") == "array", kinds
+
+
+def test_groupby_mean_float32_keeps_width(tctx):
+    """mean over np.float32 values stays f32 like the host (np.float32
+    sum / int is f32), not a silently-declared f64 (review finding)."""
+    rows = [(i % 7, np.float32(i % 5) * np.float32(0.25))
+            for i in range(560)]
+    r = tctx.parallelize(rows, 8).groupByKey(8) \
+        .mapValues(lambda vs: sum(vs) / len(vs))
+    got = dict(r.collect())
+    exp = {}
+    for k, vs in _groups(rows).items():
+        acc = np.float32(0)
+        for v in vs:
+            acc = acc + v
+        exp[k] = acc / len(vs)
+    assert _stage_kinds(tctx).get("MappedValuesRDD") == "array"
+    assert set(got) == set(exp)
+    for k in got:
+        assert np.float32(got[k]) == np.float32(exp[k]), (k, got[k],
+                                                          exp[k])
+
+
+def test_groupby_minmax_nan_masked(tctx):
+    """Documented NaN caveat: NaN values are absent for device min/max
+    — equal to the host whenever NaN is not the group's first-arrived
+    element, and deterministic either way."""
+    rows = [(i % 4, float(i)) for i in range(40)]
+    rows += [(k, float("nan")) for k in range(4)]
+    got = dict(tctx.parallelize(rows, 8).groupByKey(8)
+               .mapValues(min).collect())
+    assert _stage_kinds(tctx).get("MappedValuesRDD") == "array"
+    for k in range(4):
+        assert got[k] == float(k)        # the non-NaN min
+
+
+def test_groupby_aggregate_float_values(tctx):
+    rows = [(k, v * 0.5) for k, v in ROWS]
+    got = dict(tctx.parallelize(rows, 8).groupByKey(8)
+               .mapValues(max).collect())
+    exp = {k: max(vs) for k, vs in _groups(rows).items()}
+    assert got == exp
+    assert _stage_kinds(tctx).get("MappedValuesRDD") == "array"
+
+
+def test_groupby_aggregate_chain_continues_on_device(tctx):
+    """Ops after the aggregate (filter) and a downstream shuffle write
+    stay on the array path."""
+    r = tctx.parallelize(ROWS, 8).groupByKey(8).mapValues(sum)
+    got = dict(r.filter(lambda kv: kv[0] % 2 == 0)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    exp = {k: sum(vs) for k, vs in _groups(ROWS).items() if k % 2 == 0}
+    assert got == exp
+    kinds = _stage_kinds(tctx)
+    assert kinds.get("FilteredRDD") == "array", kinds
+    assert kinds.get("ShuffledRDD") == "array", kinds
+
+
+def test_groupby_aggregate_sort_downstream(tctx):
+    """groupByKey -> aggregate -> sortByKey: the aggregate output feeds
+    a range shuffle on device."""
+    got = tctx.parallelize(ROWS, 8).groupByKey(8).mapValues(sum) \
+        .sortByKey().collect()
+    exp = sorted((k, sum(vs)) for k, vs in _groups(ROWS).items())
+    assert got == exp
+
+
+def test_groupby_aggregate_count_only(tctx):
+    """count() over the aggregate answers from device counts (one row
+    per key, no egest)."""
+    n = tctx.parallelize(ROWS, 8).groupByKey(8).mapValues(sum).count()
+    assert n == len(_groups(ROWS))
+    assert _stage_kinds(tctx).get("MappedValuesRDD") == "array+counts"
+
+
+def test_groupby_aggregate_hint(tctx):
+    """A user function equivalent to a monoid but written differently
+    opts in via __dpark_segagg__."""
+    def total(vs):
+        acc = 0
+        for v in vs:
+            acc += v
+        return acc
+    total.__dpark_segagg__ = "sum"
+    got = dict(tctx.parallelize(ROWS, 8).groupByKey(8)
+               .mapValues(total).collect())
+    exp = {k: sum(vs) for k, vs in _groups(ROWS).items()}
+    assert got == exp
+    assert _stage_kinds(tctx).get("MappedValuesRDD") == "array"
+
+
+def test_groupby_unprovable_aggregate_falls_back(tctx):
+    """An aggregate the classifier cannot prove takes the host path and
+    still matches."""
+    got = dict(tctx.parallelize(ROWS, 8).groupByKey(8)
+               .mapValues(lambda vs: sorted(vs)[0]).collect())
+    exp = {k: min(vs) for k, vs in _groups(ROWS).items()}
+    assert got == exp
+    assert _stage_kinds(tctx).get("MappedValuesRDD") != "array"
+
+
+def test_groupby_shadowed_builtin_not_classified():
+    """A local `sum` shadowing the builtin must NOT classify."""
+    from dpark_tpu.backend.tpu import fuse
+    ns = {"sum": lambda vs: 42}
+    f = eval("lambda vs: sum(vs)", ns)
+    assert fuse.classify_segagg(f) is None
+    assert fuse.classify_segagg(sum) == "sum"
+    assert fuse.classify_segagg(len) == "count"
+    assert fuse.classify_segagg(lambda vs: sum(vs) / len(vs)) == "mean"
+    assert fuse.classify_segagg(lambda vs: sorted(vs)) is None
+
+
+def test_groupby_tuple_values_fall_back(tctx):
+    """len over a list of tuple values is host-only (segagg needs
+    scalar values) but must still match the local master."""
+    rows = [(i % 11, (i, i + 1)) for i in range(300)]
+    got = dict(tctx.parallelize(rows, 8).groupByKey(8)
+               .mapValues(len).collect())
+    exp = {k: len(vs) for k, vs in _groups(rows).items()}
+    assert got == exp
+
+
+def test_groupby_aggregate_parity_vs_local(tctx):
+    """Cross-master parity on a mixed program."""
+    from dpark_tpu import DparkContext
+    lctx = DparkContext("local")
+    try:
+        def prog(c):
+            return sorted(
+                c.parallelize(ROWS, 8).groupByKey(8)
+                .mapValues(lambda vs: sum(vs))
+                .mapValue(lambda s: s * 3).collect())
+        assert prog(tctx) == prog(lctx)
+    finally:
+        lctx.stop()
+
+
+def test_groupby_single_key_and_single_rows(tctx):
+    """Boundary shapes: one key total; one row per key."""
+    one_key = [(7, i) for i in range(100)]
+    got = dict(tctx.parallelize(one_key, 8).groupByKey(8)
+               .mapValues(sum).collect())
+    assert got == {7: sum(range(100))}
+    distinct = [(i, i * 2) for i in range(64)]
+    got = dict(tctx.parallelize(distinct, 8).groupByKey(8)
+               .mapValues(sum).collect())
+    assert got == {i: i * 2 for i in range(64)}
