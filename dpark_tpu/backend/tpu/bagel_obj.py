@@ -1,0 +1,544 @@
+"""Device adapter for OBJECT Bagel programs — general edition.
+
+Replaces the r4 template-in-state columnarizer (VERDICT r4 #4: its
+device subset required degree <= 8, <= 8 degree classes, scalar values,
+and messages only to the vertex's own out-edges).  The lifted design:
+
+* **Class-sliced tracing.**  Vertices are sharded by hash(id) and, per
+  device, grouped into contiguous slices by out-degree.  The user's
+  per-vertex ``compute`` is jax.vmap'd over each class slice with a
+  REAL Python list of that degree's Edge proxies — ``len(outEdges)``
+  stays exact at trace time — so per-class work is proportional to the
+  class size, not the whole graph, and the degree cap rises from 8 to
+  MAX_DEGREE (the number of DISTINCT degrees still bounds compile
+  count; see bagel.MAX_DEGREE_CLASSES).
+* **Messages are data (CSR-style send).**  ``Message.target_id`` may be
+  any integer — a traced edge target, a computed id, a constant —
+  because emitted messages leave compute as (dst, value) ARRAYS,
+  flatten across classes into one per-device buffer sized by the total
+  message count (not n x max_degree), and route by hash(dst) through
+  the same bucketize-combine + all_to_all exchange the shuffle plane
+  uses.  Messages to non-neighbors and variable message counts
+  (halt-and-send, notify-one) all work; unknown targets drop at
+  delivery exactly like the object loop.
+* **Structured vertex values.**  ``Vertex.value`` may be any pytree of
+  numeric scalars/vectors (tuple, dict, nested, np arrays); leaves ride
+  as separate columns.  Message values stay scalar (they feed the
+  monoid combine).
+
+Semantics parity with Bagel._run_fast (the host golden model): inactive
+vertices with no mail pass through untouched; only compute-invoked
+vertices may send; the halting counters see EMITTED messages (unknown
+targets included, dropped at next delivery); superstep is a static
+Python int per compiled step (object programs branch on it).
+
+Reference: dpark/bagel.py superstep loop (SURVEY.md 3.2); the hash-dst
+exchange is the survey's [H] mapping, shared with backend/tpu/bagel.py.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dpark_tpu import conf
+from dpark_tpu.backend.tpu import collectives, layout
+from dpark_tpu.backend.tpu.executor import _shard_map
+from dpark_tpu.utils.log import get_logger
+from dpark_tpu.utils.phash import phash_np
+
+logger = get_logger("tpu.bagel_obj")
+
+AXIS = conf.MESH_AXIS
+_SENT = np.iinfo(np.int64).max
+
+
+def _not_columnar(msg):
+    from dpark_tpu.bagel import _NotColumnarizable
+    return _NotColumnarizable(msg)
+
+
+class DeviceObjectPregel:
+    """One columnarized object-Bagel run over the executor's mesh.
+
+    Inputs are already validated/flattened by Bagel._run_columnar:
+      ids (n,) int64 unique; vleaves: list of (n, ...) numeric columns
+      (the flattened Vertex.value pytree); act (n,) bool; degs (n,)
+      int64; tgt_flat (E,) int64 edge targets in per-vertex emission
+      order (CSR with offsets = cumsum(degs)); ev_flat: None or (E,)
+      numeric edge values; pend: None or (dst (m,), val (m,)) initial
+      messages; compute: the user's object compute; monoid: the
+      provable BasicCombiner op.
+    """
+
+    def __init__(self, executor, compute, monoid, vdef, ids, vleaves,
+                 act, degs, tgt_flat, ev_flat, pend, max_superstep):
+        from dpark_tpu.bagel import PregelInputError
+        self.ex = executor
+        self.ndev = executor.ndev
+        self.mesh = executor.mesh
+        self.compute = compute
+        self.monoid = monoid
+        self.vdef = vdef
+        self.max_superstep = max_superstep
+        self._compiled = {}
+        n = ids.shape[0]
+        if np.unique(ids).shape[0] != n:
+            raise PregelInputError("vertex ids must be unique")
+        if n and int(ids.max()) == _SENT:
+            raise PregelInputError("vertex id equals the padding sentinel")
+        self.vdtypes = [np.dtype(l.dtype) for l in vleaves]
+        self.vshapes = [tuple(l.shape[1:]) for l in vleaves]
+        self.nvl = len(vleaves)
+        self.has_ev = ev_flat is not None
+        self.edt = np.dtype(ev_flat.dtype) if self.has_ev else None
+
+        self.classes = sorted(set(degs.tolist())) or [0]
+        self.mdt = self._discover_mdt(pend)
+
+        # -- per-(class, device) tables ---------------------------------
+        ndev = self.ndev
+        vdev = (phash_np(ids) % np.uint32(ndev)).astype(np.int64)
+        offs = np.concatenate([[0], np.cumsum(degs)]).astype(np.int64)
+        sh = self._sharding()
+        put = lambda a: jax.device_put(a, sh)           # noqa: E731
+        self.tables = []
+        for d in self.classes:
+            sel = np.nonzero(degs == d)[0]
+            cdev = vdev[sel]
+            order = np.argsort(cdev, kind="stable")
+            sel = sel[order]
+            bounds = np.searchsorted(cdev[order], np.arange(ndev + 1))
+            cnt = np.diff(bounds).astype(np.int32)
+            cap = layout.round_capacity(int(cnt.max()) if sel.size else 1)
+            vid = np.full((ndev, cap), _SENT, np.int64)
+            hact = np.zeros((ndev, cap), bool)
+            hvl = [np.zeros((ndev, cap) + shp, dt)
+                   for dt, shp in zip(self.vdtypes, self.vshapes)]
+            htg = np.full((ndev, cap, d), _SENT, np.int64)
+            hev = (np.zeros((ndev, cap, d), self.edt)
+                   if self.has_ev else None)
+            for dev in range(ndev):
+                lo, hi = int(bounds[dev]), int(bounds[dev + 1])
+                c = hi - lo
+                if not c:
+                    continue
+                s = sel[lo:hi]
+                vid[dev, :c] = ids[s]
+                hact[dev, :c] = act[s]
+                for h, l in zip(hvl, vleaves):
+                    h[dev, :c] = l[s]
+                if d:
+                    eidx = offs[s][:, None] + np.arange(d)[None, :]
+                    htg[dev, :c] = tgt_flat[eidx]
+                    if self.has_ev:
+                        hev[dev, :c] = ev_flat[eidx]
+            self.tables.append({
+                "d": d, "cap": cap,
+                "vid": put(vid), "act": put(hact),
+                "vals": [put(h) for h in hvl],
+                "tgts": put(htg),
+                "evals": put(hev) if self.has_ev else None,
+            })
+
+        # -- initial messages, bucketized by hash(dst) -------------------
+        self.init = None
+        if pend is not None and pend[0].size:
+            idst, ivals = pend
+            mdev = (phash_np(idst) % np.uint32(ndev)).astype(np.int64)
+            mc = np.bincount(mdev, minlength=ndev)
+            cap_m = layout.round_capacity(int(mc.max() or 1))
+            hm_d = np.full((ndev, cap_m), _SENT, np.int64)
+            hm_v = np.zeros((ndev, cap_m), self.mdt)
+            mcnt = np.zeros(ndev, np.int32)
+            for dev in range(ndev):
+                m = mdev == dev
+                c = int(m.sum())
+                mcnt[dev] = c
+                if c:
+                    hm_d[dev, :c] = idst[m]
+                    hm_v[dev, :c] = ivals[m].astype(self.mdt)
+            self.init = (put(mcnt), put(hm_d), put(hm_v))
+            self.init_count = int(idst.size)
+        else:
+            self.init_count = 0
+
+        # _discover_mdt's traces double as the early probe: every
+        # unsupported construct in the user compute surfaced there,
+        # before any device state was built
+
+    def _sharding(self):
+        return NamedSharding(self.mesh, P(AXIS))
+
+    # ------------------------------------------------------------------
+    # the per-(class, superstep, mail) traced body
+    # ------------------------------------------------------------------
+    def _class_body(self, d, s, mail, cell, mdt=None):
+        """Per-vertex fn for jax.vmap over one class slice.  mail=False
+        is the object contract's no-mail call (msg is the LITERAL None,
+        so ``msg is not None`` branches exactly as on the host paths).
+        ``cell["m"]`` reports the static emitted-message count of this
+        trace.  mdt=None puts the body in DISCOVERY mode: emitted
+        dtypes collect into cell["mdt"] instead of being checked."""
+        from dpark_tpu.bagel import Edge, Message, Vertex
+        import jax.tree_util as jtu
+        nvl = self.nvl
+        vdef = self.vdef
+        discovery = mdt is None
+        check_mdt = self.mdt if not discovery else None
+
+        def body(*args):
+            i = nvl
+            vls = args[:i]
+            vid = args[i]; i += 1
+            tgts = args[i]; i += 1
+            evs = None
+            if self.has_ev:
+                evs = args[i]; i += 1
+            m = None
+            if mail:
+                m = args[i]; i += 1
+            a = args[i]
+            value = jtu.tree_unflatten(vdef, list(vls))
+            edges = [Edge(tgts[j], evs[j] if evs is not None else None)
+                     for j in range(d)]
+            vert = Vertex(vid, value, edges, a)
+            out = self.compute(vert, m, None, s)
+            if not (isinstance(out, tuple) and len(out) == 2):
+                raise _not_columnar("compute must return "
+                                    "(vertex, messages)")
+            nv, out_msgs = out
+            if not isinstance(nv, Vertex):
+                raise _not_columnar("compute returned non-Vertex")
+            if nv.id is not vert.id:
+                raise _not_columnar("compute rebound vertex id")
+            new_leaves, ndef = jtu.tree_flatten(nv.value)
+            if ndef != vdef:
+                raise _not_columnar(
+                    "compute changed the vertex value structure")
+            outs = []
+            for leaf, dt, shp in zip(new_leaves, self.vdtypes,
+                                     self.vshapes):
+                arr = jnp.asarray(leaf)
+                if np.result_type(arr.dtype, dt) != np.dtype(dt):
+                    raise _not_columnar(
+                        "superstep %d produces %s vertex values, wider "
+                        "than the initial %s" % (s, arr.dtype, dt))
+                arr = jnp.asarray(arr, dt)
+                if arr.shape != shp:
+                    raise _not_columnar("vertex value leaf shape "
+                                        "changed at superstep %d" % s)
+                outs.append(arr)
+            dsts, vals = [], []
+            for msg_obj in (out_msgs or []):
+                if not isinstance(msg_obj, Message):
+                    raise _not_columnar("non-Message output")
+                t = msg_obj.target_id
+                if isinstance(t, bool):
+                    raise _not_columnar("non-integer message target")
+                td = jnp.asarray(t)
+                if td.shape != () or td.dtype.kind not in "iu":
+                    raise _not_columnar(
+                        "message target must be an integer scalar")
+                mv = jnp.asarray(msg_obj.value)
+                if mv.shape != ():
+                    raise _not_columnar("message values must be scalars")
+                if mv.dtype.kind not in "if":
+                    raise _not_columnar("non-numeric message value")
+                if discovery:
+                    cell["mdt"] = (np.result_type(cell["mdt"], mv.dtype)
+                                   if "mdt" in cell else
+                                   np.dtype(mv.dtype))
+                elif np.result_type(mv.dtype, check_mdt) \
+                        != np.dtype(check_mdt):
+                    raise _not_columnar(
+                        "superstep %d emits %s messages, wider than "
+                        "the discovered %s" % (s, mv.dtype, check_mdt))
+                dsts.append(jnp.asarray(td, jnp.int64))
+                vals.append(jnp.asarray(
+                    mv, check_mdt if not discovery else mv.dtype))
+            cell["m"] = len(dsts)
+            na = jnp.asarray(nv.active, bool)
+            if na.shape != ():
+                raise _not_columnar("Vertex.active must be a scalar")
+            md = (jnp.stack(dsts) if dsts
+                  else jnp.zeros((0,), jnp.int64))
+            mv_ = (jnp.stack(vals) if vals
+                   else jnp.zeros((0,), check_mdt or jnp.float64))
+            return tuple(outs) + (na, md, mv_)
+        return body
+
+    def _body_structs(self, d, mdt, mail):
+        vs = [jax.ShapeDtypeStruct((4,) + shp, dt)
+              for dt, shp in zip(self.vdtypes, self.vshapes)]
+        args = vs + [jax.ShapeDtypeStruct((4,), np.int64),
+                     jax.ShapeDtypeStruct((4, d), np.int64)]
+        if self.has_ev:
+            args.append(jax.ShapeDtypeStruct((4, d), self.edt))
+        if mail:
+            args.append(jax.ShapeDtypeStruct((4,), mdt))
+        args.append(jax.ShapeDtypeStruct((4,), np.bool_))
+        return args
+
+    def _discover_mdt(self, pend):
+        """Fixed-point message-dtype discovery across ALL classes and
+        both mail variants — a guess would silently truncate (e.g. int
+        state emitting float shares).  Initial messages seed the guess:
+        they feed the same combine and delivery as emitted ones."""
+        guess = np.result_type(
+            *( [dt for dt in self.vdtypes if dt.kind in "if"]
+               or [np.dtype(np.float64)] ))
+        if pend is not None and pend[0].size:
+            pdt = np.asarray(pend[1]).dtype
+            if pdt.kind not in "if":
+                raise _not_columnar("non-numeric initial message values")
+            guess = np.result_type(guess, pdt)
+        guess = np.dtype(guess)
+        for _ in range(3):
+            found = guess
+            for d in self.classes:
+                for mail in (True, False):
+                    cell = {}
+                    body = self._class_body(d, 0, mail, cell, mdt=None)
+                    try:
+                        jax.eval_shape(jax.vmap(body),
+                                       *self._body_structs(d, guess,
+                                                           mail))
+                    except Exception as e:
+                        from dpark_tpu.bagel import _NotColumnarizable
+                        if isinstance(e, _NotColumnarizable):
+                            raise
+                        raise _not_columnar(
+                            "compute does not trace (%s)" % str(e)[:200])
+                    if "mdt" in cell:
+                        found = np.result_type(found, cell["mdt"])
+            found = np.dtype(found)
+            if found == guess:
+                return found
+            guess = found
+        raise _not_columnar("message dtype does not stabilize")
+
+    # ------------------------------------------------------------------
+    # programs
+    # ------------------------------------------------------------------
+    def _p_init(self):
+        """Bucketize the user's initial messages by hash(dst)."""
+        ndev = self.ndev
+        monoid = self.monoid
+
+        def per_device(mcnt, mdst, mval):
+            kk, vv, counts, offsets = collectives.bucketize_combine(
+                mdst[0], [mval[0]], mcnt[0], ndev, None, monoid=monoid)
+            out = (counts, offsets, kk, vv[0])
+            return tuple(jnp.expand_dims(o, 0) for o in out)
+
+        key = ("init",)
+        if key not in self._compiled:
+            fn = _shard_map(per_device, self.mesh,
+                            in_specs=(P(AXIS),) * 3,
+                            out_specs=(P(AXIS),) * 4)
+            self._compiled[key] = jax.jit(fn)
+        return self._compiled[key]
+
+    def _p_step(self, s, rounds, slot):
+        """One superstep: deliver combined messages to every class
+        slice, run the class-sliced compute, flatten emitted (dst, val)
+        pairs across classes, pre-combine + bucketize them by hash(dst)
+        for the next exchange, and count active vertices and emitted
+        messages."""
+        key = ("step", s, rounds, slot)
+        if key in self._compiled:
+            return self._compiled[key]
+        ndev = self.ndev
+        monoid = self.monoid
+        mdt = self.mdt
+        nvl = self.nvl
+        ncls = len(self.classes)
+        caps = [t["cap"] for t in self.tables]
+        degs = [t["d"] for t in self.tables]
+        has_ev = self.has_ev
+        per_cls_in = 3 + nvl + (1 if has_ev else 0)
+        from dpark_tpu.bagel import monoid_identity
+        ident = monoid_identity(monoid, mdt)
+
+        def per_device(*args):
+            # unpack: per class [vid, act, tgts, (evals,) vals...],
+            # then rounds x cnt, rounds x (dst, val) buffers
+            cls_args = []
+            i = 0
+            for c in range(ncls):
+                cls_args.append(args[i:i + per_cls_in])
+                i += per_cls_in
+            cnts = [a[0] for a in args[i:i + rounds]]
+            i += rounds
+            bufs = args[i:]
+
+            if rounds:
+                recvs = []
+                for r in range(rounds):
+                    recvs.append([bufs[r * 2][0], bufs[r * 2 + 1][0]])
+                flat, mask = collectives.flatten_received(recvs, cnts)
+                uk, uv, _ = collectives.segment_reduce(
+                    flat[0], flat[1:], mask, None, monoid=monoid)
+                uval = uv[0]
+            else:
+                uk = uval = None
+
+            outs = []
+            msg_dsts, msg_vals = [], []
+            n_active = jnp.int64(0)
+            emitted = jnp.int64(0)
+            for c in range(ncls):
+                a = cls_args[c]
+                vid, act, tgts = a[0][0], a[1][0], a[2][0]
+                j = 3
+                evals = None
+                if has_ev:
+                    evals = a[3][0]
+                    j = 4
+                vals = [x[0] for x in a[j:]]
+                cap, d = caps[c], degs[c]
+                valid = vid != _SENT
+                if uk is not None:
+                    pos = jnp.clip(jnp.searchsorted(uk, vid), 0,
+                                   uk.shape[0] - 1)
+                    has = (uk[pos] == vid) & valid
+                    msg = jnp.where(has, uval[pos], ident)
+                else:
+                    has = jnp.zeros(cap, bool)
+                    msg = jnp.full(cap, ident, mdt)
+                invoked = (act | has) & valid
+
+                cm, cn = {}, {}
+                margs = vals + [vid, tgts] \
+                    + ([evals] if has_ev else [])
+                om = jax.vmap(self._class_body(d, s, True, cm,
+                                               mdt=mdt))(
+                    *(margs + [msg, act]))
+                on = jax.vmap(self._class_body(d, s, False, cn,
+                                               mdt=mdt))(
+                    *(margs + [act]))
+                new_vals = []
+                for li in range(nvl):
+                    pick = jnp.where(
+                        collectives._bcast(has, om[li]), om[li], on[li])
+                    new_vals.append(jnp.where(
+                        collectives._bcast(invoked, pick), pick,
+                        vals[li]))
+                new_act = invoked & jnp.where(has, om[nvl], on[nvl])
+                n_active = n_active + jnp.sum(new_act)
+                # emitted (dst, val) blocks: the mail trace's messages
+                # from invoked+has rows, the no-mail trace's from
+                # invoked+~has rows; ungated rows get the sentinel dst
+                # and compact away before the bucketize
+                for blk, gate, cell in ((om, invoked & has, cm),
+                                        (on, invoked & ~has, cn)):
+                    m = cell["m"]
+                    if not m:
+                        continue
+                    dst_b = jnp.where(gate[:, None], blk[nvl + 1],
+                                      _SENT)
+                    val_b = blk[nvl + 2]
+                    msg_dsts.append(dst_b.reshape(-1))
+                    msg_vals.append(val_b.reshape(-1).astype(mdt))
+                    emitted = emitted + jnp.sum(gate) * m
+                outs.extend(new_vals)
+                outs.append(new_act)
+
+            if msg_dsts:
+                dst_flat = jnp.concatenate(msg_dsts)
+                val_flat = jnp.concatenate(msg_vals)
+                smask = dst_flat != _SENT
+                packed, cnt = collectives.compact(
+                    [dst_flat, val_flat], smask)
+                kk, vv, counts, offsets = collectives.bucketize_combine(
+                    packed[0], packed[1:], cnt, ndev, None,
+                    monoid=monoid)
+                mv = vv[0]
+            else:
+                kk = jnp.full((1,), _SENT, jnp.int64)
+                mv = jnp.full((1,), ident, mdt)
+                counts = jnp.zeros((ndev,), jnp.int32)
+                offsets = jnp.zeros((ndev,), jnp.int32)
+            outs += [counts, offsets, kk, mv,
+                     jnp.reshape(n_active, (1,)),
+                     jnp.reshape(emitted, (1,))]
+            return tuple(jnp.expand_dims(o, 0) for o in outs)
+
+        n_in = ncls * per_cls_in + rounds + rounds * 2
+        n_out = ncls * (nvl + 1) + 6
+        fn = _shard_map(per_device, self.mesh,
+                        in_specs=(P(AXIS),) * n_in,
+                        out_specs=(P(AXIS),) * n_out)
+        self._compiled[key] = jax.jit(fn)
+        return self._compiled[key]
+
+    # ------------------------------------------------------------------
+    def run(self):
+        nvl = self.nvl
+        ncls = len(self.classes)
+        pending = None
+        total_msgs = 0
+        if self.init is not None:
+            outs = self._p_init()(*self.init)
+            pending = (outs[0], outs[1], outs[2], outs[3])
+            total_msgs = self.init_count
+
+        s = 0
+        n_active = None
+        while s < self.max_superstep:
+            args = []
+            for t in self.tables:
+                args.extend([t["vid"], t["act"], t["tgts"]]
+                            + ([t["evals"]] if self.has_ev else [])
+                            + t["vals"])
+            if pending is not None and total_msgs > 0:
+                counts, offsets, kk, vv = pending
+                recv_rounds, cnt_rounds, slot = self.ex._exchange_all(
+                    [kk, vv], counts, offsets)
+                rounds = len(recv_rounds)
+                step = self._p_step(s, rounds, slot)
+                args.extend(cnt_rounds)
+                for r in range(rounds):
+                    args.extend(recv_rounds[r])
+            else:
+                step = self._p_step(s, 0, 0)
+            outs = step(*args)
+            i = 0
+            for t in self.tables:
+                t["vals"] = list(outs[i:i + nvl])
+                t["act"] = outs[i + nvl]
+                i += nvl + 1
+            counts, offsets, kk, mv = outs[i:i + 4]
+            pending = (counts, offsets, kk, mv)
+            n_active = int(np.asarray(
+                jax.device_get(outs[i + 4])).sum())
+            total_msgs = int(np.asarray(
+                jax.device_get(outs[i + 5])).sum())
+            s += 1
+            logger.debug("obj superstep %d: active=%d msgs=%d",
+                         s, n_active, total_msgs)
+            if n_active == 0 and total_msgs == 0:
+                break
+        return self._collect()
+
+    def _collect(self):
+        """Final (ids, value leaf columns, active), unpadded and sorted
+        by id."""
+        ids, leaves, actv = [], [[] for _ in range(self.nvl)], []
+        for t in self.tables:
+            vid = np.asarray(jax.device_get(t["vid"]))
+            act = np.asarray(jax.device_get(t["act"]))
+            vls = [np.asarray(jax.device_get(l)) for l in t["vals"]]
+            m = vid != _SENT
+            ids.append(vid[m])
+            actv.append(act[m])
+            for i, l in enumerate(vls):
+                leaves[i].append(l[m])
+        ids = np.concatenate(ids) if ids else np.zeros(0, np.int64)
+        order = np.argsort(ids)
+        leaves = [np.concatenate(ls)[order] for ls in leaves]
+        act = (np.concatenate(actv)[order] if actv
+               else np.zeros(0, bool))
+        return ids[order], leaves, act

@@ -1,0 +1,263 @@
+"""Generalized object-Bagel device adapter (VERDICT r4 #4): power-law
+degrees beyond 8, messages to arbitrary targets (non-neighbors,
+constants), pytree/vector vertex values, numeric edge values, and
+variable message counts — all columnarize onto the device with parity
+against the local object path."""
+
+import operator
+import random
+
+import numpy as np
+import pytest
+
+from dpark_tpu.bagel import Bagel, BasicCombiner, Edge, Message, Vertex
+
+
+def _run_both(program_fn, build_fn, max_superstep=80):
+    from dpark_tpu import DparkContext
+    outs = []
+    used = False
+    for master in ("tpu", "local"):
+        c = DparkContext(master)
+        c.start()
+        try:
+            verts, msgs, combiner = build_fn(c)
+            final = Bagel.run(c, verts, msgs, program_fn,
+                              combiner=combiner,
+                              max_superstep=max_superstep)
+            outs.append({vid: v.value for vid, v in final.collect()})
+            if master == "tpu":
+                used = getattr(c.scheduler, "_pregel_device_used",
+                               False)
+        finally:
+            c.stop()
+    return outs[0], outs[1], used
+
+
+def _close(a, b, tol=1e-9):
+    assert set(a) == set(b)
+    for k in a:
+        va, vb = a[k], b[k]
+        la = va if isinstance(va, (tuple, list, np.ndarray)) else [va]
+        lb = vb if isinstance(vb, (tuple, list, np.ndarray)) else [vb]
+        assert np.allclose(np.asarray(la, np.float64),
+                           np.asarray(lb, np.float64),
+                           rtol=tol, atol=tol), (k, va, vb)
+
+
+def _power_law_graph(n=400, seed=7):
+    """Degrees drawn from a power-law-ish ladder with max degree 128
+    (>> the old cap of 8) while keeping distinct classes within the
+    trace budget."""
+    ladder = [0, 1, 1, 1, 2, 2, 3, 4, 5, 6, 8, 10, 13, 16,
+              20, 26, 32, 40, 64, 128]
+    rng = random.Random(seed)
+    degs = [ladder[min(int(rng.paretovariate(1.1)) - 1, len(ladder) - 1)]
+            for _ in range(n)]
+    degs[0] = 128                      # guarantee the heavy hub exists
+    verts = []
+    for i in range(n):
+        edges = [Edge(rng.randrange(n)) for _ in range(degs[i])]
+        verts.append((i, Vertex(i, 1.0 / n, edges)))
+    return verts
+
+
+def test_power_law_pagerank_rides_device():
+    """PageRank on a power-law graph: max degree 128, ~15 degree
+    classes — columnarizes (the r4 adapter refused anything past
+    degree 8) and matches the local object loop."""
+    n = 400
+    verts_rows = _power_law_graph(n)
+    assert max(len(v.outEdges) for _, v in verts_rows) == 128
+
+    def compute(vert, msg, agg, s):
+        new = vert.value if s == 0 else (
+            0.15 / n + 0.85 * (msg if msg is not None else 0.0))
+        v = Vertex(vert.id, new, vert.outEdges, s < 8)
+        if s < 8 and vert.outEdges:
+            share = new / len(vert.outEdges)
+            return (v, [Message(e.target_id, share)
+                        for e in vert.outEdges])
+        return (v, [])
+
+    def build(c):
+        return (c.parallelize(verts_rows, 8), c.parallelize([], 8),
+                BasicCombiner(operator.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert used, "power-law program did not ride the device"
+    _close(tpu, local)
+
+
+def test_messages_to_non_neighbors():
+    """Targets are COMPUTED ids, not edges at all — the r4 adapter's
+    own-out-edges-only rule is gone; delivery is a hash(dst)
+    exchange."""
+    n = 64
+
+    def compute(vert, msg, agg, s):
+        got = msg if msg is not None else 0
+        v = Vertex(vert.id, vert.value + got, vert.outEdges, s < 3)
+        if s < 3:
+            # send to a hashed non-neighbor (the graph has NO edges)
+            return (v, [Message((vert.id * vert.id + 7) % n,
+                                vert.id + 1)])
+        return (v, [])
+
+    def build(c):
+        rows = [(i, Vertex(i, 0, [])) for i in range(n)]
+        return (c.parallelize(rows, 8), c.parallelize([], 8),
+                BasicCombiner(operator.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert used, "computed-target program did not ride the device"
+    assert tpu == local
+
+
+def test_message_to_constant_hub():
+    """A constant Python-int target (everyone notifies vertex 0)."""
+    n = 40
+
+    def compute(vert, msg, agg, s):
+        got = msg if msg is not None else 0
+        v = Vertex(vert.id, vert.value + got, vert.outEdges, s < 2)
+        if s < 2:
+            return (v, [Message(0, 1)])
+        return (v, [])
+
+    def build(c):
+        rows = [(i, Vertex(i, 0, [])) for i in range(n)]
+        return (c.parallelize(rows, 8), c.parallelize([], 8),
+                BasicCombiner(operator.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert used
+    assert tpu == local
+    assert local[0] == 2 * n             # hub got everyone's 1, twice
+
+
+def test_variable_message_count():
+    """Emitting ONE message despite many out-edges (notify-first) —
+    the r4 adapter required exactly one message per out-edge."""
+    n = 48
+    rng = random.Random(3)
+    rows = [(i, Vertex(i, 0,
+                       [Edge(rng.randrange(n)) for _ in range(6)]))
+            for i in range(n)]
+
+    def compute(vert, msg, agg, s):
+        got = msg if msg is not None else 0
+        v = Vertex(vert.id, vert.value + got, vert.outEdges, s < 3)
+        if s < 3 and vert.outEdges:
+            return (v, [Message(vert.outEdges[0].target_id, 1)])
+        return (v, [])
+
+    def build(c):
+        return (c.parallelize(rows, 8), c.parallelize([], 8),
+                BasicCombiner(operator.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert used
+    assert tpu == local
+
+
+def test_tuple_vertex_values():
+    """Vertex.value as a (count, weight) tuple — pytree leaves ride as
+    separate device columns."""
+    n = 32
+    rows = [(i, Vertex(i, (0, float(i)), [Edge((i + 1) % n)]))
+            for i in range(n)]
+
+    def compute(vert, msg, agg, s):
+        cnt, w = vert.value
+        got = msg if msg is not None else 0.0
+        v = Vertex(vert.id, (cnt + 1, w + got), vert.outEdges, s < 4)
+        if s < 4:
+            return (v, [Message(e.target_id, w * 0.5)
+                        for e in vert.outEdges])
+        return (v, [])
+
+    def build(c):
+        return (c.parallelize(rows, 8), c.parallelize([], 8),
+                BasicCombiner(operator.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert used, "tuple-valued program did not ride the device"
+    _close(tpu, local)
+
+
+def test_edge_values_ride_device():
+    """Numeric Edge.value feeds the emitted messages (weighted
+    propagation)."""
+    n = 32
+    rng = random.Random(11)
+    rows = [(i, Vertex(i, 1.0,
+                       [Edge((i + k) % n, rng.random())
+                        for k in (1, 2, 3)]))
+            for i in range(n)]
+
+    def compute(vert, msg, agg, s):
+        got = msg if msg is not None else 0.0
+        v = Vertex(vert.id, vert.value + got, vert.outEdges, s < 3)
+        if s < 3:
+            return (v, [Message(e.target_id, vert.value * e.value)
+                        for e in vert.outEdges])
+        return (v, [])
+
+    def build(c):
+        return (c.parallelize(rows, 8), c.parallelize([], 8),
+                BasicCombiner(operator.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert used, "edge-valued program did not ride the device"
+    _close(tpu, local)
+
+
+def test_too_many_degree_classes_falls_back():
+    """More distinct degrees than the trace budget: host path, parity
+    intact."""
+    from dpark_tpu import bagel as bagel_mod
+    n = 80
+    rows = [(i, Vertex(i, 0, [Edge((i + k) % n)
+                              for k in range(1, 2 + (i % 40))]))
+            for i in range(n)]
+    assert len({len(v.outEdges) for _, v in rows}) \
+        > bagel_mod.MAX_DEGREE_CLASSES
+
+    def compute(vert, msg, agg, s):
+        got = msg if msg is not None else 0
+        v = Vertex(vert.id, vert.value + got, vert.outEdges, s < 2)
+        if s < 2:
+            return (v, [Message(e.target_id, 1)
+                        for e in vert.outEdges])
+        return (v, [])
+
+    def build(c):
+        return (c.parallelize(rows, 8), c.parallelize([], 8),
+                BasicCombiner(operator.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert not used
+    assert tpu == local
+
+
+def test_non_integer_target_falls_back():
+    """A string message target is outside the columnar subset but must
+    still run correctly on the host path."""
+    rows = [("a", Vertex("a", 0, [])), ("b", Vertex("b", 0, []))]
+
+    def compute(vert, msg, agg, s):
+        got = msg if msg is not None else 0
+        v = Vertex(vert.id, vert.value + got, vert.outEdges, s < 2)
+        if s < 2:
+            return (v, [Message("a", 1)])
+        return (v, [])
+
+    def build(c):
+        return (c.parallelize(rows, 2), c.parallelize([], 2),
+                BasicCombiner(operator.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert not used
+    assert tpu == local
+    assert local["a"] == 4               # both vertices notify "a" twice
