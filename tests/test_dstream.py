@@ -107,6 +107,78 @@ def test_update_state_by_key(ctx):
     assert dict(out[2][1]) == {"a": 3, "b": 6}
 
 
+def test_stateful_wordcount_rides_device_end_to_end():
+    """The running-sum updateStateByKey idiom rewrites to one flat
+    union-reduce per batch (VERDICT r4 #5), so on the tpu master every
+    steady-state stage rides the array path — asserted by stage kinds,
+    with values matching the local master."""
+    from dpark_tpu import DparkContext
+
+    def drive(master):
+        c = DparkContext(master)
+        ssc = make_ssc(c, batch=1.0)
+        out = []
+        batches = [[("w%d" % (i % 9), 1) for i in range(j * 17,
+                                                        j * 17 + 300)]
+                   for j in range(5)]
+        # int-keyed variant keeps the whole pipeline on device
+        batches = [[(hash(k) % 64, v) for k, v in b] for b in batches]
+        q = ssc.queueStream(batches)
+
+        def update(vs, prev):
+            return (prev or 0) + sum(vs)
+
+        q.updateStateByKey(update, numSplits=8).collect_batches(out)
+        run_batches(ssc, 5)
+        kinds = set()
+        for rec in c.scheduler.history:
+            for s in rec.get("stage_info", []):
+                if rec.get("parts") == 1:
+                    continue        # the one-time numeric take() probe
+                kinds.add((s["rdd"], s.get("kind")))
+        c.stop()
+        return [sorted(v) for _, v in out], kinds
+
+    got, kinds = drive("tpu")
+    exp, _ = drive("local")
+    assert got == exp
+    assert {k for k, v in kinds} >= {"UnionRDD", "ShuffledRDD"}, kinds
+    assert {v for k, v in kinds} == {"array"}, kinds
+
+
+def test_state_monoid_hint_and_fallback(ctx):
+    """__dpark_state_monoid__ opts an equivalent-but-unprovable update
+    into the rewrite; a non-numeric stream keeps the cogroup path with
+    identical results."""
+    from dpark_tpu.dstream import _classify_state_update
+    import operator
+
+    def total(vs, prev):
+        acc = prev if prev is not None else 0
+        for v in vs:
+            acc += v
+        return acc
+    assert _classify_state_update(total) is None
+    total.__dpark_state_monoid__ = "add"
+    assert _classify_state_update(total) is operator.add
+
+    # string values: sum() would raise on the host path; the probe
+    # must keep such streams off the pairwise rewrite
+    ssc = make_ssc(ctx)
+    out = []
+    q = ssc.queueStream([[("k", "a")], [("k", "b")]])
+
+    def concat(vs, prev):
+        s = prev or ""
+        for v in vs:
+            s += v
+        return s
+
+    q.updateStateByKey(concat).collect_batches(out)
+    run_batches(ssc, 2)
+    assert dict(out[1][1]) == {"k": "ab"}
+
+
 def test_state_eviction(ctx):
     """update returning None drops the key."""
     ssc = make_ssc(ctx)
