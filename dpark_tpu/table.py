@@ -320,11 +320,38 @@ class TableRDD:
 
 _SQL_RE = re.compile(
     r"^\s*select\s+(?P<cols>.+?)\s+from\s+(?P<table>\w+)"
+    r"(?:\s+join\s+(?P<jtable>\w+)\s+on\s+(?P<jon>.+?))?"
     r"(?:\s+where\s+(?P<where>.+?))?"
     r"(?:\s+group\s+by\s+(?P<group>.+?))?"
+    r"(?:\s+having\s+(?P<having>.+?))?"
     r"(?:\s+order\s+by\s+(?P<order>.+?)(?P<dir>\s+(?:asc|desc))?)?"
     r"(?:\s+limit\s+(?P<limit>\d+))?\s*$",
     re.I | re.S)
+
+_JOIN_ON_RE = re.compile(
+    r"^\s*(?:\w+\s*\.\s*)?(\w+)\s*(?:=\s*(?:\w+\s*\.\s*)?(\w+)\s*)?$")
+
+# an aggregate CALL embedded in a larger expression (one paren-nesting
+# level in the argument, e.g. avg(abs(x)))
+_AGG_CALL_RE = re.compile(
+    r"\b(count|sum|avg|min|max|adcount|first|group_concat)\s*"
+    r"\(([^()]*(?:\([^()]*\)[^()]*)*)\)", re.I)
+
+
+def _sub_aggs(expr, add_agg):
+    """Replace every aggregate call in `expr` with the column name
+    `add_agg(call_text)` returns.  Enables aggregate EXPRESSIONS in
+    SELECT and HAVING (``sum(v) / count(*) as r``, ``having count(*)
+    > 3``): the calls compute in the grouped aggregation, the
+    surrounding expression evaluates over the aggregated row.
+    Returns (rewritten_expr, any_found)."""
+    found = []
+
+    def repl(m):
+        found.append(True)
+        return add_agg(m.group(0))
+
+    return _AGG_CALL_RE.sub(repl, expr), bool(found)
 
 
 def _mask_literals(sql):
@@ -351,8 +378,12 @@ def _mask_literals(sql):
 def execute(sql, tables):
     """Minimal SQL-ish front over TableRDD (reference: dpark table's
     `execute` [SURVEY.md 2.3, low-confidence item]).  Supports
-    SELECT cols FROM t [WHERE expr] [GROUP BY keys] [ORDER BY col [DESC]]
-    [LIMIT n]; column expressions and aggregates use the DSL's syntax.
+    SELECT cols FROM t [JOIN t2 ON col] [WHERE expr] [GROUP BY keys]
+    [HAVING expr] [ORDER BY col [DESC]] [LIMIT n]; column expressions
+    and aggregates use the DSL's syntax.  SELECT and HAVING may use
+    aggregate EXPRESSIONS (``sum(v) / count(*)``); JOIN ... ON lowers
+    to TableRDD.join (the device-riding equi-join) and accepts ``col``
+    or ``a.col = b.col`` with the same column name on both sides.
 
     `tables`: dict name -> TableRDD.  Returns a TableRDD, or a row list
     when LIMIT is given.
@@ -368,6 +399,19 @@ def execute(sql, tables):
     t = tables.get(m.group("table"))
     if t is None:
         raise ValueError("unknown table %r" % m.group("table"))
+    if m.group("jtable"):
+        other = tables.get(m.group("jtable"))
+        if other is None:
+            raise ValueError("unknown table %r" % m.group("jtable"))
+        jm = _JOIN_ON_RE.match(part("jon"))
+        if not jm:
+            raise ValueError("unsupported JOIN ON: %r" % part("jon"))
+        lcol, rcol = jm.group(1), jm.group(2) or jm.group(1)
+        if lcol != rcol:
+            raise ValueError(
+                "JOIN ON must equate the same column name "
+                "(%r vs %r)" % (lcol, rcol))
+        t = t.join(other, lcol)
     if part("where"):
         t = t.where(part("where"))
 
@@ -375,30 +419,65 @@ def execute(sql, tables):
     desc = (m.group("dir") or "").strip().lower() == "desc"
     cols = part("cols").strip()
 
+    if part("having") and not part("group"):
+        raise ValueError("HAVING requires GROUP BY")
     if part("group"):
         group_keys = _split_cols((part("group"),))
         sel = _split_cols((cols,))
-        aggs, out_names = [], []
+        aggs, out_exprs, out_names = [], [], []
         key_names = [re.sub(r"\W+", "_", k).strip("_") or ("k%d" % i)
                      for i, k in enumerate(group_keys)]
+
+        def add_agg(text):
+            # helper column for one aggregate call (leading underscores
+            # would be stripped by _parse_column's sanitizer); dodge
+            # user columns of the same name
+            name = "agg%d" % len(aggs)
+            while name in t.fields or name in key_names:
+                name += "x"
+            aggs.append("%s as %s" % (text, name))
+            return name
+
         for c in sel:
             am = _AS_RE.match(c)
-            expr = am.group(1) if am else c
-            if _AGG_RE.match(expr):
-                aggs.append(c)
-                out_names.append(_parse_column(c, t.fields, 0)[0])
-            elif c.strip() in group_keys:
-                out_names.append(
-                    key_names[group_keys.index(c.strip())])
+            expr, alias = (am.group(1), am.group(2)) if am \
+                else (c, None)
+            # _AGG_RE alone would also "match" compound expressions
+            # (its lazy arg + end anchor spans `sum(a) * 2 + count(*)`)
+            # — a BARE call is a fullmatch of the balanced call regex
+            if _AGG_CALL_RE.fullmatch(expr.strip()):
+                name = alias or _parse_column(c, t.fields, 0)[0]
+                out_exprs.append("%s as %s" % (add_agg(expr), name))
+                out_names.append(name)
+            elif expr.strip() in group_keys:
+                kn = key_names[group_keys.index(expr.strip())]
+                name = alias or kn
+                out_exprs.append("%s as %s" % (kn, name))
+                out_names.append(name)
             else:
-                raise ValueError(
-                    "non-aggregate select column %r is not a group key"
-                    % c)
+                new, found = _sub_aggs(expr, add_agg)
+                if not found:
+                    raise ValueError(
+                        "non-aggregate select column %r is not a "
+                        "group key" % c)
+                name = alias or ("col%d" % len(out_exprs))
+                out_exprs.append("%s as %s" % (new, name))
+                out_names.append(name)
+        hav = None
+        if part("having"):
+            hav, _ = _sub_aggs(part("having"), add_agg)
         t = t.groupBy(group_keys, *aggs)
+        if hav is not None:
+            t = t.where(hav)
+        if order and order not in out_names:
+            # ORDER BY a grouped column that the SELECT list drops or
+            # renames: sort on the aggregated table before projecting
+            t = t.sort(order, reverse=desc)
+            order = ""
+        t = t.select(*out_exprs)
         if order:
             t = t.sort(order, reverse=desc)
             order = ""
-        t = t.select(*out_names)
     else:
         # ORDER BY may reference either the source columns or a projected
         # output name: sort on whichever side actually holds it
