@@ -133,25 +133,7 @@ def classify_merge(merge):
     return name
 
 
-def _builtin_globals_ok(f, code):
-    """Every global the bytecode references still resolves to the
-    builtin of that name (shadowed min/max etc. are not provable)."""
-    import builtins
-    fglobals = f.__globals__
-    fbuiltins = fglobals.get("__builtins__", builtins)
-    for g in code.co_names:
-        expected = getattr(builtins, g, None)
-        if expected is None:
-            return False
-        if g in fglobals:
-            if fglobals[g] is not expected:
-                return False
-        elif isinstance(fbuiltins, dict):
-            if fbuiltins.get(g) is not expected:
-                return False             # custom __builtins__ dict
-        elif getattr(fbuiltins, g, None) is not expected:
-            return False
-    return True
+from dpark_tpu.utils import builtin_globals_ok as _builtin_globals_ok
 
 
 _SEGAGG_DIRECT = None
@@ -464,10 +446,13 @@ def extract_chain(top, cached_ids=()):
     cur = top
     passthrough = False
     while True:
-        if getattr(cur, "_snapshot_path", None) is not None:
-            # snapshot(): the user asked for disk materialization with
-            # cross-run reuse — the object path honors the read/write;
-            # fusing past it would silently skip both
+        if getattr(cur, "_snapshot_path", None) is not None \
+                or cur._checkpoint_path is not None \
+                or cur._checkpoint_rdd is not None:
+            # snapshot()/checkpoint(): the user asked for disk
+            # materialization — the object path honors the read/write
+            # (and the lazy checkpoint's promotion); fusing past it
+            # would silently skip both
             return None
         if cur.id in cached_ids:
             ops.reverse()
@@ -547,6 +532,10 @@ def extract_text_chain(top):
     chain = []
     cur = top
     while True:
+        if getattr(cur, "_snapshot_path", None) is not None \
+                or cur._checkpoint_path is not None \
+                or cur._checkpoint_rdd is not None:
+            return None          # disk materialization: object path
         if isinstance(cur, sources):
             chain.reverse()
             return cur, chain

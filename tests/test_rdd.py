@@ -196,9 +196,116 @@ def test_cache(ctx):
 def test_checkpoint(ctx, tmp_path):
     r = ctx.parallelize(range(20), 4).map(lambda x: x + 1)
     r.checkpoint(str(tmp_path / "ckpt"))
-    assert r.dependencies == []
     assert r.collect() == list(range(1, 21))
+    assert r.dependencies == []          # truncated after first job
     assert r.reduce(lambda a, b: a + b) == 210
+
+
+def test_checkpoint_is_lazy(ctx, tmp_path):
+    """Reference semantics (VERDICT r4 #8): checkpoint() before any
+    action runs NO job and computes nothing; the first job
+    materializes every split (atomic part files), then lineage
+    truncates to a CheckpointRDD; later jobs read the files."""
+    import os
+    from dpark_tpu.rdd import CheckpointRDD
+    calls = []
+
+    def spy(x):
+        calls.append(x)
+        return x * 2
+
+    r = ctx.parallelize(range(12), 3).map(spy)
+    ck = str(tmp_path / "lazyck")
+    r.checkpoint(ck)
+    assert calls == []                   # no eager job
+    assert [f for f in os.listdir(ck)
+            if f.startswith("part-")] == []   # nothing materialized
+    assert r.dependencies != []          # lineage intact pre-compute
+
+    assert sorted(r.collect()) == sorted(x * 2 for x in range(12))
+    assert len(calls) == 12              # computed exactly once
+    parts = sorted(f for f in os.listdir(ck) if f.startswith("part-"))
+    assert parts == ["part-%05d" % i for i in range(3)]
+
+    # promotion: lineage truncated, reads come from the files
+    assert isinstance(r._checkpoint_rdd, CheckpointRDD)
+    assert r.dependencies == []
+    assert sorted(r.collect()) == sorted(x * 2 for x in range(12))
+    assert len(calls) == 12              # no recomputation
+
+    # a surviving directory short-circuits a fresh lineage immediately
+    calls2 = []
+
+    def spy2(x):
+        calls2.append(x)
+        return x * 2
+
+    r2 = ctx.parallelize(range(12), 3).map(spy2)
+    r2.checkpoint(ck)
+    assert isinstance(r2._checkpoint_rdd, CheckpointRDD)
+    assert sorted(r2.collect()) == sorted(x * 2 for x in range(12))
+    assert calls2 == []
+
+
+def test_checkpoint_under_process_master(tmp_path):
+    """Lazy checkpoint with FORKED workers: parts are written by the
+    workers, the driver promotes on its next splits access (review
+    finding: workers must never rebuild stripped splits)."""
+    from dpark_tpu import DparkContext
+    c = DparkContext("process:2")
+    try:
+        r = c.parallelize(range(12), 3).map(lambda x: x + 1)
+        ck = str(tmp_path / "procck")
+        r.checkpoint(ck)
+        assert sorted(r.collect()) == list(range(1, 13))
+        import os
+        assert sorted(f for f in os.listdir(ck)
+                      if f.startswith("part-")) \
+            == ["part-%05d" % i for i in range(3)]
+        _ = r.splits                     # driver-side promotion point
+        assert r._checkpoint_rdd is not None
+        assert sorted(r.collect()) == list(range(1, 13))
+    finally:
+        c.stop()
+
+
+def test_checkpoint_stale_dir_discarded(ctx, tmp_path):
+    """A checkpoint dir written for a DIFFERENT split layout must not
+    silently supply data (review finding)."""
+    import os
+    ck = str(tmp_path / "staleck")
+    r1 = ctx.parallelize(range(6), 6)
+    r1.checkpoint(ck)
+    assert sorted(r1.collect()) == list(range(6))
+    assert r1._checkpoint_rdd is not None
+    # a differently-shaped RDD pointed at the same dir: stale parts
+    # are discarded, fresh data computes and re-materializes
+    r2 = ctx.parallelize([100, 200, 300], 3)
+    r2.checkpoint(ck)
+    assert r2._checkpoint_rdd is None    # nothing trusted yet
+    assert sorted(r2.collect()) == [100, 200, 300]
+    assert sorted(f for f in os.listdir(ck)
+                  if f.startswith("part-")) \
+        == ["part-%05d" % i for i in range(3)]
+    assert r2._checkpoint_rdd is not None
+    assert sorted(r2.collect()) == [100, 200, 300]
+
+
+def test_checkpoint_partial_then_complete(ctx, tmp_path):
+    """A job touching ONLY some partitions writes only those parts; a
+    later whole-RDD job completes the set and promotes."""
+    import os
+    r = ctx.parallelize(range(12), 3).map(lambda x: x + 1)
+    ck = str(tmp_path / "partck")
+    r.checkpoint(ck)
+    first = list(ctx.runJob(r, list, partitions=[0]))[0]
+    assert first == [1, 2, 3, 4]
+    assert sorted(f for f in os.listdir(ck)
+                  if f.startswith("part-")) == ["part-00000"]
+    assert r._checkpoint_rdd is None     # not complete yet
+    assert sorted(r.collect()) == list(range(1, 13))
+    assert r._checkpoint_rdd is not None
+    assert r.dependencies == []
 
 
 def test_text_file_roundtrip(ctx, tmp_path):
