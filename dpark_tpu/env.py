@@ -46,6 +46,7 @@ class MapOutputTracker:
 
 class DparkEnv:
     def __init__(self):
+        from dpark_tpu.hostatus import TaskHostManager
         self.started = False
         self.is_master = False
         self.workdir = None
@@ -56,6 +57,11 @@ class DparkEnv:
         self.bucket_server = None         # DCN data plane, opt-in
         self.tracker_client = None        # DCN metadata plane, opt-in
         self.tracker_addr = None
+        # ONE host-health view per process, shared by the scheduler's
+        # task placement AND the shuffle fetcher's replica choice —
+        # fetch failures inform placement and vice versa (SURVEY.md
+        # section 5.3; hostatus.py)
+        self.host_manager = TaskHostManager()
 
     def start(self, is_master=True, environ=None):
         if self.started:

@@ -65,10 +65,22 @@ class TaskHostManager:
     def offer_choice(self, hosts, now=None):
         """Pick the best host from candidates: never-blacklisted first,
         fewest recent failures next (reference: task_prefered_hosts)."""
-        ranked = sorted(
-            (h for h in hosts if not self.is_blacklisted(h, now)),
-            key=lambda h: self.hosts[h].recent_failure_rate(now)
-            if h in self.hosts else 0.0)
-        if ranked:
-            return ranked[0]
-        return hosts[0] if hosts else None
+        ranked = self.rank_hosts(hosts, now)
+        return ranked[0] if ranked else None
+
+    def rank_hosts(self, hosts, now=None):
+        """All candidates, best first: healthy hosts by recent failure
+        rate, then blacklisted ones (last resorts, still tried when
+        nothing else is left — e.g. every replica of a shuffle bucket
+        lives on flagged hosts)."""
+        return self.rank_items(hosts, lambda h: h, now)
+
+    def rank_items(self, items, host_of, now=None):
+        """rank_hosts generalized to items CARRYING a host (shuffle
+        replica uris): one ranking rule for placement and fetch."""
+        def key(item):
+            h = host_of(item)
+            rate = (self.hosts[h].recent_failure_rate(now)
+                    if h in self.hosts else 0.0)
+            return (self.is_blacklisted(h, now), rate)
+        return sorted(items, key=key)

@@ -77,13 +77,15 @@ class DAGScheduler:
     submit_tasks()."""
 
     def __init__(self):
-        from dpark_tpu.hostatus import TaskHostManager
+        from dpark_tpu.env import env
         self.shuffle_to_stage = {}
         self.started = False
         self.profile = None            # MergedProfile when --profile
-        # host health (trivial on single-host masters; the multi-host DCN
-        # dispatcher consults is_blacklisted/offer_choice)
-        self.host_manager = TaskHostManager()
+        # host health, SHARED with the shuffle fetcher's replica choice
+        # through env (trivial on single-host masters; the multi-host
+        # DCN paths consult is_blacklisted/offer_choice/rank_hosts);
+        # env constructs it unconditionally
+        self.host_manager = env.host_manager
         self.history = []              # job records for the web UI
         self._next_job_id = 0
 

@@ -91,6 +91,52 @@ def read_bucket(uri, shuffle_id, map_id, reduce_id):
     raise ValueError("unsupported shuffle uri %r" % uri)
 
 
+def uri_host(uri):
+    """The host-health key of a shuffle location: the peer hostname for
+    tcp:// uris, the uri itself otherwise (file/hbm locations fail for
+    local reasons, but tracking them is still harmless)."""
+    if uri.startswith("tcp://"):
+        return uri[len("tcp://"):].rpartition(":")[0]
+    return uri
+
+
+def read_bucket_any(uris, shuffle_id, map_id, reduce_id):
+    """Fetch one map output from the best of its REPLICA locations.
+
+    `uris`: one uri string, or a list/tuple of replicas (a map output
+    re-served from several hosts).  Replicas are tried in
+    hostatus-ranked order — a blacklisted host is skipped while any
+    healthy replica exists, and every attempt's outcome feeds back into
+    the shared health view (SURVEY.md section 5.3: the blacklist must
+    CHANGE where the bytes come from, not just count failures).
+    Raises FetchFailed when every replica fails."""
+    from dpark_tpu.env import env
+    if isinstance(uris, str):
+        uris = (uris,)
+    hm = env.host_manager
+    ordered = list(uris)
+    if len(ordered) > 1:
+        # hostatus ranking by each replica's HOST (two replicas on one
+        # host share fate): healthy-first, then by recent failure rate
+        ordered = hm.rank_items(ordered, uri_host)
+    last_err = None
+    for uri in ordered:
+        try:
+            items = read_bucket(uri, shuffle_id, map_id, reduce_id)
+        except Exception as e:
+            hm.task_failed_on(uri_host(uri))
+            logger.warning("fetch failed %s: %s", uri, e)
+            last_err = e
+            continue
+        if uri.startswith("tcp://"):
+            hm.task_succeed_on(uri_host(uri))
+        return items
+    if isinstance(last_err, FetchFailed):
+        raise last_err
+    raise FetchFailed(ordered[0] if ordered else None, shuffle_id,
+                      map_id, reduce_id)
+
+
 class SimpleShuffleFetcher:
     """Sequential fetch of every map output for one reduce partition."""
 
@@ -102,15 +148,7 @@ class SimpleShuffleFetcher:
         for map_id, uri in enumerate(locs):
             if uri is None:
                 raise FetchFailed(uri, shuffle_id, map_id, reduce_id)
-            try:
-                items = read_bucket(uri, shuffle_id, map_id, reduce_id)
-            except FetchFailed:
-                raise
-            except Exception as e:
-                # any read failure (missing file, evicted HBM shuffle,
-                # decode error) becomes FetchFailed -> lineage recovery
-                logger.warning("fetch failed %s: %s", uri, e)
-                raise FetchFailed(uri, shuffle_id, map_id, reduce_id)
+            items = read_bucket_any(uri, shuffle_id, map_id, reduce_id)
             merge_func(items)
 
     def stop(self):
@@ -145,8 +183,8 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
                     return
                 try:
                     results.put((None,
-                                 read_bucket(uri, shuffle_id, map_id,
-                                             reduce_id)))
+                                 read_bucket_any(uri, shuffle_id,
+                                                 map_id, reduce_id)))
                 except BaseException:
                     # never die silently: the fetch loop counts results
                     results.put((FetchFailed(uri, shuffle_id, map_id,

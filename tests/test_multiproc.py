@@ -28,7 +28,16 @@ def test_spmd_full_job_two_processes():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import __graft_entry__ as g
-    g._dryrun_spmd_job()
+    g._dryrun_spmd_job(nprocs=2, local_devices=4)
+
+
+def test_spmd_full_job_four_processes():
+    """4 controller processes x 2 devices each on one 8-device mesh
+    (VERDICT r4 #6: past 2 ranks)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__ as g
+    g._dryrun_spmd_job(nprocs=4, local_devices=2)
 
 
 def test_host_read_and_put_sharded_single_process(tctx):
