@@ -220,20 +220,30 @@ class JAXExecutor:
 
     def _epilogue_merge(self, plan):
         """(merge_fn, monoid) for a combining shuffle write, or
-        (None, None) for the no-combine (list-aggregator) mode."""
+        (None, None) for the no-combine (list-aggregator) mode.
+
+        The two are independent: a PROVABLE monoid combines through
+        segment scatters even when the user's function itself does not
+        trace (``max(a, b)`` forces a tracer bool) — discarding the
+        monoid with the failed trace crashed the streamed combine (r5
+        fuzz finding).  Untraceable AND unclassified merges exchange
+        raw created combiners."""
         dep = plan.epilogue[1]
         if fuse.is_list_agg(dep.aggregator):
             return None, None
+        try:
+            monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
+        except Exception:
+            monoid = None
         try:
             merge_fn = fuse._leaves_merge_fn(
                 dep.aggregator.merge_combiners, plan.out_treedef)
             structs = fuse._batched_spec_struct(plan.out_specs[1:])
             jax.eval_shape(lambda *v: merge_fn(list(v), list(v)),
                            *structs)
-            monoid = fuse.classify_merge(dep.aggregator.merge_combiners)
-            return merge_fn, monoid
         except Exception:
-            return None, None      # exchange raw created combiners
+            merge_fn = None
+        return merge_fn, monoid
 
     @staticmethod
     def _epilogue_block(plan, lv, n, n_dst, merge_fn, monoid, bounds):
@@ -248,7 +258,7 @@ class JAXExecutor:
                                         n_dst, valid, r=r)
         else:
             dst = None
-        if merge_fn is not None:
+        if merge_fn is not None or monoid is not None:
             k2, v2, cnts, offs = collectives.bucketize_combine(
                 k, lv[1:], n, n_dst, merge_fn, monoid=monoid, dst=dst,
                 r=r)
@@ -1219,7 +1229,8 @@ class JAXExecutor:
                                             r, valid, r=r)
             else:
                 rid = collectives.hash_dst(k, r, valid, r=r)
-            if carry_rid and merge_fn is not None:
+            if carry_rid and (merge_fn is not None
+                              or monoid is not None):
                 cols, cnts, offs = collectives.bucketize_combine_rid(
                     rid, k, lv[1:], n, ndev, merge_fn, monoid=monoid)
             elif carry_rid:
@@ -1292,7 +1303,7 @@ class JAXExecutor:
             recv = self._exchange_all(leaves, cnts, offs,
                                       slot_floor=slot_floor)
             slot_floor = max(slot_floor, recv[2])
-            if pre_merge is not None:
+            if pre_merge is not None or pre_monoid is not None:
                 sorted_batch = self._prereduce_received(
                     plan, recv, pre_merge, pre_monoid)
             else:

@@ -1,16 +1,21 @@
 """Columnarized object-Bagel parity fuzzer: random NUMERIC object
 programs (random graphs, degrees, halting/emission schedules, monoids,
-initial messages) must produce identical results on the tpu master's
-device-columnarized path and the local master's object loop — and must
-actually ride the device (every generated program is columnarizable by
-construction)."""
+initial messages, message-target modes) must produce identical results
+on the tpu master's device-columnarized path and the local master's
+object loop — and must actually ride the device (every generated
+program is columnarizable by construction).
+
+r5 depth (VERDICT r4 #9): halt-and-send programs, computed non-neighbor
+targets, single-message emission, tuple vertex values, many-distinct-
+degree graphs near the class budget, and fallback-boundary programs
+asserted to fall back AND match."""
 
 import random
 
 import pytest
 
 
-def _build_program(rng):
+def _build_program(rng, n):
     """Random but trace-safe object compute: branches only on the
     superstep, the (static) out-degree, and `msg is not None`."""
     from dpark_tpu.bagel import Message, Vertex
@@ -23,31 +28,55 @@ def _build_program(rng):
     emit_set = set(rng.sample(range(4), rng.randint(1, 4)))
     mc1 = rng.choice([1, 2])
     mc2 = rng.randint(-2, 2)
+    tuple_vals = rng.random() < 0.3
+    # message-target mode: the vertex's own edges, a COMPUTED
+    # non-neighbor, or just the first out-edge (variable message count)
+    tmode = rng.choice(["edges", "computed", "first"])
+    # halt-and-send: emit exactly at the halting superstep
+    halt_and_send = rng.random() < 0.3
+    tk = rng.randint(1, 5)
 
     def compute(vert, msg, agg, s):
-        got = msg if msg is not None else fb
-        newv = vert.value * a + got * b + c
+        if tuple_vals:
+            base, acc = vert.value
+            got = msg if msg is not None else fb
+            newv = (base * a + got * b + c, acc + got)
+            mval = newv[0] * mc1 + mc2
+        else:
+            got = msg if msg is not None else fb
+            newv = vert.value * a + got * b + c
+            mval = newv * mc1 + mc2
         active = s < halt_s
         v = Vertex(vert.id, newv, vert.outEdges, active)
-        if active and s in emit_set and vert.outEdges:
-            return (v, [Message(e.target_id, newv * mc1 + mc2)
-                        for e in vert.outEdges])
+        emit_now = (s == halt_s) if halt_and_send \
+            else (active and s in emit_set)
+        if emit_now:
+            if tmode == "computed":
+                return (v, [Message((vert.id * tk + s) % n, mval)])
+            if tmode == "first" and vert.outEdges:
+                return (v, [Message(vert.outEdges[0].target_id, mval)])
+            if tmode == "edges" and vert.outEdges:
+                return (v, [Message(e.target_id, mval)
+                            for e in vert.outEdges])
         return (v, [])
 
     return compute
 
 
-def _build_graph(rng, ctx):
+def _build_graph(rng, ctx, n, tuple_vals):
     import operator
 
     from dpark_tpu.bagel import BasicCombiner, Edge, Vertex
-    n = rng.randint(4, 20)
     rows = []
+    # degree ladder reaching past the old degree-8 cap, with enough
+    # distinct degrees to stress the class-sliced tracing
+    ladder = [0, 1, 1, 2, 3, 4, 5, 6, 7, 8, 9, 12, 17, 33]
     for i in range(n):
-        deg = rng.choice([0, 1, 1, 2, 3])
+        deg = rng.choice(ladder)
         targets = [rng.randrange(n) for _ in range(deg)]
-        rows.append((i, Vertex(i, rng.randint(-5, 5),
-                               [Edge(t) for t in targets])))
+        val = (rng.randint(-5, 5), rng.randint(-2, 2)) if tuple_vals \
+            else rng.randint(-5, 5)
+        rows.append((i, Vertex(i, val, [Edge(t) for t in targets])))
     verts = ctx.parallelize(rows, rng.choice([2, 4]))
     init = [(rng.randrange(n), rng.randint(-4, 4))
             for _ in range(rng.randint(0, n // 2))]
@@ -56,8 +85,8 @@ def _build_graph(rng, ctx):
     return verts, msgs, BasicCombiner(op)
 
 
-@pytest.mark.parametrize("seed", range(6))
-def test_object_bagel_fuzz_parity(seed):
+def _run_parity(seed, expect_device=True, n_override=None,
+                graph_fn=None):
     from dpark_tpu import DparkContext
     from dpark_tpu.bagel import Bagel
     outs = []
@@ -67,8 +96,14 @@ def test_object_bagel_fuzz_parity(seed):
         c = DparkContext(master)
         c.start()
         try:
-            compute = _build_program(rng)
-            verts, msgs, combiner = _build_graph(rng, c)
+            n = n_override or rng.randint(6, 24)
+            # the program draws from its OWN rng stream; the graph
+            # builder needs only its tuple_vals outcome, re-derived
+            # deterministically by _program_uses_tuples
+            compute = _build_program(random.Random(seed * 7 + 1), n)
+            build = graph_fn or _build_graph
+            verts, msgs, combiner = build(
+                rng, c, n, _program_uses_tuples(seed))
             final = Bagel.run(c, verts, msgs, compute,
                               combiner=combiner, max_superstep=6)
             outs.append(sorted(
@@ -79,5 +114,102 @@ def test_object_bagel_fuzz_parity(seed):
                                False)
         finally:
             c.stop()
-    assert used, "seed %d did not ride the device" % seed
+    if expect_device:
+        assert used, "seed %d did not ride the device" % seed
+    else:
+        assert not used, "seed %d must fall back" % seed
     assert outs[0] == outs[1], (seed, outs[0], outs[1])
+
+
+def _program_uses_tuples(seed):
+    """Re-derive _build_program's tuple_vals draw (9th random value of
+    its rng stream) so the graph builder matches the program."""
+    rng = random.Random(seed * 7 + 1)
+    rng.choice([1, 2])
+    rng.choice([0, 1, 2])
+    rng.randint(-3, 3)
+    rng.randint(-2, 2)
+    rng.randint(1, 3)
+    rng.sample(range(4), rng.randint(1, 4))
+    rng.choice([1, 2])
+    rng.randint(-2, 2)
+    return rng.random() < 0.3
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_object_bagel_fuzz_parity(seed):
+    _run_parity(seed)
+
+
+def test_fallback_boundary_class_count():
+    """One more distinct degree than the trace budget: the program must
+    fall back to the host object path AND match."""
+    from dpark_tpu import bagel as bagel_mod
+
+    def graph(rng, ctx, n, tuple_vals):
+        import operator
+        from dpark_tpu.bagel import BasicCombiner, Edge, Vertex
+        k = bagel_mod.MAX_DEGREE_CLASSES + 1
+        nn = max(n, k + 2)
+        rows = []
+        for i in range(nn):
+            deg = i % k                  # k distinct degrees: over cap
+            targets = [(i + j + 1) % nn for j in range(deg)]
+            val = (i % 5, 0) if tuple_vals else i % 5
+            rows.append((i, Vertex(i, val, [Edge(t) for t in targets])))
+        verts = ctx.parallelize(rows, 4)
+        msgs = ctx.parallelize([], 2)
+        return verts, msgs, BasicCombiner(operator.add)
+
+    _run_parity(3, expect_device=False,
+                n_override=bagel_mod.MAX_DEGREE_CLASSES + 3,
+                graph_fn=graph)
+
+
+def test_fallback_boundary_degree():
+    """One past MAX_DEGREE falls back (and matches); AT the cap rides
+    the device."""
+    import operator
+    from dpark_tpu import bagel as bagel_mod
+    from dpark_tpu.bagel import (Bagel, BasicCombiner, Edge, Message,
+                                 Vertex)
+    from dpark_tpu import DparkContext
+
+    old = bagel_mod.MAX_DEGREE
+    bagel_mod.MAX_DEGREE = 12            # keep the test cheap
+    try:
+        def compute(vert, msg, agg, s):
+            got = msg if msg is not None else 0
+            v = Vertex(vert.id, vert.value + got, vert.outEdges, s < 1)
+            if s < 1 and vert.outEdges:
+                return (v, [Message(e.target_id, 1)
+                            for e in vert.outEdges])
+            return (v, [])
+
+        for deg, expect_device in ((12, True), (13, False)):
+            outs = []
+            used = False
+            for master in ("tpu", "local"):
+                c = DparkContext(master)
+                c.start()
+                try:
+                    n = 20
+                    rows = [(i, Vertex(i, 0,
+                                       [Edge((i + j) % n)
+                                        for j in range(deg)]))
+                            for i in range(n)]
+                    final = Bagel.run(
+                        c, c.parallelize(rows, 4), c.parallelize([], 2),
+                        compute, combiner=BasicCombiner(operator.add),
+                        max_superstep=4)
+                    outs.append(sorted((vid, v.value)
+                                       for vid, v in final.collect()))
+                    if master == "tpu":
+                        used = getattr(c.scheduler,
+                                       "_pregel_device_used", False)
+                finally:
+                    c.stop()
+            assert used == expect_device, (deg, used)
+            assert outs[0] == outs[1], (deg, outs)
+    finally:
+        bagel_mod.MAX_DEGREE = old
