@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-5 re-measurement of the 10GB out-of-core runs (VERDICT r4 #2):
+# wordcount + sortgroup on current code, CPU mesh, per-byte numbers vs
+# the r2 anchors (2.9 / 4.9 MB/s).
+set -u
+cd /root/repo
+OUT=.bench_ooc
+mkdir -p "$OUT"
+for cfg in wordcount sortgroup; do
+  echo "== $cfg start $(date -u +%H:%M:%S) =="
+  timeout --signal=TERM --kill-after=120 14400 \
+    python benchmarks/ooc_run.py --config "$cfg" --master tpu --gb 10 \
+    > "$OUT/$cfg.json" 2> "$OUT/$cfg.err"
+  echo "rc=$? for $cfg at $(date -u +%H:%M:%S)"
+done
+echo DONE
