@@ -122,6 +122,13 @@ DEFAULT_DTYPE = "int32"
 # stays i64 either way; set 0 to disable (e.g. when bisecting parity).
 NARROW_EXCHANGE = os.environ.get("DPARK_NARROW_EXCHANGE", "1") != "0"
 
+# graph-build-time rewrite of groupByKey().mapValue(provable aggregate)
+# to a map-side-combining combineByKey (rdd._group_agg_rewrite): the
+# classic combiner optimization, exchange volume O(distinct keys).
+# "0" disables; the device SegAggOp path then serves these chains.
+GROUP_AGG_REWRITE = os.environ.get("DPARK_GROUP_AGG_REWRITE",
+                                   "1") != "0"
+
 # device->host egest: int64 scalar columns at least this large are
 # min/max-probed and ride the link as int32 when every valid value fits
 # (the axon tunnel reads back at ~37 MB/s — BENCH_REAL_r03.md — so

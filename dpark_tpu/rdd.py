@@ -66,6 +66,37 @@ def _append(l, v):
     return l
 
 
+def _radd_zero(v):
+    """sum()'s first accumulation step (0 + item): raises for exactly
+    the value types sum() raises for — the group-aggregate rewrite
+    must not widen what works (a string group must still TypeError)."""
+    return 0 + v
+
+
+def _one(v):
+    return 1
+
+
+def _count_merge(c, v):
+    return c + 1
+
+
+def _mean_create(v):
+    return (0 + v, 1)
+
+
+def _mean_merge_value(c, v):
+    return (c[0] + v, c[1] + 1)
+
+
+def _mean_merge(a, b):
+    return (a[0] + b[0], a[1] + b[1])
+
+
+def _mean_final(sc):
+    return sc[0] / sc[1]
+
+
 def _extend(l1, l2):
     l1.extend(l2)
     return l1
@@ -232,9 +263,90 @@ class RDD:
     mapPartitionWithIndex = mapPartitionsWithIndex
 
     def mapValue(self, f):
+        rewritten = self._group_agg_rewrite(f)
+        if rewritten is not None:
+            return rewritten
         return MappedValuesRDD(self, f)
 
     mapValues = mapValue
+
+    def _group_agg_rewrite(self, f):
+        """groupByKey().mapValue(provable aggregate) -> combineByKey:
+        the classic combiner optimization, applied at graph-build time
+        so EVERY master benefits — map-side pre-aggregation cuts
+        exchange volume to O(distinct keys) instead of shipping every
+        row to its group (reference: what dpark users hand-write as
+        combineByKey; SURVEY.md 3.1 combiner note).
+
+        Applies only when `self` IS a bare groupByKey output (a
+        no-combine hash ShuffledRDD — partitionBy's flat rows sit
+        behind a FlatMappedValues(identity) and never reach here), the
+        aggregate is provable (fuse.classify_segagg: sum/len/min/max/
+        mean or a __dpark_segagg__ hint — NOT the np twins, which
+        flatten array values), no cache/snapshot/checkpoint pins the
+        grouped RDD, and the grouping's shuffle outputs do not already
+        exist (then reuse beats re-scanning the parent).  A grouped RDD
+        aggregated SEVERAL times rewrites each aggregate into its own
+        combining shuffle — cache() the group to keep one shared
+        grouping instead.  Error behavior is preserved:
+        the sum rewrites start from ``0 + v`` exactly like sum()'s
+        accumulator, so non-numeric values raise on every master the
+        way they always did.  conf.GROUP_AGG_REWRITE=0 disables (the
+        device SegAggOp path then serves these chains)."""
+        from dpark_tpu import conf
+        if not conf.GROUP_AGG_REWRITE:
+            return None
+        if not (isinstance(self, ShuffledRDD)
+                and self.aggregator.create_combiner is _mk_list
+                and self.aggregator.merge_value is _append
+                and self.aggregator.merge_combiners is _extend
+                and type(self.partitioner) is HashPartitioner
+                and not self.should_cache
+                and self._checkpoint_path is None
+                and self._checkpoint_rdd is None
+                and getattr(self, "_snapshot_path", None) is None):
+            return None
+        from dpark_tpu.env import env
+        if env.map_output_tracker.get_outputs(
+                self.dep.shuffle_id) is not None:
+            # the grouping's map outputs already exist (an earlier job
+            # computed this grouped RDD): reuse them instead of
+            # re-scanning the parent through a fresh combining shuffle
+            return None
+        try:
+            from dpark_tpu.backend.tpu.fuse import classify_segagg
+        except Exception:
+            return None
+        # np.sum/np.mean/np.min/np.max are NOT rewrite-safe: np
+        # flattens a list of array values where the pairwise builtins
+        # work elementwise (or raise) — only the builtins, the bytecode
+        # templates, and explicit hints rewrite (review finding).  The
+        # builtins themselves ARE pairwise-equal for array values
+        # (sum == chained +, min/max raise ambiguous-truth both ways).
+        import numpy as _np
+        try:
+            if f in (_np.sum, _np.mean, _np.min, _np.max):
+                return None
+        except TypeError:
+            return None
+        kind = classify_segagg(f)
+        if kind is None:
+            return None
+        n = self.partitioner.num_partitions
+        parent = self.parent
+        if kind == "sum":
+            return parent.combineByKey(_radd_zero, _add, _add, n)
+        if kind == "count":
+            return parent.combineByKey(_one, _count_merge, _add, n)
+        if kind == "min":
+            return parent.combineByKey(_identity, min, min, n)
+        if kind == "max":
+            return parent.combineByKey(_identity, max, max, n)
+        if kind == "mean":
+            return parent.combineByKey(
+                _mean_create, _mean_merge_value, _mean_merge,
+                n).mapValue(_mean_final)
+        return None
 
     def flatMapValue(self, f):
         return FlatMappedValuesRDD(self, f)

@@ -1,0 +1,159 @@
+"""Graph-build combiner rewrite: groupByKey().mapValue(provable
+aggregate) becomes a map-side-combining combineByKey on EVERY master
+(rdd._group_agg_rewrite) — exchange volume O(distinct keys), results
+identical, error behavior preserved."""
+
+import numpy as np
+import pytest
+
+
+ROWS = [(i % 37, (i * 5) % 13 - 4) for i in range(3000)]
+
+
+def _groups(rows):
+    exp = {}
+    for k, v in rows:
+        exp.setdefault(k, []).append(v)
+    return exp
+
+
+@pytest.mark.parametrize("f,host", [
+    (sum, sum),
+    (len, len),
+    (min, min),
+    (max, max),
+    (lambda vs: sum(vs) / len(vs), lambda vs: sum(vs) / len(vs)),
+])
+def test_rewrite_matches_group_semantics(ctx, f, host):
+    r = ctx.parallelize(ROWS, 6).groupByKey(4).mapValues(f)
+    from dpark_tpu.rdd import MappedValuesRDD, ShuffledRDD
+    # the rewrite removed the grouped ShuffledRDD: the graph is a
+    # combining shuffle (mean adds one finalize mapValue)
+    node = r
+    if isinstance(node, MappedValuesRDD):
+        node = node.prev
+    assert isinstance(node, ShuffledRDD)
+    from dpark_tpu.rdd import _mk_list
+    assert node.aggregator.create_combiner is not _mk_list
+    got = dict(r.collect())
+    exp = {k: host(vs) for k, vs in _groups(ROWS).items()}
+    assert got == exp
+
+
+def test_rewrite_cuts_exchange_rows():
+    """On the tpu master the rewritten shuffle ships pre-combined rows:
+    far fewer valid rows offered for exchange than the no-combine
+    grouping ships."""
+    from dpark_tpu import DparkContext, conf
+
+    def run(enabled):
+        old = conf.GROUP_AGG_REWRITE
+        conf.GROUP_AGG_REWRITE = enabled
+        c = DparkContext("tpu")
+        c.start()
+        try:
+            got = dict(c.parallelize(ROWS, 8).groupByKey(8)
+                       .mapValues(sum).collect())
+            rows = c.scheduler.executor.exchange_real_rows
+        finally:
+            c.stop()
+            conf.GROUP_AGG_REWRITE = old
+        return got, rows
+
+    got_on, rows_on = run(True)
+    got_off, rows_off = run(False)
+    assert got_on == got_off
+    # 3000 rows over 37 keys on 8 devices: combined rows <= 37*8 per
+    # exchange vs 3000 uncombined
+    assert rows_on < rows_off / 3, (rows_on, rows_off)
+
+
+def test_rewrite_preserves_error_behavior(ctx):
+    """sum over string values raises on the host path; the rewrite's
+    0 + v must raise too, not silently concatenate."""
+    rows = [("k", "a"), ("k", "b")]
+    r = ctx.parallelize(rows, 2).groupByKey(2).mapValues(sum)
+    with pytest.raises(Exception):
+        r.collect()
+
+
+def test_rewrite_skips_pinned_groups(ctx):
+    """cache()/checkpoint-marked grouped RDDs keep the real grouping
+    (the rewrite would bypass the materialization the user asked for);
+    min/max over strings still work through the rewrite (comparison
+    semantics are pairwise-equal)."""
+    from dpark_tpu.rdd import MappedValuesRDD
+    g = ctx.parallelize(ROWS, 4).groupByKey(4).cache()
+    r = g.mapValues(sum)
+    assert isinstance(r, MappedValuesRDD)    # not rewritten
+    got = dict(r.collect())
+    assert got == {k: sum(vs) for k, vs in _groups(ROWS).items()}
+
+    srows = [(i % 5, "s%02d" % (i % 23)) for i in range(200)]
+    got = dict(ctx.parallelize(srows, 4).groupByKey(4)
+               .mapValues(min).collect())
+    assert got == {k: min(vs) for k, vs in _groups(srows).items()}
+
+
+def test_rewrite_mean_float32_width(ctx):
+    """mean keeps the host's width semantics through the rewrite."""
+    rows = [(i % 7, np.float32(i % 5)) for i in range(280)]
+    got = dict(ctx.parallelize(rows, 4).groupByKey(4)
+               .mapValues(lambda vs: sum(vs) / len(vs)).collect())
+    exp = {}
+    for k, vs in _groups(rows).items():
+        acc = 0
+        for v in vs:
+            acc = acc + v
+        exp[k] = acc / len(vs)
+    assert set(got) == set(exp)
+    for k in got:
+        assert np.float32(got[k]) == np.float32(exp[k])
+
+
+def test_partitionby_mapvalue_not_rewritten(ctx):
+    """partitionBy keeps flat (k, v) rows — mapValue(sum) there applies
+    to each VALUE and must not be treated as a group aggregate."""
+    rows = [(i % 5, [i, i + 1]) for i in range(50)]
+    got = dict(ctx.parallelize(rows, 4).partitionBy(4)
+               .mapValue(sum).collect())
+    # sum of each [i, i+1] list value
+    assert got
+    for k, v in got.items():
+        assert isinstance(v, int)
+
+
+def test_np_aggregates_not_rewritten(ctx):
+    """np.sum/np.mean flatten a LIST of array values; the pairwise
+    rewrite would compute elementwise instead — np twins must keep the
+    real grouping (review finding)."""
+    from dpark_tpu.rdd import MappedValuesRDD
+    rows = [(i % 3, np.asarray([i, i + 1.0])) for i in range(30)]
+    r = ctx.parallelize(rows, 4).groupByKey(4).mapValues(np.mean)
+    assert isinstance(r, MappedValuesRDD)    # not rewritten
+    got = dict(r.collect())
+    exp = {k: float(np.mean(vs)) for k, vs in _groups(rows).items()}
+    for k in exp:
+        assert abs(got[k] - exp[k]) < 1e-9
+
+
+def test_builtin_sum_over_arrays_still_rewrites(ctx):
+    """builtin sum over array values IS pairwise-equal (chained +):
+    the rewrite applies and matches."""
+    rows = [(i % 3, np.asarray([i, i * 2])) for i in range(30)]
+    got = dict(ctx.parallelize(rows, 4).groupByKey(4)
+               .mapValues(sum).collect())
+    for k, vs in _groups(rows).items():
+        assert np.array_equal(got[k], sum(vs))
+
+
+def test_materialized_group_not_rewritten(ctx):
+    """Once a grouped RDD's shuffle outputs exist, later aggregates
+    reuse them instead of re-scanning the parent (review finding)."""
+    from dpark_tpu.rdd import MappedValuesRDD
+    g = ctx.parallelize(ROWS, 4).groupByKey(4)
+    assert g.count() == len(_groups(ROWS))     # materializes g's dep
+    r = g.mapValues(sum)
+    assert isinstance(r, MappedValuesRDD)          # reuse, no rewrite
+    got = dict(r.collect())
+    assert got == {k: sum(vs) for k, vs in _groups(ROWS).items()}

@@ -7,6 +7,19 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _no_rewrite():
+    """These tests exercise the device SegAggOp path, which serves
+    group->aggregate chains whenever the graph-build combiner rewrite
+    (conf.GROUP_AGG_REWRITE) does not apply — disable the rewrite so
+    the op path is what actually runs."""
+    from dpark_tpu import conf
+    old = conf.GROUP_AGG_REWRITE
+    conf.GROUP_AGG_REWRITE = False
+    yield
+    conf.GROUP_AGG_REWRITE = old
+
+
 @pytest.fixture()
 def tctx():
     from dpark_tpu import DparkContext
