@@ -355,6 +355,16 @@ class DAGScheduler:
             if started is not None and status == "success":
                 durations.setdefault(task.stage_id, []).append(
                     _time.time() - started)
+            if started is not None:
+                # per-task drill-down for the web UI (SURVEY.md 5.1);
+                # bounded so huge jobs don't bloat the history record
+                tl = self._stage_info(record, task.stage_id) \
+                    .setdefault("tasks", [])
+                if len(tl) < 512:
+                    tl.append({"p": task.partition,
+                               "s": round(_time.time() - started, 3),
+                               "host": env.host,
+                               "ok": status == "success"})
             if status == "success":
                 result, acc_updates, md_updates = payload
                 self.host_manager.task_succeed_on(env.host)
