@@ -40,9 +40,17 @@ class PageRank:
 def main():
     options = parse_options()
     ctx = DparkContext(options.master)
-    # a small ring-with-chords graph
+    # a power-law-ish graph: most vertices have a few edges, a handful
+    # have dozens (max degree 48 — far past the r4 adapter's degree-8
+    # cap; the class-sliced r5 adapter columnarizes it whole)
+    import random
+    rng = random.Random(7)
     n = 64
-    links = {i: [(i + 1) % n, (i * 7 + 3) % n] for i in range(n)}
+    ladder = [1, 2, 2, 3, 4, 6, 9, 14, 22, 48]
+    links = {i: [rng.randrange(n)
+                 for _ in range(ladder[min(int(rng.paretovariate(1.2)),
+                                           len(ladder)) - 1])]
+             for i in range(n)}
     verts = ctx.parallelize(
         [(i, Vertex(i, 1.0 / n, [Edge(t) for t in targets]))
          for i, targets in links.items()], 4)

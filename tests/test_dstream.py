@@ -107,6 +107,22 @@ def test_update_state_by_key(ctx):
     assert dict(out[2][1]) == {"a": 3, "b": 6}
 
 
+def _device_kinds(c, last_only=False):
+    """(rdd, kind) pairs across the scheduler history, skipping
+    single-task jobs (probe/take jobs run object tasks by design).
+    last_only restricts to the final multi-task job — the steady-state
+    batch."""
+    recs = [rec for rec in c.scheduler.history
+            if rec.get("parts") != 1]
+    if last_only:
+        recs = recs[-1:]
+    kinds = set()
+    for rec in recs:
+        for st in rec.get("stage_info", []):
+            kinds.add((st["rdd"], st.get("kind")))
+    return kinds
+
+
 def test_stateful_wordcount_rides_device_end_to_end():
     """The running-sum updateStateByKey idiom rewrites to one flat
     union-reduce per batch (VERDICT r4 #5), so on the tpu master every
@@ -130,12 +146,7 @@ def test_stateful_wordcount_rides_device_end_to_end():
 
         q.updateStateByKey(update, numSplits=8).collect_batches(out)
         run_batches(ssc, 5)
-        kinds = set()
-        for rec in c.scheduler.history:
-            for s in rec.get("stage_info", []):
-                if rec.get("parts") == 1:
-                    continue        # the one-time numeric take() probe
-                kinds.add((s["rdd"], s.get("kind")))
+        kinds = _device_kinds(c)
         c.stop()
         return [sorted(v) for _, v in out], kinds
 
@@ -372,15 +383,7 @@ def test_linear_window_rides_device_end_to_end():
         q.reduceByKeyAndWindow(operator.add, 2.0,
                                invFunc=operator.sub).collect_batches(out)
         run_batches(ssc, 5)
-        kinds = set()
-        for rec in c.scheduler.history:
-            for s in rec.get("stage_info", []):
-                # the one-time numeric value probe is a one-partition
-                # take(1) job — single-task stages run object tasks by
-                # design; every REAL window stage must be array
-                if rec.get("parts") == 1:
-                    continue
-                kinds.add((s["rdd"], s.get("kind")))
+        kinds = _device_kinds(c)
         c.stop()
         return [sorted(v) for _, v in out], kinds
 
@@ -440,3 +443,61 @@ def test_window_fuzz_parity(seed):
     the local master exactly — the (add, sub) linear rewrite included."""
     assert _window_fuzz_run("tpu", seed) == _window_fuzz_run("local",
                                                              seed)
+
+
+def test_noninv_window_rides_device():
+    """reduceByKeyAndWindow WITHOUT invFunc recomputes each window as a
+    union of batch RDDs feeding a reduce — the union-source device
+    stage; every steady-state stage rides the array path."""
+    from dpark_tpu import DparkContext
+
+    def drive(master):
+        c = DparkContext(master)
+        ssc = make_ssc(c, batch=1.0)
+        out = []
+        batches = [[(i % 16, 1) for i in range(j * 13, j * 13 + 160)]
+                   for j in range(4)]
+        q = ssc.queueStream(batches)
+        q.reduceByKeyAndWindow(operator.add, 2.0,
+                               numSplits=8).collect_batches(out)
+        run_batches(ssc, 4)
+        kinds = _device_kinds(c)
+        c.stop()
+        return [sorted(v) for _, v in out], kinds
+
+    got, kinds = drive("tpu")
+    exp, _ = drive("local")
+    assert got == exp
+    assert {v for k, v in kinds} == {"array"}, kinds
+
+
+def test_stream_join_rides_device():
+    """Per-batch stream joins expand on the device join source in
+    steady state (both sides' shuffles HBM-resident)."""
+    from dpark_tpu import DparkContext
+
+    def drive(master):
+        c = DparkContext(master)
+        ssc = make_ssc(c, batch=1.0)
+        out = []
+        left = [[(i % 32, i) for i in range(j * 11, j * 11 + 120)]
+                for j in range(3)]
+        right = [[(i % 32, i * 2) for i in range(j * 7, j * 7 + 90)]
+                 for j in range(3)]
+        a = ssc.queueStream(left)
+        b = ssc.queueStream(right)
+        a.join(b, numSplits=8) \
+         .transform(lambda r: r.map(
+             lambda kv: (kv[0], kv[1][0] + kv[1][1]))
+             .reduceByKey(operator.add, 8)) \
+         .collect_batches(out)
+        run_batches(ssc, 3)
+        kinds = _device_kinds(c, last_only=True)
+        c.stop()
+        return [sorted(v) for _, v in out], kinds
+
+    got, kinds = drive("tpu")
+    exp, _ = drive("local")
+    assert got == exp
+    # steady state (the last batch's job) must be ALL device stages
+    assert kinds and {v for _, v in kinds} == {"array"}, kinds
