@@ -222,6 +222,20 @@ class TPUScheduler(DAGScheduler):
                            and all(isinstance(t, ResultTask)
                                    and t.func is _count_iter
                                    for t in tasks))
+        # top(k): per-device pre-top with a classifiable ordering key —
+        # ndev*k rows egest instead of the whole batch; the per-task
+        # _TopN and the driver heap merge then run unchanged
+        from dpark_tpu.rdd import _TopN
+        plan.top_candidate = None
+        if (not stage.is_shuffle_map and tasks
+                and all(isinstance(t, ResultTask)
+                        and isinstance(t.func, _TopN)
+                        for t in tasks)
+                and len({(t.func.n, id(t.func.key), t.func.smallest)
+                         for t in tasks}) == 1):
+            tf = tasks[0].func
+            plan.top_candidate = (tf.n, tf.key, tf.smallest)
+        plan.topk_used = False
         # reduce(f) with a PROVABLE monoid over scalar records likewise
         # answers from one per-device reduction (ndev scalars on the
         # wire); unprovable reduces keep the egest + host fold
@@ -281,6 +295,8 @@ class TPUScheduler(DAGScheduler):
                 report(task, "success",
                        (v if n else _EMPTY, {}, {}))
         else:
+            if getattr(plan, "topk_used", False):
+                note["kind"] = "array+top"   # observable: pre-top ran
             rows_per_part = result
             for task in tasks:
                 assert isinstance(task, ResultTask)

@@ -197,6 +197,78 @@ def classify_segagg(f):
     return name
 
 
+def _subscript_const_index(f):
+    """The integer I when f is exactly ``lambda x: x[I]`` (closure-free,
+    any spelling with the same bytecode, e.g. rdd._snd) — the provable
+    select-one-leaf top() key.  None otherwise."""
+    code = getattr(f, "__code__", None)
+    if code is None or getattr(f, "__closure__", None):
+        return None
+    if code.co_argcount != 1 or code.co_flags & 0x0C:
+        return None
+    t = (lambda x: x[99]).__code__
+    if not (code.co_code == t.co_code and code.co_names == t.co_names):
+        return None
+    ints = [c for c in code.co_consts
+            if isinstance(c, int) and not isinstance(c, bool)]
+    t_other = [c for c in t.co_consts
+               if not isinstance(c, int) or isinstance(c, bool)]
+    other = [c for c in code.co_consts
+             if not isinstance(c, int) or isinstance(c, bool)]
+    if len(ints) != 1 or other != t_other:
+        return None
+    return ints[0]
+
+
+def classify_top_key(key, treedef, specs, encoded):
+    """Device top-k eligibility for one result batch: how to compute
+    the ordering key of each record on device.
+
+    Returns ("leaf", i) to order by leaf column i, ("fn", key) to
+    order by the traced user key (scalar numeric output), or None
+    (host path).  With dictionary-ENCODED string keys in leaf 0, only
+    a provable value-leaf subscript (index >= 1) qualifies — anything
+    that could read leaf 0 would order by the raw ids."""
+    import jax.tree_util as jtu
+    nl = len(specs)
+    if key is None:
+        if encoded or nl != 1:
+            return None
+        dt, shape = specs[0]
+        if shape == () and dt.kind in "if":
+            return ("leaf", 0)
+        return None
+    idx = _subscript_const_index(key)
+    if idx is not None:
+        if not (0 <= idx < nl):
+            return None
+        if treedef != jtu.tree_structure(tuple(range(nl))):
+            return None          # nested records: subscript != leaf
+        dt, shape = specs[idx]
+        if shape != () or dt.kind not in "if":
+            return None
+        if encoded and idx == 0:
+            return None
+        return ("leaf", idx)
+    if encoded:
+        return None
+    try:
+        fn = _row_fn(key, treedef)
+        out = jax.eval_shape(fn, *_spec_struct(specs))
+        # FLOAT outputs only: the host computes key expressions in
+        # exact Python ints while the device wraps at i64 — an
+        # integer key that overflows would silently reorder (review
+        # finding).  Float arithmetic is IEEE-identical per record on
+        # both sides.  Raw stored int COLUMNS (the "leaf" cases) carry
+        # no arithmetic and stay eligible.
+        if (len(out) == 1 and out[0].shape == ()
+                and np.dtype(out[0].dtype).kind == "f"):
+            return ("fn", key)
+    except Exception:
+        pass
+    return None
+
+
 def fn_key(f):
     """Structural identity of a user function: same code + same captured
     cell values => same compiled program.  Unhashable captures fall back to

@@ -1,0 +1,142 @@
+"""Device top(k): result stages whose tasks are per-partition _TopN
+select each device's k best rows ON DEVICE (one argsort, ndev*k rows
+egested) when the ordering key classifies; the per-partition heap and
+driver merge run unchanged, so results match the local master."""
+
+import pytest
+
+
+@pytest.fixture()
+def tctx():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu")
+    c.start()
+    yield c
+    c.stop()
+
+
+def _last_kind(tctx):
+    rec = tctx.scheduler.history[-1]
+    return {s["rdd"]: s.get("kind") for s in rec["stage_info"]}
+
+
+# 131 generates Z/1009: the value column is a PERMUTATION of 0..1008 —
+# injective, so no top-k cutoff ties (tie membership is order-dependent
+# on every master and not a parity property)
+ROWS = [(i, (i * 131) % 1009) for i in range(1009)]
+
+
+def test_top_by_value_rides_device(tctx):
+    r = tctx.parallelize(ROWS, 8).reduceByKey(lambda a, b: a + b, 8)
+    got = r.top(7, key=lambda kv: kv[1])
+    kinds = _last_kind(tctx)
+    assert "array+top" in kinds.values(), kinds
+    exp = sorted(ROWS, key=lambda kv: kv[1], reverse=True)[:7]
+    assert got == exp
+
+
+def test_top_smallest_and_scalar_records(tctx):
+    r = tctx.parallelize(ROWS, 8).reduceByKey(lambda a, b: a + b, 8) \
+        .map(lambda kv: kv[1])
+    got = r.top(5, reverse=True)         # smallest
+    assert "array+top" in _last_kind(tctx).values()
+    assert got == sorted(v for _, v in ROWS)[:5]
+    got = r.top(5)
+    assert got == sorted((v for _, v in ROWS), reverse=True)[:5]
+
+
+def test_top_traced_key_expression(tctx):
+    # injective FLOAT key (integer key expressions stay on the host —
+    # device i64 wraps where Python ints are exact; ties at the cutoff
+    # have order-dependent membership on every master)
+    r = tctx.parallelize(ROWS, 8).reduceByKey(lambda a, b: a + b, 8)
+    got = r.top(4, key=lambda kv: kv[1] * 2000.0 + kv[0])
+    assert "array+top" in _last_kind(tctx).values()
+    exp = sorted(ROWS, key=lambda kv: kv[1] * 2000.0 + kv[0],
+                 reverse=True)[:4]
+    assert sorted(got) == sorted(exp)
+
+
+def test_top_int_key_expression_falls_back(tctx):
+    """An integer key EXPRESSION can exceed i64 on device while the
+    host computes exact Python ints — such keys keep the host path
+    (review finding), and the answer stays right."""
+    rows = [(1, 2 ** 61), (2, 5), (3, 7)]
+    r = tctx.parallelize(rows, 2).reduceByKey(lambda a, b: a + b, 2)
+    got = r.top(1, key=lambda kv: kv[1] * 100)
+    assert "array+top" not in _last_kind(tctx).values()
+    assert got == [(1, 2 ** 61)]
+
+
+def test_top_extreme_float_keys(tctx):
+    """Valid rows whose key equals the float extreme must outrank
+    padding (review finding: sentinel collision returned garbage)."""
+    rows = [(i, float("-inf")) for i in range(5)] \
+        + [(10, 1.0), (11, 2.0)]
+    r = tctx.parallelize(rows, 8).reduceByKey(lambda a, b: a + b, 8)
+    got = r.top(5, key=lambda kv: kv[1])
+    assert "array+top" in _last_kind(tctx).values()
+    assert got[:2] == [(11, 2.0), (10, 1.0)]
+    assert all(v == float("-inf") and k in range(5)
+               for k, v in got[2:])
+    got = r.top(4, key=lambda kv: kv[1], reverse=True)
+    assert all(v == float("-inf") for _, v in got)
+
+
+def test_top_untraceable_key_falls_back(tctx):
+    rows = ROWS[:1009]                   # value set injective: no ties
+    r = tctx.parallelize(rows, 8).reduceByKey(lambda a, b: a + b, 8)
+    got = r.top(3, key=lambda kv: str(kv[1]))
+    kinds = _last_kind(tctx)
+    assert "array+top" not in kinds.values(), kinds
+    exp = sorted(rows, key=lambda kv: str(kv[1]), reverse=True)[:3]
+    assert got == exp
+
+
+def test_top_encoded_wordcount(tctx, tmp_path):
+    """String-keyed text counts: ordering by the COUNT leaf pre-tops on
+    device (ids never order anything); ordering by the word itself
+    keeps the host path (ids must not substitute for strings)."""
+    p = tmp_path / "t.txt"
+    words = []
+    for i in range(40):
+        words += ["w%02d" % i] * (i + 1)
+    p.write_text(" ".join(words) + "\n")
+    counts = tctx.textFile(str(p)) \
+        .flatMap(lambda line: line.split()) \
+        .map(lambda w: (w, 1)) \
+        .reduceByKey(lambda a, b: a + b, 8)
+    got = counts.top(5, key=lambda kv: kv[1])
+    assert "array+top" in _last_kind(tctx).values()
+    assert got == [("w%02d" % i, i + 1) for i in range(39, 34, -1)]
+
+    got = counts.top(3)                  # orders by (word, count)
+    assert "array+top" not in _last_kind(tctx).values()
+    assert got == [("w39", 40), ("w38", 39), ("w37", 38)]
+
+
+def test_hot_uses_device_top(tctx):
+    """rdd.hot() = count + top by count: the canonical heavy-hitters
+    action pre-tops on device."""
+    data = []
+    for i in range(50):
+        data += [i] * (i + 1)
+    got = tctx.parallelize(data, 8).hot(4)
+    assert "array+top" in _last_kind(tctx).values()
+    assert got == [(49, 50), (48, 49), (47, 48), (46, 47)]
+
+
+def test_top_parity_vs_local(tctx):
+    from dpark_tpu import DparkContext
+    lctx = DparkContext("local")
+    try:
+        def prog(c):
+            # ROWS[:1009]: the value set is injective — tie membership
+            # at the cutoff is order-dependent on every master and not
+            # a parity property
+            return c.parallelize(ROWS[:1009], 8) \
+                .reduceByKey(lambda a, b: a + b, 8) \
+                .top(9, key=lambda kv: kv[1])
+        assert prog(tctx) == prog(lctx)
+    finally:
+        lctx.stop()
