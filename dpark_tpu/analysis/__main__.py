@@ -1,0 +1,141 @@
+"""dlint — run the closure rules over source files/trees.
+
+    python -m dpark_tpu.analysis file.py dir/ ...
+    python -m dpark_tpu.analysis --self            # dpark_tpu/ + examples/
+    tools/dlint examples/wordcount.py              # thin wrapper
+
+Exit codes: 0 clean (or every finding baselined / warnings only without
+a baseline), 1 new findings (errors always; warn+ when a baseline is in
+play), 2 usage error.
+
+The committed baseline (tools/dlint_baseline.json) freezes today's
+known findings so CI fails only on NEW anti-patterns: a baseline key is
+"<relpath>::<rule>::<site-minus-line-numbers>", deliberately coarse so
+unrelated edits to a file do not churn it.  Refresh deliberately with
+--write-baseline after fixing or accepting findings.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+from dpark_tpu.analysis.report import SEVERITIES, Report
+from dpark_tpu.analysis.closure_rules import lint_source
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _py_files(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if not d.startswith(".")
+                               and d != "__pycache__"]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+        elif p.endswith(".py"):
+            yield p
+        else:
+            raise SystemExit("dlint: not a .py file or directory: %s" % p)
+
+
+def baseline_key(root, finding):
+    """Stable identity for the committed baseline: relative path + rule
+    + site with every :<line> stripped."""
+    site = re.sub(r":\d+", "", finding.site)
+    parts = site.split(" ", 1)
+    rel = os.path.relpath(parts[0], root).replace(os.sep, "/")
+    tail = (" " + parts[1]) if len(parts) > 1 else ""
+    return "%s%s::%s" % (rel, tail, finding.rule)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="dlint", description="dpark_tpu closure linter")
+    ap.add_argument("paths", nargs="*", help=".py files or directories")
+    ap.add_argument("--self", dest="self_lint", action="store_true",
+                    help="lint the dpark_tpu package and examples/")
+    ap.add_argument("--baseline", default=None,
+                    help="JSON baseline of accepted finding keys "
+                         "(default with --self: tools/dlint_baseline"
+                         ".json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from this run's findings")
+    ap.add_argument("--tpu", action="store_true",
+                    help="treat closures as routed to the tpu master "
+                         "(tracer rules escalate info -> warn)")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+
+    root = _repo_root()
+    paths = list(args.paths)
+    baseline_path = args.baseline
+    if args.self_lint:
+        paths += [os.path.join(root, "dpark_tpu"),
+                  os.path.join(root, "examples")]
+        if baseline_path is None:
+            baseline_path = os.path.join(root, "tools",
+                                         "dlint_baseline.json")
+    if not paths:
+        ap.print_usage(sys.stderr)
+        return 2
+
+    report = Report()
+    nfiles = 0
+    for path in _py_files(paths):
+        nfiles += 1
+        lint_source(path, report=report, tpu=args.tpu)
+
+    keys = {baseline_key(root, f): f for f in report}
+    if args.write_baseline and baseline_path:
+        os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
+        with open(baseline_path, "w") as f:
+            json.dump(sorted(keys), f, indent=1)
+            f.write("\n")
+        print("dlint: wrote %d baseline keys -> %s"
+              % (len(keys), baseline_path), file=sys.stderr)
+        return 0
+
+    baseline = set()
+    if baseline_path and os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = set(json.load(f))
+
+    fresh = [f for k, f in sorted(keys.items()) if k not in baseline]
+    suppressed = len(report) - len(fresh)
+
+    if args.as_json:
+        json.dump([f.as_dict() for f in fresh], sys.stdout, indent=1)
+        print()
+    else:
+        for f in fresh:
+            print(f.render())
+
+    errors = sum(1 for f in fresh if f.severity == "error")
+    warns = sum(1 for f in fresh if f.severity == "warn")
+    print("dlint: %d file%s, %d finding%s (%d error%s, %d warning%s)"
+          "%s" % (nfiles, "s" if nfiles != 1 else "",
+                  len(fresh), "s" if len(fresh) != 1 else "",
+                  errors, "s" if errors != 1 else "",
+                  warns, "s" if warns != 1 else "",
+                  ", %d baselined" % suppressed if suppressed else ""),
+          file=sys.stderr)
+
+    if errors:
+        return 1
+    if warns and baseline:
+        # a baseline is the CI contract: NEW warnings fail the build
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

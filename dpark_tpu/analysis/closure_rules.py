@@ -1,0 +1,464 @@
+"""AST closure analyzer: inspects user functions passed to RDD
+transforms WITHOUT executing them.
+
+Two entry layers share the same rules:
+
+* lint_function(fn, ...)  — a live callable collected from an RDD
+  lineage at pre-flight time.  Closure cells and referenced globals are
+  introspected directly (precise: an actual RDD/DparkContext instance
+  in a cell IS a capture); the function's source, when available, also
+  runs through the AST checks.
+* lint_source(path, ...)  — a whole source file (the dlint CLI / CI
+  self-lint).  Module-scope assignment tracking identifies names bound
+  to contexts and RDD chains; closures passed to transform calls are
+  analyzed against that scope.
+
+Rules:
+
+  closure-rdd-capture     a function shipped to workers references an
+                          RDD or DparkContext — pickling either drags
+                          the whole driver graph into every task (or
+                          fails outright on the process/tpu masters).
+  closure-unseeded-random unseeded random.*/np.random.*/time.time()
+                          inside a deterministic transform: retries and
+                          speculative duplicates see different data
+                          (silent wrong answers under speculation).
+  closure-tracer-branch   Python control flow on runtime values
+                          (`if x > 0:`), `.item()`, or float()/int()
+                          coercion of arguments — unsafe under the jax
+                          tracer when the stage is routed to the tpu
+                          master (forces host fallback at best, tracer
+                          errors at worst).
+"""
+
+import ast
+import inspect
+import textwrap
+
+from dpark_tpu.analysis.report import Report
+
+# transform methods whose function argument ships to workers and must be
+# deterministic; foreach/mapPartitions ride along for the capture rule
+TRANSFORM_METHODS = {
+    "map", "flatMap", "filter", "mapValue", "mapValues", "flatMapValue",
+    "flatMapValues", "keyBy", "groupBy", "reduce", "fold", "aggregate",
+    "reduceByKey", "combineByKey", "foldByKey", "mapPartitions",
+    "mapPartition", "mapPartitionsWithIndex", "mapPartitionWithIndex",
+    "foreach", "foreachPartition", "top", "sort",
+    "updateStateByKey", "reduceByKeyAndWindow", "transform",
+}
+
+# DparkContext factories producing RDDs (file-mode scope tracking)
+CONTEXT_FACTORIES = {
+    "parallelize", "makeRDD", "textFile", "partialTextFile", "csvFile",
+    "binaryFile", "tableFile", "table", "beansdb", "tabular", "union",
+    "zip",
+}
+
+_RANDOM_FNS = {"random", "randint", "randrange", "uniform", "choice",
+               "choices", "shuffle", "sample", "gauss", "normalvariate",
+               "betavariate", "expovariate", "vonmisesvariate",
+               "paretovariate", "weibullvariate", "triangular",
+               "lognormvariate", "getrandbits", "randbytes", "rand",
+               "randn", "standard_normal", "permutation"}
+_TIME_FNS = {"time", "time_ns", "monotonic", "perf_counter"}
+
+
+# ---------------------------------------------------------------------------
+# shared AST checks over one function body
+# ---------------------------------------------------------------------------
+
+class _ClosureVisitor(ast.NodeVisitor):
+    """Walk ONE function's body collecting rule hits; nested lambdas
+    and defs are part of the closure and walked too."""
+
+    def __init__(self, params, known_rdd_names=(), known_ctx_names=()):
+        self.params = set(params)
+        self.rdd_names = set(known_rdd_names)
+        self.ctx_names = set(known_ctx_names)
+        self.random_calls = []      # (lineno, "random.random")
+        self.time_calls = []
+        self.tracer_branches = []   # (lineno, kind)
+        self.captured = []          # (lineno, name)
+
+    # -- captures --------------------------------------------------------
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load) \
+                and node.id not in self.params \
+                and (node.id in self.rdd_names
+                     or node.id in self.ctx_names):
+            self.captured.append((node.lineno, node.id))
+        self.generic_visit(node)
+
+    # -- nondeterminism --------------------------------------------------
+    def visit_Call(self, node):
+        fn = node.func
+        dotted = _dotted(fn)
+        if dotted:
+            parts = dotted.split(".")
+            head, tail = parts[0], parts[-1]
+            if tail in _RANDOM_FNS and head in ("random", "np", "numpy",
+                                                "jax"):
+                self.random_calls.append((node.lineno, dotted))
+            elif tail in _TIME_FNS and head == "time":
+                self.time_calls.append((node.lineno, dotted))
+            elif tail == "item":
+                # x.item() forces a concrete value out of a traced array
+                self.tracer_branches.append((node.lineno, dotted + "()"))
+        elif isinstance(fn, ast.Name) and fn.id in ("float", "int",
+                                                    "bool"):
+            if any(self._derives_from_param(a) for a in node.args):
+                self.tracer_branches.append(
+                    (node.lineno, "%s() on an argument" % fn.id))
+        self.generic_visit(node)
+
+    # -- tracer-unsafe branching ----------------------------------------
+    def visit_If(self, node):
+        if self._derives_from_param(node.test):
+            self.tracer_branches.append(
+                (node.lineno, "if on a runtime value"))
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self._derives_from_param(node.test):
+            self.tracer_branches.append(
+                (node.lineno, "while on a runtime value"))
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        if self._derives_from_param(node.test):
+            self.tracer_branches.append(
+                (node.lineno, "conditional expression on a runtime "
+                              "value"))
+        self.generic_visit(node)
+
+    def _derives_from_param(self, expr):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in self.params:
+                return True
+        return False
+
+    # nested functions inherit the parameter set (their own params are
+    # also runtime values when called with closure data)
+    def visit_Lambda(self, node):
+        inner = set(self.params)
+        inner.update(a.arg for a in node.args.args)
+        saved, self.params = self.params, inner
+        self.generic_visit(node)
+        self.params = saved
+
+    def visit_FunctionDef(self, node):
+        inner = set(self.params)
+        inner.update(a.arg for a in node.args.args)
+        saved, self.params = self.params, inner
+        self.generic_visit(node)
+        self.params = saved
+
+
+def _dotted(node):
+    """'a.b.c' for an Attribute chain rooted at a Name, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _fn_params(node):
+    args = node.args
+    names = [a.arg for a in args.posonlyargs + args.args
+             + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    # defaults rebind a captured name to a parameter-local copy — the
+    # classic `lambda x, rdd=rdd:` idiom is SAFE only for plain values,
+    # but for the capture rule the name is a param, not a free load
+    return names
+
+
+def _emit(report, visitor, site, tpu=False, deterministic=True):
+    for lineno, name in visitor.captured:
+        report.add(
+            "closure-rdd-capture", "error", "%s:%d" % (site, lineno),
+            "worker function captures %r (an RDD/DparkContext): tasks "
+            "would serialize the whole driver-side graph" % name,
+            "collect()/broadcast() the data it needs instead, or join "
+            "the two datasets")
+    if deterministic:
+        for lineno, name in visitor.random_calls:
+            report.add(
+                "closure-unseeded-random", "warn",
+                "%s:%d" % (site, lineno),
+                "unseeded %s() in a deterministic stage: task retries "
+                "and speculative duplicates see different data" % name,
+                "seed per partition (mapPartitionsWithIndex + "
+                "random.Random(seed + index)) or precompute the draw")
+        for lineno, name in visitor.time_calls:
+            report.add(
+                "closure-unseeded-random", "warn",
+                "%s:%d" % (site, lineno),
+                "%s() in a deterministic stage: recomputation and "
+                "retries observe different clocks" % name,
+                "stamp times on the driver and broadcast the value")
+    sev = "warn" if tpu else "info"
+    for lineno, kind in visitor.tracer_branches:
+        report.add(
+            "closure-tracer-branch", sev, "%s:%d" % (site, lineno),
+            "%s: tracer-unsafe under the tpu master's jitted array "
+            "path (concretization error or silent host fallback)"
+            % kind,
+            "use jnp.where/lax.cond-style data-parallel forms, or "
+            "keep this stage on the host path")
+
+
+# ---------------------------------------------------------------------------
+# live-callable mode (pre-flight)
+# ---------------------------------------------------------------------------
+
+def _capture_values(fn):
+    """(name, value) pairs a callable would drag along when pickled:
+    closure cells plus the globals its code references."""
+    out = []
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        call = getattr(type(fn), "__call__", None)
+        # callable object (the _PartReduce idiom): its attributes ship
+        for k, v in list(getattr(fn, "__dict__", {}).items())[:32]:
+            out.append((k, v))
+        code = getattr(call, "__code__", None)
+        if code is None:
+            return out
+        fn = call
+    closure = getattr(fn, "__closure__", None) or ()
+    for name, cell in zip(code.co_freevars, closure):
+        try:
+            out.append((name, cell.cell_contents))
+        except ValueError:
+            pass                    # empty cell
+    fglobals = getattr(fn, "__globals__", {})
+    for name in code.co_names:
+        if name in fglobals:
+            out.append((name, fglobals[name]))
+    return out
+
+
+def lint_function(fn, site=None, report=None, tpu=False,
+                  deterministic=True, _ast_cache={}):
+    """Lint one live callable.  Closure/global capture inspection never
+    needs source; the AST rules run when inspect.getsource works."""
+    from dpark_tpu.context import DparkContext
+    from dpark_tpu.rdd import RDD
+    report = report if report is not None else Report()
+    site = site or _describe(fn)
+    for name, value in _capture_values(fn):
+        if isinstance(value, (RDD, DparkContext)):
+            report.add(
+                "closure-rdd-capture", "error", site,
+                "worker function captures %r = %r: tasks would "
+                "serialize the whole driver-side graph" % (name, value),
+                "collect()/broadcast() the data it needs instead, or "
+                "join the two datasets")
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return report
+    # stable identity — id(code) can be reused after GC, serving a
+    # stale AST for a different function; co_code disambiguates
+    # several lambdas sharing one source line
+    key = (code.co_filename, code.co_firstlineno, code.co_name,
+           code.co_code)
+    tree = _ast_cache.get(key)
+    if tree is None:
+        try:
+            src = textwrap.dedent(inspect.getsource(fn))
+            tree = ast.parse(src)
+        except (OSError, TypeError, SyntaxError, IndentationError,
+                ValueError):
+            tree = False
+        _ast_cache[key] = tree
+        if len(_ast_cache) > 4096:
+            _ast_cache.clear()
+    if tree is False:
+        return report
+    node = _match_fn_node(tree, code)
+    if node is not None:
+        v = _ClosureVisitor(_fn_params(node))
+        for stmt in (node.body if isinstance(node.body, list)
+                     else [node.body]):
+            v.visit(stmt)
+        _emit(report, v, site, tpu=tpu, deterministic=deterministic)
+    return report
+
+
+def _match_fn_node(tree, code):
+    """The FunctionDef/Lambda in `tree` that corresponds to `code`:
+    when several lambdas share one source line (so getsource returned
+    them all), prefer the one whose parameter names match the code
+    object — best-effort, first candidate otherwise."""
+    argnames = list(code.co_varnames[:code.co_argcount])
+    first = None
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.Lambda)):
+            continue
+        if first is None:
+            first = node
+        if [a.arg for a in node.args.args] == argnames:
+            return node
+    return first
+
+
+def _describe(fn):
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return repr(fn)
+    import os
+    return "%s:%d %s" % (os.path.basename(code.co_filename),
+                         code.co_firstlineno,
+                         getattr(fn, "__qualname__", code.co_name))
+
+
+def iter_plan_functions(rdd, lineage=None):
+    """(fn, site) for every user callable reachable from the lineage of
+    `rdd` — narrow transform functions and aggregator triples.
+    `lineage` lets the pre-flight gate pass its (possibly capped) walk
+    instead of re-walking."""
+    from dpark_tpu.analysis.plan_rules import iter_lineage
+    from dpark_tpu import rdd as _rdd
+    skip = {_rdd._identity, _rdd._mk_list, _rdd._append, _rdd._extend,
+            _rdd._fst, _rdd._snd, _rdd._add, _rdd._keep_first,
+            _rdd._radd_zero, _rdd._one, _rdd._count_merge,
+            _rdd._mean_create, _rdd._mean_merge_value, _rdd._mean_merge,
+            _rdd._mean_final, _rdd._pair_none, _rdd._pair_one,
+            _rdd._pair_self}
+    for r in (lineage if lineage is not None else iter_lineage(rdd)):
+        fn = getattr(r, "f", None)
+        if callable(fn) and fn not in skip:
+            yield fn, r.scope_name
+        agg = getattr(r, "aggregator", None)
+        if agg is not None:
+            for part in (agg.create_combiner, agg.merge_value,
+                         agg.merge_combiners):
+                if callable(part) and part not in skip \
+                        and getattr(part, "__module__", "").split(".")[0] \
+                        not in ("operator", "builtins", "_operator"):
+                    yield part, r.scope_name
+
+
+# ---------------------------------------------------------------------------
+# source-file mode (dlint CLI / CI self-lint)
+# ---------------------------------------------------------------------------
+
+class _ModuleScope(ast.NodeVisitor):
+    """Track module/function-scope names bound to DparkContexts and to
+    RDD chains, then lint every closure passed to a transform call."""
+
+    def __init__(self, path, report, tpu=False):
+        self.path = path
+        self.report = report
+        self.tpu = tpu
+        self.ctx_names = set()
+        self.rdd_names = set()
+        self.defs = {}              # name -> FunctionDef (module level)
+        self.collect_only = True    # pass 1 gathers names, pass 2 lints
+
+    # -- assignment tracking --------------------------------------------
+    def visit_Assign(self, node):
+        value = node.value
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if targets:
+            if self._is_ctx_expr(value):
+                self.ctx_names.update(targets)
+            elif self._is_rdd_expr(value):
+                self.rdd_names.update(targets)
+        self.generic_visit(node)
+
+    def _is_ctx_expr(self, expr):
+        if isinstance(expr, ast.Call):
+            dotted = _dotted(expr.func) or ""
+            if dotted.split(".")[-1] in ("DparkContext",):
+                return True
+        return False
+
+    def _is_rdd_expr(self, expr):
+        """ctx.<factory>(...) or <rdd>.<transform>(...) chains."""
+        while isinstance(expr, ast.Call):
+            fn = expr.func
+            if not isinstance(fn, ast.Attribute):
+                return False
+            if isinstance(fn.value, ast.Name):
+                base = fn.value.id
+                if base in self.ctx_names \
+                        and fn.attr in CONTEXT_FACTORIES:
+                    return True
+                if base in self.rdd_names:
+                    return True
+                return False
+            expr = fn.value         # deeper chain: a.b(...).c(...)
+        return False
+
+    def visit_FunctionDef(self, node):
+        self.defs[node.name] = node
+        self.generic_visit(node)
+
+    # -- transform calls -------------------------------------------------
+    def visit_Call(self, node):
+        fn = node.func
+        if not self.collect_only and isinstance(fn, ast.Attribute) \
+                and fn.attr in TRANSFORM_METHODS:
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                self._lint_closure_arg(arg, fn.attr)
+        self.generic_visit(node)
+
+    def _lint_closure_arg(self, arg, method):
+        node = None
+        name = None
+        if isinstance(arg, ast.Lambda):
+            node = arg
+            name = "lambda"
+        elif isinstance(arg, ast.Name) and arg.id in self.defs:
+            node = self.defs[arg.id]
+            name = arg.id
+        if node is None:
+            return
+        params = set(_fn_params(node))
+        # default-arg rebinding (lambda x, r=rdd: ...) still captures:
+        # the default VALUE is the rdd — flag those too
+        default_rdds = []
+        for d, a in zip(reversed(node.args.defaults),
+                        reversed(node.args.args)):
+            if isinstance(d, ast.Name) and (d.id in self.rdd_names
+                                            or d.id in self.ctx_names):
+                default_rdds.append((node.lineno, d.id))
+        v = _ClosureVisitor(params, self.rdd_names, self.ctx_names)
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            v.visit(stmt)
+        v.captured.extend(default_rdds)
+        site = "%s %s" % (self.path, name)      # _emit appends :lineno
+        _emit(self.report, v, site, tpu=self.tpu)
+
+
+def lint_source(path, report=None, text=None, tpu=False):
+    """Lint one Python source file; returns the Report."""
+    report = report if report is not None else Report()
+    if text is None:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        report.add("syntax-error", "error", "%s:%s" % (path, e.lineno),
+                   "file does not parse: %s" % e.msg)
+        return report
+    scope = _ModuleScope(path, report, tpu=tpu)
+    # two passes: assignments/defs first so forward uses of an rdd name
+    # inside main() still resolve, then the transform-call lint
+    scope.visit(tree)
+    scope.collect_only = False
+    scope.visit(tree)
+    return report

@@ -1,0 +1,388 @@
+"""Plan linter: a static pass over the RDD lineage DAG, run pre-flight
+by DparkContext.runJob (and importable standalone via lint_plan).
+
+Rules catch the two failure families the round-5 audit surfaced —
+silent-wrong-answer shapes decided at plan-construction time, and
+shuffle anti-patterns that dominate cost at production scale:
+
+  plan-group-agg         groupByKey().mapValue(provable aggregate) that
+                         the graph-build rewrite did NOT absorb: every
+                         row ships to its group instead of a map-side
+                         combine.
+  plan-uncached-reshuffle one lineage shuffled 2+ times without
+                         cache()/checkpoint(): the parent recomputes
+                         once per shuffle.
+  plan-wide-depth        more than conf.LINT_WIDE_DEPTH shuffle edges on
+                         one lineage path with no checkpoint: a lost
+                         partition replays the whole chain.
+  plan-join-repartition  a cogroup/join whose inputs already share a
+                         partitioner, re-exchanged because the join was
+                         given a different partition count.
+  monoid-multileaf       reduceByKey/combineByKey with a classified
+                         min/max merge over values whose pytree has >1
+                         leaf or a non-scalar leaf — the exact round-5
+                         silent-wrong-answer shape on the device monoid
+                         path (the host compares whole records
+                         lexicographically, a per-leaf device reduction
+                         mixes leaves from different records; add/mul
+                         over sequences are legitimate concat/repeat
+                         and stay unflagged).
+
+The walk reads graph structure only (dependencies / partitioner /
+cache flags) — it never touches RDD.splits (which can promote lazy
+checkpoints) and never runs jobs.  Record probing for monoid-multileaf
+reads only data already resident on the driver (parallelize slices);
+user functions are never executed unless conf.LINT_PROBE == "deep".
+"""
+
+from dpark_tpu.analysis.report import Report
+
+
+# ---------------------------------------------------------------------------
+# lineage traversal
+# ---------------------------------------------------------------------------
+
+def iter_lineage(rdd):
+    """Yield every RDD reachable from `rdd` (itself included) exactly
+    once, parents after children discovery order — purely structural,
+    no splits access."""
+    seen = set()
+    frontier = [rdd]
+    while frontier:
+        r = frontier.pop()
+        if id(r) in seen:
+            continue
+        seen.add(id(r))
+        yield r
+        for dep in getattr(r, "dependencies", ()):
+            parent = getattr(dep, "rdd", None)
+            if parent is not None:
+                frontier.append(parent)
+
+
+def _is_pinned(r):
+    """cache/checkpoint/snapshot pins: this RDD's lineage does not
+    recompute on re-use (for lint purposes)."""
+    return (getattr(r, "should_cache", False)
+            or getattr(r, "_checkpoint_path", None) is not None
+            or getattr(r, "_checkpoint_rdd", None) is not None
+            or getattr(r, "_snapshot_path", None) is not None)
+
+
+# ---------------------------------------------------------------------------
+# merge classification (jax-free fallback)
+# ---------------------------------------------------------------------------
+
+def _ensure_backend_identities():
+    """Register the tpu backend's jnp by-identity callables in the
+    shared classifier — but ONLY when jax is already loaded: a
+    pure-local job must not pay a jax import (review finding; the
+    registrations only matter if the user passed a jnp callable, which
+    implies jax is in sys.modules already)."""
+    import sys
+    if "jax" in sys.modules:
+        try:
+            import dpark_tpu.backend.tpu.fuse      # noqa: F401
+        except ImportError:
+            pass
+
+
+def _classify_merge(fn):
+    """The SHARED exact classifier (utils/monoid.py — the same core
+    fuse.classify_merge delegates to, so linter and executor can never
+    drift)."""
+    _ensure_backend_identities()
+    from dpark_tpu.utils.monoid import classify_merge
+    return classify_merge(fn)
+
+
+def _classify_segagg(fn):
+    _ensure_backend_identities()
+    from dpark_tpu.utils.monoid import classify_segagg
+    return classify_segagg(fn)
+
+
+# ---------------------------------------------------------------------------
+# value-shape probing (monoid-multileaf)
+# ---------------------------------------------------------------------------
+
+def _value_leaves(v):
+    """Flatten a record value the way the device path would: tuples,
+    lists, and dict values are structure; everything else is one leaf."""
+    if isinstance(v, (tuple, list)):
+        out = []
+        for item in v:
+            out.extend(_value_leaves(item))
+        return out
+    if isinstance(v, dict):
+        out = []
+        for k in sorted(v, key=repr):
+            out.extend(_value_leaves(v[k]))
+        return out
+    return [v]
+
+
+def _leaf_is_scalar(leaf):
+    shape = getattr(leaf, "shape", None)
+    if shape:                       # ndarray with ndim > 0
+        return False
+    return True
+
+
+def _peek_source_records(rdd, k=4, _depth=0):
+    """Up to k records WITHOUT running a job: reads data already
+    resident on the driver (parallelize slices), looks through unions,
+    and — only under conf.LINT_PROBE == "deep" — replays narrow
+    per-record functions over the probe rows (user functions may have
+    side effects, e.g. accumulators, so execution is opt-in).  Returns
+    a list of records, possibly empty, or None when the source is not
+    cheaply probeable."""
+    from dpark_tpu import conf, rdd as _rdd
+    if _depth > 16:
+        return None
+    if isinstance(rdd, _rdd.ParallelCollection):
+        slices = getattr(rdd, "_slices", None)
+        if slices is None:          # worker-side copy: data stripped
+            return None
+        out = []
+        for s in slices:
+            try:
+                for i in range(min(k - len(out), len(s))):
+                    out.append(s[i])
+            except Exception:
+                return None
+            if len(out) >= k:
+                break
+        return out
+    if isinstance(rdd, _rdd.UnionRDD):
+        for parent in getattr(rdd, "rdds", ()):
+            rows = _peek_source_records(parent, k, _depth + 1)
+            if rows:
+                return rows
+        return None
+    if getattr(conf, "LINT_PROBE", "shallow") != "deep":
+        return None
+    per_record = {
+        _rdd.MappedRDD: lambda f, rows: [f(r) for r in rows],
+        _rdd.FilteredRDD: lambda f, rows: [r for r in rows if f(r)],
+        _rdd.FlatMappedRDD: lambda f, rows: [o for r in rows
+                                             for o in f(r)],
+        _rdd.MappedValuesRDD: lambda f, rows: [(r[0], f(r[1]))
+                                               for r in rows],
+        _rdd.KeyedRDD: lambda f, rows: [(f(r), r) for r in rows],
+    }
+    fn = per_record.get(type(rdd))
+    if fn is None:
+        return None
+    parent_rows = _peek_source_records(rdd.prev, k, _depth + 1)
+    if not parent_rows:
+        return parent_rows
+    try:
+        return fn(rdd.f, parent_rows)[:k]
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _rule_group_agg(r, report):
+    """MappedValuesRDD over a bare groupByKey whose mapValue function is
+    a PROVABLE aggregate: the graph rewrite did not absorb it (cache
+    pin, np twins, reused outputs, or conf off), so every row rides the
+    exchange."""
+    from dpark_tpu import conf, rdd as _rdd
+    if not getattr(conf, "GROUP_AGG_REWRITE", True):
+        return                      # user opted out deliberately
+    if not isinstance(r, _rdd.MappedValuesRDD):
+        return
+    prev = r.prev
+    if not isinstance(prev, _rdd.ShuffledRDD):
+        return
+    agg = prev.aggregator
+    if not (agg.create_combiner is _rdd._mk_list
+            and agg.merge_value is _rdd._append
+            and agg.merge_combiners is _rdd._extend):
+        return
+    try:
+        from dpark_tpu.env import env
+        if env.map_output_tracker.get_outputs(
+                prev.dep.shuffle_id) is not None:
+            return          # rewrite declined to REUSE existing outputs
+    except Exception:
+        pass
+    f = getattr(r, "f", None)
+    provable = f is not None and _classify_segagg(f) is not None
+    np_twin = False
+    if not provable:
+        try:
+            import numpy as np
+            np_twin = f in (np.sum, np.mean, np.min, np.max)
+        except Exception:
+            np_twin = False
+    if not provable and not np_twin:
+        return                      # f may be a real list transform
+    report.add(
+        "plan-group-agg", "warn", r.scope_name,
+        "groupByKey().mapValue(<aggregate>) ships every row to its "
+        "group; the combiner rewrite did not absorb this chain",
+        "use reduceByKey/combineByKey (or drop the cache pin on the "
+        "grouped RDD); np.sum/np.mean twins need the builtin forms")
+
+
+def _rule_uncached_reshuffle(lineage, report):
+    """The same parent RDD feeding 2+ distinct shuffles without a
+    cache/checkpoint pin: its lineage recomputes once per shuffle."""
+    shuffled_by = {}                # id(parent) -> (parent, {shuffle_id})
+    for r in lineage:
+        for dep in getattr(r, "dependencies", ()):
+            if getattr(dep, "is_shuffle", False):
+                parent = dep.rdd
+                ent = shuffled_by.setdefault(id(parent), (parent, set()))
+                ent[1].add(dep.shuffle_id)
+    for parent, sids in shuffled_by.values():
+        if len(sids) < 2 or _is_pinned(parent):
+            continue
+        report.add(
+            "plan-uncached-reshuffle", "warn", parent.scope_name,
+            "this lineage feeds %d separate shuffles and is not "
+            "cached: it recomputes for each one" % len(sids),
+            "cache() (or checkpoint()) the RDD before fanning out")
+
+
+def _shuffle_depth(r, memo):
+    """Max number of shuffle edges on any path below `r`; a pinned RDD
+    resets the count (its lineage won't replay)."""
+    key = id(r)
+    if key in memo:
+        return memo[key]
+    memo[key] = 0                   # cycle guard (graphs are acyclic)
+    if _is_pinned(r):
+        return 0
+    best = 0
+    for dep in getattr(r, "dependencies", ()):
+        d = _shuffle_depth(dep.rdd, memo) \
+            + (1 if getattr(dep, "is_shuffle", False) else 0)
+        best = max(best, d)
+    memo[key] = best
+    return best
+
+
+def _rule_wide_depth(rdd, report):
+    from dpark_tpu import conf
+    limit = int(getattr(conf, "LINT_WIDE_DEPTH", 4))
+    if limit <= 0:
+        return
+    depth = _shuffle_depth(rdd, {})
+    if depth > limit:
+        report.add(
+            "plan-wide-depth", "warn", rdd.scope_name,
+            "%d chained shuffles with no checkpoint on the path "
+            "(limit %d): a lost partition replays the whole chain"
+            % (depth, limit),
+            "checkpoint() (or cache()) an intermediate RDD; raise "
+            "conf.LINT_WIDE_DEPTH if the depth is intentional")
+
+
+def _rule_join_repartition(r, report):
+    """A cogroup whose inputs ALL share one partitioner, forced through
+    a full re-exchange because the cogroup was created with a different
+    partitioner (usually an implicit numSplits default)."""
+    from dpark_tpu import rdd as _rdd
+    if not isinstance(r, _rdd.CoGroupedRDD):
+        return
+    inputs = getattr(r, "rdds", ())
+    if len(inputs) < 2:
+        return
+    parts = [p.partitioner for p in inputs]
+    if any(p is None for p in parts):
+        return
+    first = parts[0]
+    if not all(p == first for p in parts[1:]):
+        return
+    if first == r.partitioner:
+        return                      # narrow already — nothing to flag
+    report.add(
+        "plan-join-repartition", "warn", r.scope_name,
+        "join/cogroup inputs already agree on a partitioner "
+        "(%d parts) but the join repartitions to %d: both sides "
+        "re-exchange for nothing"
+        % (first.num_partitions, r.partitioner.num_partitions),
+        "pass numSplits=%d (or the shared partitioner) to the join"
+        % first.num_partitions)
+
+
+def _rule_monoid_multileaf(r, report):
+    """Combining shuffle with a classified monoid merge over multi-leaf
+    or non-scalar values: the round-5 wrong-answer shape.  The host
+    merges whole records (tuples compare lexicographically) while the
+    device monoid path reduces each leaf independently — results mix
+    leaves from different records.  The executor now refuses the
+    device monoid for this shape (falling back to the raw-combiner
+    exchange), so severity=error here is the pre-flight twin that
+    refuses the plan outright under DPARK_LINT=error."""
+    from dpark_tpu import rdd as _rdd
+    if not isinstance(r, _rdd.ShuffledRDD):
+        return
+    agg = r.aggregator
+    if (agg.create_combiner is _rdd._mk_list
+            and agg.merge_value is _rdd._append
+            and agg.merge_combiners is _rdd._extend):
+        return                      # no-combine shuffle: no monoid path
+    kind = _classify_merge(agg.merge_combiners)
+    if kind not in ("min", "max"):
+        # add/mul over sequences are legitimate HOST semantics (tuple
+        # concat/repeat) that every master now agrees on; only ordered
+        # comparisons have the lexicographic-vs-per-leaf ambiguity
+        return
+    rows = _peek_source_records(r.parent)
+    if not rows:
+        return                      # not cheaply probeable: stay quiet
+    bad = None
+    for row in rows:
+        if not (isinstance(row, tuple) and len(row) == 2):
+            continue
+        leaves = _value_leaves(row[1])
+        if len(leaves) > 1:
+            bad = "%d value leaves" % len(leaves)
+            break
+        if leaves and not _leaf_is_scalar(leaves[0]):
+            bad = "a non-scalar value leaf (shape %s)" \
+                % (getattr(leaves[0], "shape", None),)
+            break
+    if bad is None:
+        return
+    report.add(
+        "monoid-multileaf", "error", r.scope_name,
+        "reduceByKey/combineByKey merge classifies as monoid %r but "
+        "records carry %s: per-leaf device reduction would mix leaves "
+        "from different records (host %s merges whole records)"
+        % (kind, bad, kind),
+        "merge per-field explicitly (e.g. lambda a, b: (min(a[0], "
+        "b[0]), ...) is NOT the same as %s(a, b)) or keep a single "
+        "scalar value per record" % kind)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def lint_plan(rdd, master="local", report=None, lineage=None):
+    """Run every plan rule over the lineage of `rdd`; returns a Report.
+
+    `master` reserved for master-specific severity policy (the rules
+    themselves are master-agnostic: the monoid shape is a device-path
+    hazard but the plan may run under -m tpu later).  `lineage` lets
+    the pre-flight gate pass its (possibly capped) walk instead of
+    re-walking."""
+    report = report if report is not None else Report()
+    if lineage is None:
+        lineage = list(iter_lineage(rdd))
+    for r in lineage:
+        _rule_group_agg(r, report)
+        _rule_join_repartition(r, report)
+        _rule_monoid_multileaf(r, report)
+    _rule_uncached_reshuffle(lineage, report)
+    _rule_wide_depth(rdd, report)
+    return report

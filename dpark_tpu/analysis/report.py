@@ -1,0 +1,144 @@
+"""Structured lint findings: rule id, severity, lineage/source site,
+message, fix hint — and the severity policy that turns findings into
+log lines (DPARK_LINT=warn) or a refused plan (DPARK_LINT=error).
+
+Every rule in plan_rules/closure_rules emits Finding objects through a
+Report; nothing in this module knows about RDDs or ASTs, so the CLI,
+the pre-flight gate, and tests all consume the same shape.
+"""
+
+import os
+import sys
+
+SEVERITIES = ("info", "warn", "error")
+
+
+def lint_mode():
+    """The effective DPARK_LINT mode: off | warn | error.
+
+    The env var wins over the conf constant so a single run can be
+    escalated (DPARK_LINT=error python job.py) without editing conf.
+    Unknown values degrade to "warn" — a typo must not silently turn
+    the linter off."""
+    from dpark_tpu import conf
+    mode = os.environ.get("DPARK_LINT", getattr(conf, "DPARK_LINT", "warn"))
+    mode = str(mode).strip().lower()
+    if mode in ("off", "0", "none", "disable", "disabled"):
+        return "off"
+    if mode in ("error", "strict", "fail"):
+        return "error"
+    return "warn"
+
+
+class Finding:
+    """One lint finding.
+
+    rule     -- stable kebab-case id ("monoid-multileaf", ...)
+    severity -- "info" | "warn" | "error"
+    site     -- where: an RDD scope name ("MappedRDD@file.py:12") or a
+                source location ("examples/pi.py:9 inside()")
+    message  -- one-line statement of the defect
+    hint     -- how to fix it (may be empty)
+    """
+
+    __slots__ = ("rule", "severity", "site", "message", "hint")
+
+    def __init__(self, rule, severity, site, message, hint=""):
+        assert severity in SEVERITIES, severity
+        self.rule = rule
+        self.severity = severity
+        self.site = site
+        self.message = message
+        self.hint = hint
+
+    @property
+    def key(self):
+        """Dedup identity within a process/run.  (The CLI's baseline
+        uses its own coarser key with line numbers stripped.)"""
+        return (self.rule, self.site)
+
+    def as_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "site": self.site, "message": self.message,
+                "hint": self.hint}
+
+    def render(self):
+        out = "%s %s [%s] %s" % (self.severity.upper(), self.site,
+                                 self.rule, self.message)
+        if self.hint:
+            out += "\n    hint: %s" % self.hint
+        return out
+
+    def __repr__(self):
+        return "<Finding %s %s %s>" % (self.severity, self.rule, self.site)
+
+
+class Report:
+    """An ordered, deduplicated collection of findings."""
+
+    def __init__(self):
+        self.findings = []
+        self._seen = set()
+
+    def add(self, rule, severity, site, message, hint=""):
+        f = Finding(rule, severity, site, message, hint)
+        if f.key in self._seen:
+            return None
+        self._seen.add(f.key)
+        self.findings.append(f)
+        return f
+
+    def extend(self, other):
+        for f in other.findings:
+            if f.key not in self._seen:
+                self._seen.add(f.key)
+                self.findings.append(f)
+
+    def __len__(self):
+        return len(self.findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def __bool__(self):
+        return bool(self.findings)
+
+    def errors(self):
+        return [f for f in self.findings if f.severity == "error"]
+
+    def worst(self):
+        worst = None
+        for f in self.findings:
+            if worst is None or (SEVERITIES.index(f.severity)
+                                 > SEVERITIES.index(worst)):
+                worst = f.severity
+        return worst
+
+    def render(self, stream=None, min_severity="info"):
+        stream = stream or sys.stderr
+        floor = SEVERITIES.index(min_severity)
+        n = 0
+        for f in self.findings:
+            if SEVERITIES.index(f.severity) < floor:
+                continue
+            print(f.render(), file=stream)
+            n += 1
+        return n
+
+    def as_dicts(self):
+        return [f.as_dict() for f in self.findings]
+
+
+class PlanLintError(Exception):
+    """Raised by the pre-flight gate under DPARK_LINT=error: the plan
+    holds at least one error-severity finding and is refused before any
+    task launches.  .report carries the full Report."""
+
+    def __init__(self, report):
+        self.report = report
+        lines = [f.render() for f in report.errors()] or \
+                [f.render() for f in report]
+        super().__init__(
+            "plan refused by DPARK_LINT=error (%d finding%s):\n%s"
+            % (len(lines), "s" if len(lines) != 1 else "",
+               "\n".join(lines)))

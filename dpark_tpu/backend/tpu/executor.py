@@ -227,7 +227,20 @@ class JAXExecutor:
         trace (``max(a, b)`` forces a tracer bool) — discarding the
         monoid with the failed trace crashed the streamed combine (r5
         fuzz finding).  Untraceable AND unclassified merges exchange
-        raw created combiners."""
+        raw created combiners.
+
+        A classified monoid WITHOUT a traced merge_fn only stands in
+        for the user's function when the record carries exactly one
+        SCALAR value leaf: the host merges whole records (max over
+        tuples compares lexicographically) while the monoid-only call
+        sites (_epilogue_block, the carry_rid bucketize, and
+        _prereduce_received — all of which get their pair from here
+        via _merge_probe) reduce each leaf independently, mixing
+        leaves from different records (r5 advisor finding: silent
+        wrong answers for tuple-valued reduceByKey(min/max)).  For
+        any other value shape the pair degrades to (None, None) and
+        the raw-combiner exchange folds with the user's function on
+        the host — slower, correct."""
         dep = plan.epilogue[1]
         if fuse.is_list_agg(dep.aggregator):
             return None, None
@@ -243,6 +256,12 @@ class JAXExecutor:
                            *structs)
         except Exception:
             merge_fn = None
+        if merge_fn is None and monoid is not None:
+            specs = plan.out_specs
+            single_scalar_value = (len(specs) == 2
+                                   and specs[1][1] == ())
+            if not single_scalar_value:
+                return None, None
         return merge_fn, monoid
 
     @staticmethod

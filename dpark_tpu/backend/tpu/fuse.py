@@ -61,140 +61,34 @@ def _batched_spec_struct(specs, n=4):
     return [jax.ShapeDtypeStruct((n,) + shape, dt) for dt, shape in specs]
 
 
-_MONOID_DIRECT = None
-_MONOID_TEMPLATES = None
+# exact monoid identification lives in the SHARED jax-free core
+# (utils/monoid.py) so the pre-flight linter classifies identically;
+# this backend contributes its jnp identities to the by-identity table
+from dpark_tpu.utils import monoid as _monoid
 
-
-def _monoid_tables():
-    """Lazily built lookup tables for exact monoid identification."""
-    global _MONOID_DIRECT, _MONOID_TEMPLATES
-    if _MONOID_DIRECT is None:
-        import operator
-        direct = {operator.add: "add", operator.iadd: "add",
-                  operator.mul: "mul", operator.imul: "mul",
-                  min: "min", max: "max",
-                  np.add: "add", np.multiply: "mul",
-                  np.minimum: "min", np.maximum: "max",
-                  jnp.add: "add", jnp.multiply: "mul",
-                  jnp.minimum: "min", jnp.maximum: "max"}
-        tmpl = {
-            "add": [lambda a, b: a + b, lambda a, b: b + a],
-            "mul": [lambda a, b: a * b, lambda a, b: b * a],
-            "min": [lambda a, b: min(a, b)],
-            "max": [lambda a, b: max(a, b)],
-        }
-        templates = {}
-        for name, fns in tmpl.items():
-            for f in fns:
-                c = f.__code__
-                templates[(c.co_code, c.co_consts, c.co_names)] = name
-        _MONOID_DIRECT, _MONOID_TEMPLATES = direct, templates
-    return _MONOID_DIRECT, _MONOID_TEMPLATES
+_monoid.register_direct({jnp.add: "add", jnp.multiply: "mul",
+                         jnp.minimum: "min", jnp.maximum: "max"})
 
 
 def classify_merge(merge):
-    """EXACT algebraic classification of a user merge function.
-
-    A classified monoid unlocks single-pass segment scatters instead of
-    the generic O(log n)-pass associative scan — but a wrong answer here
-    silently replaces the user's function, so only provable matches
-    qualify (round-1 advisor finding: the old 8-random-int-probe
-    classifier could mistake e.g. a saturating add for plain add):
-
-    * a known callable by identity (operator.add, min, np.maximum, ...);
-    * a closure-free 2-arg Python function whose bytecode equals one of
-      the canonical forms ``a+b``, ``b+a``, ``a*b``, ``b*a``,
-      ``min(a,b)``, ``max(a,b)`` — with any referenced global verified
-      to still be the builtin;
-    * an explicit user hint: ``merge.__dpark_monoid__ = "add"`` (for
-      functions that are equivalent to a monoid but written differently).
-
-    Everything else returns None and runs through the traced user
-    function (correct, just not single-pass)."""
-    hint = getattr(merge, "__dpark_monoid__", None)
-    if hint in ("add", "min", "max", "mul"):
-        return hint
-    direct, templates = _monoid_tables()
-    try:
-        if merge in direct:
-            return direct[merge]
-    except TypeError:
-        return None                      # unhashable callable
-    code = getattr(merge, "__code__", None)
-    if code is None or getattr(merge, "__closure__", None):
-        return None
-    if code.co_argcount != 2 or code.co_flags & 0x0C:   # *args/**kwargs
-        return None
-    name = templates.get((code.co_code, code.co_consts, code.co_names))
-    if name is None:
-        return None
-    if not _builtin_globals_ok(merge, code):
-        return None
-    return name
+    """EXACT algebraic classification of a user merge function —
+    "add" | "min" | "max" | "mul" | None.  See utils/monoid.py for the
+    proof obligations (only provable matches qualify; everything else
+    returns None and runs through the traced user function)."""
+    return _monoid.classify_merge(merge)
 
 
 from dpark_tpu.utils import builtin_globals_ok as _builtin_globals_ok
-
-
-_SEGAGG_DIRECT = None
-_SEGAGG_TEMPLATES = None
-
-
-def _segagg_tables():
-    global _SEGAGG_DIRECT, _SEGAGG_TEMPLATES
-    if _SEGAGG_DIRECT is None:
-        direct = {sum: "sum", len: "count", min: "min", max: "max",
-                  np.sum: "sum", np.mean: "mean",
-                  np.min: "min", np.max: "max"}
-        tmpl = {
-            "sum": [lambda vs: sum(vs)],
-            "count": [lambda vs: len(vs)],
-            "min": [lambda vs: min(vs)],
-            "max": [lambda vs: max(vs)],
-            "mean": [lambda vs: sum(vs) / len(vs)],
-        }
-        templates = {}
-        for name, fns in tmpl.items():
-            for f in fns:
-                c = f.__code__
-                templates[(c.co_code, c.co_consts, c.co_names)] = name
-        _SEGAGG_DIRECT, _SEGAGG_TEMPLATES = direct, templates
-    return _SEGAGG_DIRECT, _SEGAGG_TEMPLATES
 
 
 def classify_segagg(f):
     """EXACT classification of a mapValues function applied to a
     groupByKey value LIST as a per-group aggregate (VERDICT r4 #3:
     group->aggregate chains ride the mesh as segment reductions, no
-    (k, [v]) lists ever materialize).  Same proof obligations as
-    classify_merge — only provable matches qualify:
-
-    * the builtins sum/len/min/max (or np.sum/np.mean/np.min/np.max)
-      by identity;
-    * a closure-free 1-arg function whose bytecode equals ``sum(vs)``,
-      ``len(vs)``, ``min(vs)``, ``max(vs)`` or ``sum(vs)/len(vs)``,
-      with referenced globals verified to still be the builtins;
-    * an explicit hint: ``f.__dpark_segagg__ = "sum"``.
-
-    Returns "sum" | "count" | "min" | "max" | "mean" | None."""
-    hint = getattr(f, "__dpark_segagg__", None)
-    if hint in ("sum", "count", "min", "max", "mean"):
-        return hint
-    direct, templates = _segagg_tables()
-    try:
-        if f in direct:
-            return direct[f]
-    except TypeError:
-        return None
-    code = getattr(f, "__code__", None)
-    if code is None or getattr(f, "__closure__", None):
-        return None
-    if code.co_argcount != 1 or code.co_flags & 0x0C:
-        return None
-    name = templates.get((code.co_code, code.co_consts, code.co_names))
-    if name is None or not _builtin_globals_ok(f, code):
-        return None
-    return name
+    (k, [v]) lists ever materialize).  Delegates to the shared
+    jax-free core (utils/monoid.py) — same proof obligations as
+    classify_merge; only provable matches qualify."""
+    return _monoid.classify_segagg(f)
 
 
 def _subscript_const_index(f):

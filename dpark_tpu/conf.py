@@ -126,6 +126,11 @@ NARROW_EXCHANGE = os.environ.get("DPARK_NARROW_EXCHANGE", "1") != "0"
 # to a map-side-combining combineByKey (rdd._group_agg_rewrite): the
 # classic combiner optimization, exchange volume O(distinct keys).
 # "0" disables; the device SegAggOp path then serves these chains.
+# FLOAT CAVEAT: the rewrite reassociates the fold — sum/mean over float
+# values pre-combine map-side, so low-order bits depend on partitioning
+# and combine order on EVERY master (including local), where the
+# un-rewritten groupByKey summed each group's list in row order.
+# Integer aggregates and min/max are exact either way.
 GROUP_AGG_REWRITE = os.environ.get("DPARK_GROUP_AGG_REWRITE",
                                    "1") != "0"
 
@@ -144,6 +149,35 @@ EGEST_WARN_BYTES = 256 << 20
 # when set, the tpu executor writes a jax.profiler trace here for the
 # whole session (view with tensorboard / xprof)
 TRACE_DIR = os.environ.get("DPARK_TRACE_DIR")
+
+# ---------------------------------------------------------------------------
+# pre-flight plan linter (dpark_tpu/analysis/)
+# ---------------------------------------------------------------------------
+
+# off | warn | error.  Every runJob lints the submitted lineage first:
+# "warn" logs each finding once per process; "error" refuses a plan
+# carrying error-severity findings (e.g. monoid-multileaf — the
+# round-5 silent-wrong-answer shape) with PlanLintError BEFORE any
+# task launches.  The env var wins at read time (analysis.lint_mode)
+# so a single run can be escalated without editing conf.
+DPARK_LINT = os.environ.get("DPARK_LINT", "warn")
+
+# plan-wide-depth rule: more chained shuffles than this on one
+# uncheckpointed lineage path draws a warning (0 disables the rule)
+LINT_WIDE_DEPTH = int(os.environ.get("DPARK_LINT_WIDE_DEPTH", "4"))
+
+# pre-flight walk budget in lineage nodes: plans bigger than this are
+# linted over a truncated prefix (logged at debug) so per-tick lint
+# cost on long-running streams stays bounded — streaming lineages grow
+# until checkpoint truncation and each tick submits a fresh final rdd
+LINT_MAX_NODES = int(os.environ.get("DPARK_LINT_MAX_NODES", "500"))
+
+# monoid-multileaf record probing: "shallow" reads only data already
+# resident on the driver (parallelize slices / unions of them);
+# "deep" additionally replays narrow per-record user functions over
+# the <=4 probe rows (opt-in: user functions may carry side effects,
+# e.g. accumulator bumps); "off" disables probing entirely
+LINT_PROBE = os.environ.get("DPARK_LINT_PROBE", "shallow")
 
 
 def load_conf(path):

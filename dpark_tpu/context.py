@@ -231,6 +231,13 @@ class DparkContext:
     # -- execution -------------------------------------------------------
     def runJob(self, rdd, func, partitions=None, allow_local=False):
         self.start()
+        # pre-flight gate (dpark_tpu/analysis/): lint the lineage —
+        # shuffle anti-patterns and silent-wrong-answer shapes — before
+        # the scheduler sees it.  Runs EAGERLY here (run_job returns a
+        # lazy generator), so DPARK_LINT=error refuses a bad plan at
+        # submit time, not at first iteration.
+        from dpark_tpu.analysis import preflight
+        preflight(rdd, master=self.master, func=func)
         return self.scheduler.run_job(rdd, func, partitions, allow_local)
 
     def clear(self):

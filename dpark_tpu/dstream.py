@@ -12,6 +12,7 @@ programs (backend/tpu/fuse.py), so the per-tick cost is execution, not
 compilation — the DStream-specific recompile hazard of SURVEY.md 7.2.5.
 """
 
+import numbers
 import os
 import socket as _socket
 import threading
@@ -202,18 +203,87 @@ class StreamingContext:
 
     def run_batch(self, t):
         """Generate and run one batch's jobs (called by the timer loop; in
-        tests it can be driven manually for determinism)."""
+        tests it can be driven manually for determinism).
+
+        A TypeError escaping a batch whose state/window streams took
+        the probe-based numeric union-reduce rewrite permanently
+        disables that rewrite (the probe saw a numeric head; the tail
+        proved it wrong) and regenerates the batch through the generic
+        updateFunc/invFunc path — the 5-record probe is an accelerator
+        heuristic, never the arbiter of correctness."""
         t = round(t, 6)
         self._batches_done += 1
         self._checkpoint_now = (
             self.checkpoint_path is not None
             and self._batches_done % self.checkpoint_interval == 0)
         for out in self.output_streams:
-            out.generate_job(t)
+            try:
+                out.generate_job(t)
+            except (TypeError, RuntimeError) as e:
+                if not self._disable_numeric_rewrites(t, e, out):
+                    raise
+                try:
+                    out.generate_job(t)  # regenerate via the generic path
+                except Exception:
+                    # the generic path rejects this batch too (the
+                    # user's own function raises on the data): drop the
+                    # poisoned derived RDDs so LATER batches carry the
+                    # last good state forward instead of replaying the
+                    # failure forever.  Scope to THIS output's chain —
+                    # sibling chains already emitted their batch
+                    for s in self._chain_streams(out):
+                        if not isinstance(s, InputDStream):
+                            s.generated.pop(t, None)
+                    raise
         for out in self.output_streams:
             out.forget_old(t)
         if self._checkpoint_now:
             self._save_metadata(t)
+
+    def _chain_streams(self, out):
+        """Every stream reachable from ONE output stream (the failing
+        chain) — fallback surgery must not touch sibling chains that
+        already emitted their batch."""
+        seen, chain, frontier = set(), [], [out]
+        while frontier:
+            s = frontier.pop()
+            if id(s) in seen:
+                continue
+            seen.add(id(s))
+            chain.append(s)
+            frontier.extend(s.parents)
+        return chain
+
+    def _disable_numeric_rewrites(self, t, exc, out):
+        """Fallback on the FIRST _NumericRewriteError from the numeric
+        rewrite: flip the failing chain's _numeric latches to False
+        (the rewrite never re-applies for those streams) and drop the
+        failed batch's derived RDDs so the retry recomputes them
+        generically.  Input streams keep their generated batch — the
+        data must not be consumed twice (queue) or lost (socket).
+        Returns False when the error did not come from the checked op
+        (an unrelated user TypeError must NOT disable working
+        rewrites) or no rewrite was active; the caller re-raises."""
+        if not isinstance(exc, _NumericRewriteError) \
+                and "_NumericRewriteError" not in str(exc):
+            return False                # an unrelated failure
+        chain = self._chain_streams(out)
+        hit = False
+        for s in chain:
+            if getattr(s, "_numeric", None):
+                s._numeric = False
+                hit = True
+                logger.warning(
+                    "%s at t=%s: numeric union-reduce rewrite hit a "
+                    "TypeError (probe saw numbers, batch holds "
+                    "non-numbers); falling back to the generic path "
+                    "permanently", type(s).__name__, t)
+        if not hit:
+            return False
+        for s in chain:
+            if not isinstance(s, InputDStream):
+                s.generated.pop(t, None)
+        return True
 
     def awaitTermination(self, timeout=None):
         if self._thread:
@@ -372,6 +442,20 @@ class DStream:
 
     def reduceByKeyAndWindow(self, func, windowDuration, slideDuration=None,
                              numSplits=None, invFunc=None):
+        """Windowed per-key reduce; with invFunc the window updates
+        incrementally (prev - leaving + entering).
+
+        PROBE CONTRACT: when (func, invFunc) prove to be plain (+, -),
+        the incremental update is rewritten to one union-reduce per
+        tick — but only after a one-time probe of up to 5 records from
+        the first non-empty partition shows plain numeric values
+        (numbers form a group under (+, -); e.g. collections.Counter
+        supports both operators but is NOT invertible).  The rewrite
+        then re-verifies numeric-ness on every folded pair: the first
+        non-numeric value raises TypeError inside the batch, the
+        rewrite is permanently disabled for this stream, and the batch
+        regenerates through the generic leftOuterJoin+invFunc path —
+        the probe accelerates, it never decides correctness."""
         if invFunc is None:
             w = self.window(windowDuration, slideDuration)
             return TransformedDStream(
@@ -381,7 +465,19 @@ class DStream:
 
     # -- state -----------------------------------------------------------
     def updateStateByKey(self, updateFunc, numSplits=None):
-        """updateFunc(new_values_list, prev_state_or_None) -> state|None"""
+        """updateFunc(new_values_list, prev_state_or_None) -> state|None
+
+        PROBE CONTRACT: an updateFunc that provably is the running-sum
+        idiom ``(prev or 0) + sum(vs)`` (or carries a
+        __dpark_state_monoid__ hint) is rewritten to a flat
+        union-reduce per batch — but only after a one-time probe of up
+        to 5 records from the first non-empty partition shows plain
+        numeric values (pairwise a+b == sum()-from-0 for numbers
+        only).  The rewrite then re-verifies numeric-ness on every
+        folded pair: the first non-numeric value raises TypeError
+        inside the batch, the rewrite is permanently disabled for this
+        stream, and the batch regenerates through the generic cogroup
+        path — the probe accelerates, it never decides correctness."""
         return StateDStream(self, updateFunc, numSplits)
 
     # -- outputs ---------------------------------------------------------
@@ -621,6 +717,12 @@ class ReducedWindowedDStream(DerivedDStream):
         # below (_numeric) before it applies.
         self._linear_ops = _is_plain_add(func) and _is_plain_sub(invFunc)
         self._numeric = None            # undecided until data shows up
+        # ONE checked-op instance for the stream's lifetime: the tpu
+        # backend keys compiled programs by merge-callable identity, so
+        # a fresh wrapper per batch would defeat the program cache
+        # (and leak one compiled entry per tick — review finding)
+        self._checked_op = (_CheckedNumericOp(func, "add")
+                            if self._linear_ops else None)
 
     @property
     def slide_duration(self):
@@ -692,8 +794,10 @@ class ReducedWindowedDStream(DerivedDStream):
                         + [r.mapValue(_neg_value) for r in leaving])
             out = branches[0]
             if len(branches) > 1:
+                # checked op: a non-numeric tail raises TypeError and
+                # run_batch falls back to the join+invFunc path
                 out = out.union(*branches[1:]) \
-                         .reduceByKey(self.func, self.numSplits)
+                         .reduceByKey(self._checked_op, self.numSplits)
             return out.cache()
         out = prev
         for r in leaving:
@@ -752,6 +856,64 @@ def _neg_value(v):
     return -v
 
 
+def _arraylike(x):
+    """NUMERIC array-likes only: jax tracers during the merge-fn trace,
+    numpy numeric scalars/arrays on ingested columns.  dtype.kind is
+    checked so np.str_ (which carries dtype+shape) cannot slip a
+    string concatenation past the numeric rewrite."""
+    dt = getattr(x, "dtype", None)
+    # sentinel default: a dtype WITHOUT .kind must default-deny ("" is
+    # a substring of every string — review finding)
+    return (dt is not None and hasattr(x, "shape")
+            and getattr(dt, "kind", "?") in "biufc")
+
+
+class _NumericRewriteError(TypeError):
+    """Raised by _CheckedNumericOp when a rewritten union-reduce folds
+    a non-numeric pair.  A DEDICATED type (with a distinctive name that
+    survives traceback stringification across task retries) so
+    run_batch never attributes an unrelated user TypeError to the
+    rewrite and never disables healthy rewrites for it."""
+
+
+class _CheckedNumericOp:
+    """The binary op a numeric union-reduce rewrite folds with,
+    re-verifying PER PAIR what the 5-record probe asserted: both
+    operands are plain numbers.  A mixed batch (numeric head,
+    non-numeric tail) raises TypeError instead of silently
+    concatenating/diverging; StreamingContext.run_batch catches it,
+    latches the stream's _numeric off, and regenerates the batch
+    through the generic path.
+
+    Carries the __dpark_monoid__ hint so the tpu master still
+    classifies the merge: the device path only ever runs over ingested
+    NUMERIC columns (non-numeric rows can't ingest and fall back to
+    the host object path, where this check executes), so the hint is
+    sound."""
+
+    __slots__ = ("op", "__dpark_monoid__")
+
+    _HINTS = {"add": "add", "min": "min", "max": "max", "mul": "mul"}
+
+    def __init__(self, op, hint=None):
+        self.op = op
+        if hint in self._HINTS:
+            self.__dpark_monoid__ = hint
+
+    def __call__(self, a, b):
+        # array-likes (jax tracers during the merge-fn trace, numpy
+        # scalars/arrays on ingested columns) are numeric by
+        # construction — the check targets arbitrary Python objects on
+        # the host object path (str concatenation was the r5 finding)
+        if (isinstance(a, numbers.Number) or _arraylike(a)) \
+                and (isinstance(b, numbers.Number) or _arraylike(b)):
+            return self.op(a, b)
+        raise _NumericRewriteError(
+            "numeric union-reduce rewrite saw a non-numeric pair "
+            "(%s, %s): the probe-based rewrite does not apply to "
+            "this stream" % (type(a).__name__, type(b).__name__))
+
+
 def _probe_values(rdd, k=5):
     """Up to k records from the first non-empty partition.  Every scan
     is a parts==1 job — the array path skips single-task jobs by
@@ -808,9 +970,30 @@ class StateDStream(DerivedDStream):
         self.must_checkpoint = True
         self._monoid_op = _classify_state_update(updateFunc)
         self._numeric = None            # undecided until data shows up
+        # one instance for the stream's lifetime — stable identity
+        # keeps the tpu backend's compiled-program cache warm across
+        # batches (review finding)
+        self._checked_op = None
+        if self._monoid_op is not None:
+            # hint name from the SHARED classifier (utils/monoid) — no
+            # fourth copy of the op->name table (review finding)
+            from dpark_tpu.utils.monoid import classify_merge
+            self._checked_op = _CheckedNumericOp(
+                self._monoid_op,
+                getattr(updateFunc, "__dpark_state_monoid__", None)
+                or classify_merge(self._monoid_op))
 
     def compute(self, t):
         prev = self.generated.get(round(t - self.slide_duration, 6))
+        if prev is None:
+            # a failed/dropped batch leaves a hole in `generated`; carry
+            # the most recent state forward instead of silently
+            # resetting to empty (the hole batch's data is lost either
+            # way, the accumulated state must not be)
+            earlier = [ts for ts, rdd in self.generated.items()
+                       if ts < t - 1e-9 and rdd is not None]
+            if earlier:
+                prev = self.generated[max(earlier)]
         batch = self.parent.getOrCompute(t)
         ctx = self.ssc.ctx
         if self._monoid_op is not None and self._numeric is None \
@@ -829,17 +1012,19 @@ class StateDStream(DerivedDStream):
             # monoid state: state' = prev U reduce(batch), one flat
             # union-reduce per batch — every stage rides the array path
             # in steady state (HBM-resident prev shuffle + new batch),
-            # exactly like the (add, sub) window rewrite above
+            # exactly like the (add, sub) window rewrite above.  The
+            # checked op re-verifies numeric-ness PER PAIR: a batch
+            # that defeats the probe (numeric head, string tail) raises
+            # TypeError and run_batch falls back to the generic path
             if batch is None and prev is not None:
                 return prev              # state unchanged this tick
             if batch is not None:
-                reduced = batch.reduceByKey(self._monoid_op,
-                                            self.numSplits)
+                op = self._checked_op
+                reduced = batch.reduceByKey(op, self.numSplits)
                 if prev is None:
                     return reduced.cache()
                 return prev.union(reduced) \
-                    .reduceByKey(self._monoid_op,
-                                 self.numSplits).cache()
+                    .reduceByKey(op, self.numSplits).cache()
         if batch is None:
             batch = ctx.parallelize([], 1)
         if prev is None:

@@ -292,7 +292,16 @@ class RDD:
         the sum rewrites start from ``0 + v`` exactly like sum()'s
         accumulator, so non-numeric values raise on every master the
         way they always did.  conf.GROUP_AGG_REWRITE=0 disables (the
-        device SegAggOp path then serves these chains)."""
+        device SegAggOp path then serves these chains).
+
+        FLOAT CAVEAT: the rewrite REASSOCIATES the fold.  sum/mean over
+        float values pre-combine map-side and merge per partition, so
+        the result's low-order bits depend on partitioning and combine
+        order on EVERY master (including the local golden model) —
+        where the un-rewritten chain summed each group's list in row
+        order.  Integer aggregates and min/max are exact either way;
+        float-exact reproduction of the reference's list-order sum
+        needs GROUP_AGG_REWRITE=0."""
         from dpark_tpu import conf
         if not conf.GROUP_AGG_REWRITE:
             return None
@@ -2144,9 +2153,16 @@ class CheckpointRDD(RDD):
     def compute(self, split):
         # a lazy checkpoint may promote MID-JOB: tasks planned before
         # the promotion still carry the original RDD's splits — map
-        # them by index (same partition layout by construction)
-        path = getattr(split, "path", None)
-        if path is None:
+        # them by index (same partition layout by construction).
+        # Decide by TYPE, not by attribute: any foreign split class may
+        # carry a .path (TextSplit, BinarySplit, a CheckpointSplit of a
+        # DIFFERENT directory) and duck-typing it here made compute
+        # unpickle the source text file after promotion (r5 advisor
+        # finding — all retries failed with UnpicklingError)
+        if isinstance(split, CheckpointSplit) \
+                and os.path.dirname(split.path) == self.path:
+            path = split.path
+        else:
             path = os.path.join(self.path, self.files[split.index])
         with open(path, "rb") as f:
             return iter(pickle.load(f))

@@ -259,7 +259,13 @@ class DAGScheduler:
         self._next_job_id += 1
         record = {"id": self._next_job_id, "scope": final_rdd.scope_name,
                   "parts": parts, "finished": 0, "stages": stages,
-                  "seconds": 0.0, "state": "running", "stage_info": []}
+                  "seconds": 0.0, "state": "running", "stage_info": [],
+                  # pre-flight lint findings (context.runJob stashes
+                  # them on the final rdd) ride the job record so the
+                  # web UI shows WHY a plan is suspect next to its
+                  # per-stage timings
+                  "lint": list(getattr(final_rdd, "_lint_findings",
+                                       ()) or ())}
         self.history.append(record)
         del self.history[:-100]
         self._current_record = record

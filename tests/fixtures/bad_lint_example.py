@@ -1,0 +1,31 @@
+"""Purpose-built BAD example for the lint tests: every construct below
+is an anti-pattern the analysis subsystem must catch.  dlint parses
+this file (never executes it); tests/test_analysis.py also builds the
+same plan shapes live and asserts the plan rules fire."""
+
+import random
+
+from dpark_tpu import DparkContext
+
+ctx = DparkContext("local")
+lookup = ctx.parallelize([(i, i * i) for i in range(10)], 2)
+pairs = ctx.parallelize([(i % 5, (i, i * 2)) for i in range(100)], 4)
+
+# monoid-multileaf: tuple values reduced with a bare max — the host
+# compares tuples lexicographically, a per-leaf device monoid would mix
+# leaves from different records (the round-5 silent-wrong-answer shape)
+worst = pairs.reduceByKey(lambda a, b: max(a, b))
+
+# closure-rdd-capture: the worker function reaches back into an RDD
+tagged = worst.map(lambda kv: (kv[0], lookup.count()))
+
+# closure-unseeded-random: retries/speculation see different data
+noisy = tagged.map(lambda kv: (kv[0], random.random()))
+
+
+def main():
+    print(noisy.collect())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,238 @@
+"""Pre-flight plan linter + AST closure analyzer (dpark_tpu/analysis/).
+
+Plan rules run over live lineage graphs; closure rules run both over
+live callables (pre-flight) and over source files (the dlint CLI).
+The local master is sufficient for every plan-shape assertion — the
+rules are graph-structural and never execute device code."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dpark_tpu.analysis import (PlanLintError, lint_function, lint_plan,
+                                lint_source, preflight)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BAD_EXAMPLE = os.path.join(REPO, "tests", "fixtures",
+                           "bad_lint_example.py")
+
+
+def rules(report):
+    return {f.rule for f in report}
+
+
+# ---------------------------------------------------------------------------
+# plan rules
+# ---------------------------------------------------------------------------
+
+def test_monoid_multileaf_fires_on_tuple_values(ctx):
+    r = ctx.parallelize([(1, (2, 3)), (1, (5, 1)), (2, (7, 8))], 2) \
+           .reduceByKey(lambda a, b: max(a, b))
+    rep = lint_plan(r)
+    assert "monoid-multileaf" in rules(rep)
+    [f] = [f for f in rep if f.rule == "monoid-multileaf"]
+    assert f.severity == "error"
+
+
+def test_monoid_multileaf_quiet_on_scalar_values(ctx):
+    r = ctx.parallelize([(1, 2), (2, 3)], 2) \
+           .reduceByKey(lambda a, b: max(a, b))
+    assert "monoid-multileaf" not in rules(lint_plan(r))
+
+
+def test_monoid_multileaf_quiet_on_unclassified_merge(ctx):
+    # a per-field merge is the CORRECT spelling — must not be flagged
+    r = ctx.parallelize([(1, (2, 3)), (2, (7, 8))], 2) \
+           .reduceByKey(lambda a, b: (max(a[0], b[0]), max(a[1], b[1])))
+    assert "monoid-multileaf" not in rules(lint_plan(r))
+
+
+def test_error_mode_refuses_plan_before_launch(ctx, monkeypatch):
+    monkeypatch.setenv("DPARK_LINT", "error")
+    r = ctx.parallelize([(1, (2, 3)), (1, (5, 1)), (2, (7, 8))], 2) \
+           .reduceByKey(lambda a, b: max(a, b))
+    with pytest.raises(PlanLintError) as ei:
+        r.collect()
+    assert "monoid-multileaf" in str(ei.value)
+    # warn mode lets the same plan run (the executor guard makes the
+    # result correct via the raw-combiner exchange)
+    monkeypatch.setenv("DPARK_LINT", "warn")
+    assert sorted(r.collect()) == [(1, (5, 1)), (2, (7, 8))]
+
+
+def test_join_repartition_rule(ctx):
+    a = ctx.parallelize([(i, i) for i in range(10)], 2).partitionBy(3)
+    b = ctx.parallelize([(i, -i) for i in range(10)], 2).partitionBy(3)
+    assert "plan-join-repartition" in rules(lint_plan(a.join(b, 5)))
+    # matching split counts keep the join narrow — no finding
+    assert "plan-join-repartition" not in rules(lint_plan(a.join(b, 3)))
+
+
+def test_uncached_reshuffle_rule(ctx):
+    base = ctx.parallelize([(i % 3, i) for i in range(30)], 2) \
+              .map(lambda kv: (kv[0], kv[1] + 1))
+    fan = base.reduceByKey(lambda a, b: a + b, 2) \
+              .union(base.groupByKey(2).mapValue(len))
+    assert "plan-uncached-reshuffle" in rules(lint_plan(fan))
+    base.cache()
+    assert "plan-uncached-reshuffle" not in rules(lint_plan(fan))
+    base.unpersist()
+
+
+def test_wide_depth_rule(ctx, monkeypatch):
+    from dpark_tpu import conf
+    monkeypatch.setattr(conf, "LINT_WIDE_DEPTH", 2)
+    r = ctx.parallelize([(i % 3, i) for i in range(10)], 2)
+    for _ in range(3):
+        r = r.reduceByKey(lambda a, b: a + b, 2)
+    assert "plan-wide-depth" in rules(lint_plan(r))
+    # a checkpoint pin on the path resets the count
+    r2 = ctx.parallelize([(i % 3, i) for i in range(10)], 2)
+    for i in range(3):
+        r2 = r2.reduceByKey(lambda a, b: a + b, 2)
+        if i == 1:
+            r2._checkpoint_path = "/tmp/_fake_ck"     # pin marker only
+    assert "plan-wide-depth" not in rules(lint_plan(r2))
+
+
+def test_group_agg_rule_fires_when_rewrite_pinned_out(ctx):
+    grouped = ctx.parallelize([(i % 3, i) for i in range(30)], 2) \
+                 .groupByKey(2).cache()        # cache pin blocks rewrite
+    m = grouped.mapValue(sum)
+    from dpark_tpu import rdd as _rdd
+    assert isinstance(m, _rdd.MappedValuesRDD)   # rewrite really declined
+    assert "plan-group-agg" in rules(lint_plan(m))
+    grouped.unpersist()
+
+
+# ---------------------------------------------------------------------------
+# closure rules (live callables)
+# ---------------------------------------------------------------------------
+
+def test_closure_rdd_capture_live(ctx):
+    other = ctx.parallelize([1, 2, 3], 2)
+
+    def bad(x):
+        return (x, other.count())
+
+    rep = lint_function(bad)
+    assert "closure-rdd-capture" in rules(rep)
+    [f] = [f for f in rep if f.rule == "closure-rdd-capture"]
+    assert f.severity == "error"
+
+
+def test_closure_context_capture_live(ctx):
+    def bad(x):
+        return ctx.parallelize([x]).count()
+
+    assert "closure-rdd-capture" in rules(lint_function(bad))
+
+
+def test_closure_clean_function_has_no_findings():
+    def good(kv, m=7):
+        return (kv[0] % m, kv[1])
+
+    assert len(lint_function(good)) == 0
+
+
+def test_preflight_warn_mode_never_blocks(ctx, monkeypatch):
+    monkeypatch.setenv("DPARK_LINT", "warn")
+    other = ctx.parallelize([1, 2, 3], 2)
+    # the closure CAPTURES an rdd (error-severity finding) but warn
+    # mode only logs: the job must still run on the local master
+    r = ctx.parallelize([1, 2], 2).map(lambda x: (other, x + 3)[1])
+    assert sorted(r.collect()) == [4, 5]
+
+
+def test_preflight_off_mode_skips_all_work(ctx, monkeypatch):
+    monkeypatch.setenv("DPARK_LINT", "off")
+    r = ctx.parallelize([(1, (2, 3))], 1).reduceByKey(
+        lambda a, b: max(a, b))
+    assert preflight(r) is None
+
+
+# ---------------------------------------------------------------------------
+# closure rules (source-file mode) + the bad example
+# ---------------------------------------------------------------------------
+
+def test_bad_example_file_triggers_closure_rules():
+    rep = lint_source(BAD_EXAMPLE)
+    got = rules(rep)
+    assert "closure-rdd-capture" in got
+    assert "closure-unseeded-random" in got
+
+
+def test_bad_example_plan_triggers_plan_rule(ctx):
+    # the same plan shape the fixture writes down, built live: the
+    # multi-leaf monoid reduce draws the plan-rule finding
+    pairs = ctx.parallelize([(i % 5, (i, i * 2)) for i in range(100)], 4)
+    worst = pairs.reduceByKey(lambda a, b: max(a, b))
+    assert "monoid-multileaf" in rules(lint_plan(worst))
+
+
+def test_source_mode_tracks_rdd_names():
+    src = """
+from dpark_tpu import DparkContext
+ctx = DparkContext("local")
+lookup = ctx.parallelize([(1, 2)], 2)
+data = ctx.parallelize(range(10), 2)
+out = data.map(lambda x: (x, lookup.count()))
+safe = data.map(lambda x, lk=None: (x, lk))
+"""
+    rep = lint_source("inline.py", text=src)
+    caps = [f for f in rep if f.rule == "closure-rdd-capture"]
+    assert len(caps) == 1            # only the real capture
+
+
+def test_source_mode_tracer_rules_escalate_for_tpu():
+    src = """
+from dpark_tpu import DparkContext
+ctx = DparkContext("tpu")
+data = ctx.parallelize(range(10), 2)
+branchy = data.map(lambda x: 1 if x > 0 else 0)
+"""
+    host = [f for f in lint_source("inline.py", text=src)
+            if f.rule == "closure-tracer-branch"]
+    tpu = [f for f in lint_source("inline.py", text=src, tpu=True)
+           if f.rule == "closure-tracer-branch"]
+    assert host and host[0].severity == "info"
+    assert tpu and tpu[0].severity == "warn"
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _dlint(*args):
+    env = dict(os.environ, PYTHONPATH=REPO, DPARK_PROGRESS="0")
+    return subprocess.run(
+        [sys.executable, "-m", "dpark_tpu.analysis", *args],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+
+
+def test_cli_wordcount_example_is_clean():
+    p = _dlint(os.path.join(REPO, "examples", "wordcount.py"))
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "0 errors" in p.stderr
+
+
+def test_cli_bad_example_fails_with_findings():
+    p = _dlint(BAD_EXAMPLE, "--json")
+    assert p.returncode == 1, p.stdout + p.stderr
+    findings = json.loads(p.stdout)
+    got = {f["rule"] for f in findings}
+    assert "closure-rdd-capture" in got
+    assert "closure-unseeded-random" in got
+
+
+def test_monoid_multileaf_quiet_on_tuple_concat(ctx):
+    # add over tuple values is legitimate per-key concatenation — all
+    # masters agree on the result, so the rule must stay quiet
+    import operator
+    r = ctx.parallelize([(1, (2, 3)), (1, (4, 5))], 2) \
+           .reduceByKey(operator.add)
+    assert "monoid-multileaf" not in rules(lint_plan(r))
+    assert sorted(r.collect()) == [(1, (2, 3, 4, 5))]

@@ -1,0 +1,43 @@
+"""CI gate: the closure linter over dpark_tpu/ and examples/ must stay
+clean against the committed baseline (tools/dlint_baseline.json).
+
+This is the in-suite twin of the CI lint job (.github/workflows): any
+NEW anti-pattern in the package or the shipped examples fails tier-1.
+To accept a deliberate new finding, refresh the baseline with
+`tools/dlint --self --write-baseline` and commit it."""
+
+import os
+
+from dpark_tpu.analysis.__main__ import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_self_lint_is_clean_against_baseline(capsys):
+    rc = main(["--self"])
+    out = capsys.readouterr()
+    assert rc == 0, "new lint findings:\n%s%s" % (out.out, out.err)
+
+
+def test_baseline_file_is_committed_and_sorted():
+    import json
+    path = os.path.join(REPO, "tools", "dlint_baseline.json")
+    assert os.path.exists(path), "tools/dlint_baseline.json missing"
+    with open(path) as f:
+        keys = json.load(f)
+    assert keys == sorted(keys)
+    assert all("::" in k for k in keys)
+
+
+def test_shipped_examples_have_no_errors(capsys):
+    # acceptance: zero ERROR findings across every shipped example
+    # (warnings like pi.py's unseeded random are baselined, not errors)
+    from dpark_tpu.analysis.closure_rules import lint_source
+    from dpark_tpu.analysis.report import Report
+    report = Report()
+    exdir = os.path.join(REPO, "examples")
+    for name in sorted(os.listdir(exdir)):
+        if name.endswith(".py"):
+            lint_source(os.path.join(exdir, name), report=report)
+    errors = [f.render() for f in report.errors()]
+    assert not errors, "\n".join(errors)

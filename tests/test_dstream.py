@@ -501,3 +501,128 @@ def test_stream_join_rides_device():
     assert got == exp
     # steady state (the last batch's job) must be ALL device stages
     assert kinds and {v for _, v in kinds} == {"array"}, kinds
+
+
+def test_state_rewrite_falls_back_on_type_error(ctx):
+    """Satellite regression (r5 advisor, low): a stream whose FIRST
+    batch is numeric locks the union-reduce rewrite in; a later batch
+    with non-numeric values must NOT silently concatenate through the
+    pairwise a+b — the checked op raises TypeError, run_batch disables
+    the rewrite permanently and replays the batch through the generic
+    updateFunc path (which faithfully reproduces sum()'s TypeError for
+    strings, exactly like the reference)."""
+    ssc = make_ssc(ctx)
+    out = []
+    batches = [
+        [("a", 1), ("a", 2), ("b", 3)],       # numeric: probe locks in
+        [("a", 1), ("a", "x"), ("b", 2)],     # poisoned tail
+        [("a", 5), ("b", 1)],                  # numeric again
+    ]
+    q = ssc.queueStream(batches)
+
+    def update(vs, prev):
+        return (prev or 0) + sum(vs)
+
+    state = q.updateStateByKey(update)
+    state.collect_batches(out)
+    ssc.ctx.start()
+    for ins in ssc.input_streams:
+        ins.start()
+    ssc.zero_time = 1000.0
+
+    ssc.run_batch(1001.0)
+    assert dict(out[-1][1]) == {"a": 3, "b": 3}
+    assert state._numeric is True             # rewrite engaged
+
+    # poisoned batch: the rewrite falls back, and the generic path
+    # reproduces the reference behavior (sum() raises for int+str)
+    with pytest.raises(Exception) as ei:
+        ssc.run_batch(1002.0)
+    assert "TypeError" in str(ei.value) or isinstance(ei.value,
+                                                      TypeError)
+    assert state._numeric is False            # latched off for good
+
+    # the stream recovers: the next numeric batch runs generically and
+    # the accumulated state survived the dropped batch
+    ssc.run_batch(1003.0)
+    assert dict(out[-1][1]) == {"a": 8, "b": 4}
+
+
+def test_window_rewrite_falls_back_on_type_error(ctx):
+    """Same contract for the (add, sub) incremental window: a stream
+    that defeats the 5-record probe must end up on the generic
+    leftOuterJoin+invFunc path instead of silently diverging."""
+    ssc = make_ssc(ctx, batch=1.0)
+    out = []
+    batches = [[("k", 1)], [("k", 2)], [("k", "x")], [("k", 8)]]
+    q = ssc.queueStream(batches)
+    q.reduceByKeyAndWindow(operator.add, 2.0,
+                           invFunc=operator.sub).collect_batches(out)
+    ssc.ctx.start()
+    for ins in ssc.input_streams:
+        ins.start()
+    ssc.zero_time = 1000.0
+    ssc.run_batch(1001.0)
+    ssc.run_batch(1002.0)
+    assert dict(out[-1][1]) == {"k": 3}
+    streams = [s for s in ssc._all_streams()
+               if type(s).__name__ == "ReducedWindowedDStream"]
+    assert streams and streams[0]._numeric is True
+    # the poisoned batch disables the rewrite; whatever error surfaces
+    # is the generic path's own (str in an (add, sub) window)
+    try:
+        ssc.run_batch(1003.0)
+    except Exception:
+        pass
+    assert streams[0]._numeric is False
+
+
+def test_rewrite_fallback_leaves_sibling_chains_intact(ctx):
+    """Fallback surgery is scoped to the FAILING output chain: an
+    independent healthy state stream must keep its batch-t state (the
+    code-review repro: popping generated[t] globally made the healthy
+    chain silently drop a batch and regress at t+1)."""
+    ssc = make_ssc(ctx)
+    out_a, out_b = [], []
+    qa = ssc.queueStream([[("a", 1)], [("a", 10)], [("a", 100)]])
+    qb = ssc.queueStream([[("b", 1)], [("b", "x")], [("b", 5)]])
+
+    def update(vs, prev):
+        return (prev or 0) + sum(vs)
+
+    sa = qa.updateStateByKey(update)
+    sb = qb.updateStateByKey(update)
+    sa.collect_batches(out_a)
+    sb.collect_batches(out_b)
+    ssc.ctx.start()
+    for ins in ssc.input_streams:
+        ins.start()
+    ssc.zero_time = 1000.0
+
+    ssc.run_batch(1001.0)
+    assert dict(out_a[-1][1]) == {"a": 1}
+    # chain B poisons batch 2; chain A already emitted (or still must
+    # emit) its batch-2 state and MUST NOT lose it
+    try:
+        ssc.run_batch(1002.0)
+    except Exception:
+        pass
+    ssc.run_batch(1003.0)
+    assert dict(out_a[-1][1]) == {"a": 111}   # 1 + 10 + 100, no gap
+    assert sb._numeric is False               # only B latched off
+    assert sa._numeric is not False
+
+
+def test_checked_op_rejects_numpy_strings():
+    """np.str_ carries dtype+shape; the checked op must not let it
+    slip past as an 'array-like' and concatenate (code-review)."""
+    import numpy as np
+    import operator
+    from dpark_tpu.dstream import _CheckedNumericOp, _NumericRewriteError
+    op = _CheckedNumericOp(operator.add, "add")
+    assert op(2, 3) == 5
+    assert op(np.int64(2), 3) == 5
+    with pytest.raises(_NumericRewriteError):
+        op(np.str_("a"), np.str_("b"))
+    with pytest.raises(_NumericRewriteError):
+        op(1, "x")

@@ -277,3 +277,51 @@ def test_forced_ooc_columnar_parity(seed):
         assert outs[0] == outs[1], (seed, red, nsp)
     finally:
         conf.STREAM_CHUNK_ROWS = old
+
+
+def test_tuple_value_reduce_minmax_parity():
+    """Satellite regression (r5 advisor, high): a classified monoid
+    (min/max) over MULTI-LEAF values must not ride the per-leaf device
+    monoid path — the host merges whole records (tuples compare
+    lexicographically) while per-leaf reduction mixes leaves from
+    different records.  _epilogue_merge now degrades such plans to the
+    raw-combiner exchange; results must match the local golden master
+    exactly.  A 2-device mesh keeps the map-side bucketize-combine +
+    exchange machinery engaged without needing the full virtual mesh."""
+    from dpark_tpu import DparkContext
+
+    rng = random.Random(99)
+    data = [(rng.randint(0, 20),
+             (rng.randint(0, 1000), rng.randint(0, 1000)))
+            for _ in range(4000)]
+
+    tctx = DparkContext("tpu:2")
+    lctx = DparkContext("local")
+    try:
+        for fn in (lambda a, b: max(a, b),
+                   lambda a, b: min(a, b)):
+            rt = sorted(tctx.parallelize(data, 2)
+                        .reduceByKey(fn, 2).collect())
+            rl = sorted(lctx.parallelize(data, 2)
+                        .reduceByKey(fn, 2).collect())
+            assert rt == rl, (rt[:3], rl[:3])
+    finally:
+        tctx.stop()
+        lctx.stop()
+
+
+def test_monoid_multileaf_lint_rule_matches_executor_guard():
+    """The monoid-multileaf lint rule is the pre-flight twin of the
+    _epilogue_merge guard: the exact plan shape the guard degrades is
+    the shape the rule flags."""
+    from dpark_tpu import DparkContext
+    from dpark_tpu.analysis import lint_plan
+
+    ctx = DparkContext("local")
+    try:
+        r = ctx.parallelize(
+            [(1, (2, 3)), (1, (5, 1)), (2, (7, 8))], 2) \
+            .reduceByKey(lambda a, b: max(a, b), 2)
+        assert any(f.rule == "monoid-multileaf" for f in lint_plan(r))
+    finally:
+        ctx.stop()

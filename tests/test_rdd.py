@@ -492,3 +492,50 @@ def test_union_does_not_flatten_through_checkpoint(ctx, tmp_path):
     assert isinstance(w.rdds[0], UnionRDD) or len(w.rdds) == 2, \
         [type(r).__name__ for r in w.rdds]
     assert sorted(w.collect()) == [1, 2, 3, 4, 5]
+
+
+def test_checkpoint_textfile_foreign_splits(ctx, tmp_path):
+    """Satellite regression (r5 advisor, high): CheckpointRDD.compute
+    must decide by split TYPE, not by a duck-typed .path — a
+    textFile-derived lineage promotes lazily while downstream
+    DerivedRDDs still hold the parent's TextSplits (which carry a
+    .path into the source text file).  The downstream consumer must
+    read checkpointed parts both before and after promotion."""
+    import os
+    from dpark_tpu.rdd import CheckpointRDD
+
+    src = tmp_path / "input.txt"
+    with open(src, "w") as f:
+        for i in range(40):
+            f.write("row %d\n" % i)
+
+    base = ctx.textFile(str(src), numSplits=4).map(lambda l: l.upper())
+    nsplits = len(base.splits)           # textFile may round up
+    ck = str(tmp_path / "txtck")
+    base.checkpoint(ck)
+
+    down = base.map(lambda l: l + "!")
+    expect = sorted("ROW %d!" % i for i in range(40))
+
+    # job 1: materializes the checkpoint mid-job; downstream planned
+    # against the ORIGINAL TextSplits
+    assert sorted(down.collect()) == expect
+
+    # promotion happened on the driver
+    assert isinstance(base._checkpoint_rdd, CheckpointRDD)
+    parts = sorted(f for f in os.listdir(ck) if f.startswith("part-"))
+    assert len(parts) == nsplits
+
+    # job 2: down's cached splits are still TextSplits — compute maps
+    # them BY INDEX onto part files (duck-typing read the text file
+    # here and died in pickle.load across all retries)
+    assert sorted(down.collect()) == expect
+
+    # a foreign CheckpointSplit (different directory) maps by index too
+    other = ctx.parallelize(range(8), 2).map(lambda x: -x)
+    other.checkpoint(str(tmp_path / "otherck"))
+    other.collect()
+    foreign = other._checkpoint_rdd.splits[0]
+    got = list(base._checkpoint_rdd.compute(foreign))
+    assert got == list(base._checkpoint_rdd.compute(
+        base._checkpoint_rdd.splits[0]))
