@@ -83,6 +83,13 @@ def _pad_stats(ex):
             "pad_kind": "ingest"}
 
 
+def _pipeline_stats(ctx):
+    """The streamed map stage's overlapped-wave pipeline aggregates
+    (scheduler.pipeline_summary), or None off the streamed paths."""
+    summary = getattr(ctx.scheduler, "pipeline_summary", None)
+    return summary() if summary is not None else None
+
+
 def _sort_roofline_gbps():
     """The chip's own single-operand `jnp.sort` throughput (GB/s) at the
     benchmark size — the per-session roofline every headline metric is
@@ -179,6 +186,9 @@ def _ooc_phase():
         "chips": ndev,
     }
     payload.update(_pad_stats(ex))
+    pipe = _pipeline_stats(ctx)
+    if pipe is not None:
+        payload["pipeline"] = pipe
     ctx.stop()
     print("OOC_RESULT %s" % json.dumps(payload), flush=True)
 
@@ -348,9 +358,12 @@ def _sg_phase():
     ndev = ctx.scheduler.executor.ndev
     _sg_run(ctx, data, ndev)                      # warm-up compile
     dt = _sg_run(ctx, data, ndev)
+    out = {"t": dt, "ndev": ndev}
+    pipe = _pipeline_stats(ctx)
+    if pipe is not None:        # only when the input streamed in waves
+        out["pipeline"] = pipe
     ctx.stop()
-    print("SG_RESULT %s" % json.dumps({"t": dt, "ndev": ndev}),
-          flush=True)
+    print("SG_RESULT %s" % json.dumps(out), flush=True)
 
 
 # BASELINE config #4: DStream reduceByKeyAndWindow micro-batches.
@@ -699,6 +712,8 @@ def main():
                 "unit": "Mpairs/s",
                 "vs_baseline": round(t_sg_proc / g["t"], 2),
                 "pairs": SG_PAIRS, "chips": g.get("ndev")}
+        if g.get("pipeline"):
+            gout["pipeline"] = g["pipeline"]
         if emulated:
             gout["emulated_cpu_mesh"] = True
         print(json.dumps(gout))

@@ -119,6 +119,11 @@ def main():
     if ex is not None:
         out["hbm_used_gb"] = round(
             (ex._store_bytes + ex._result_bytes) / (1 << 30), 3)
+    # overlapped wave pipeline: aggregate ingest/compute/exchange/spill
+    # ms + device-idle fraction of the deepest streamed stage
+    pipe = getattr(ctx.scheduler, "pipeline_summary", lambda: None)()
+    if pipe is not None:
+        out["pipeline"] = pipe
     ctx.stop()
     print(json.dumps(out), flush=True)
 

@@ -254,9 +254,19 @@ class TPUScheduler(DAGScheduler):
         real0 = self.executor.exchange_real_rows
         slot0 = self.executor.exchange_slot_rows
         islot0 = self.executor.ingest_slot_rows
-        kind, result = self.executor.run_stage(plan)
+        # live per-wave pipeline updates: a long streamed stage reports
+        # its ingest/compute/exchange/spill ms and device-idle fraction
+        # into stage_info WHILE it runs (web UI), not just at the end
+        self.executor._stage_note = (
+            lambda **kw: self.note_stage(stage.id, **kw))
+        try:
+            kind, result = self.executor.run_stage(plan)
+        finally:
+            self.executor._stage_note = None
         note = {"kind": "array",
                 "run_seconds": round(_time.time() - t0, 3)}
+        if self.executor.last_stream_stats is not None:
+            note["pipeline"] = self.executor.last_stream_stats
         wire = self.executor.exchange_wire_bytes - wire0
         slot_rows = self.executor.exchange_slot_rows - slot0
         ingest_rows = self.executor.ingest_slot_rows - islot0

@@ -93,20 +93,66 @@ def stream_chunk_rows(row_bytes=16):
     """Effective wave size in rows per device: an explicitly assigned
     STREAM_CHUNK_ROWS wins; "auto" sizes the wave to the device's own
     HBM (VERDICT r3 #2: waves must amortize the 66 ms dispatch tunnel
-    RTT — size them to memory, not to a CPU-tuned constant).  Raw wave
-    bytes/device = HBM/16; the wave working set (ingest + bucketized +
-    receive + merge copies, ~6x) then peaks well under half of HBM."""
+    RTT — size them to memory, not to a CPU-tuned constant).
+
+    HBM accounting: without donation, raw wave bytes/device = HBM/16 —
+    the wave working set (ingest + bucketized + receive + merge copies,
+    ~6x) then peaks well under half of HBM.  With DONATE_BUFFERS on,
+    the per-wave programs reuse their dead input buffers in place
+    (ingest -> bucketized, received -> merged), dropping the multiplier
+    by roughly two copies; the budget rises to HBM/12 — but the
+    pipeline also holds up to STREAM_PIPELINE_DEPTH extra ingested
+    waves in flight, which is why the divisor does not drop further."""
     if STREAM_CHUNK_ROWS != "auto":
         return STREAM_CHUNK_ROWS
     limit = _hbm_bytes_limit()
     if not limit:
         return _STREAM_CHUNK_ROWS_FALLBACK
+    divisor = 12 if DONATE_BUFFERS else 16
     return max(_STREAM_CHUNK_ROWS_FALLBACK,
-               limit // (16 * max(1, row_bytes)))
+               limit // (divisor * max(1, row_bytes)))
 
 # text-source stages bigger than this stream in waves of splits instead
 # of materializing the whole encoded dataset (same out-of-core pipeline)
 STREAM_TEXT_BYTES = 1 << 28
+
+# ---------------------------------------------------------------------------
+# overlapped wave pipeline (backend/tpu executor stream loops)
+# ---------------------------------------------------------------------------
+
+# how many waves the host runs AHEAD of the device: depth >= 1
+# double-buffers device ingest (wave k+1 device_puts while wave k
+# computes) and defers each wave's host readback/spill by one wave so
+# D2H transfers ride behind the next wave's compute.  0 disables the
+# overlap entirely (serial waves — the pre-pipeline behavior, useful
+# when bisecting); values above 1 only deepen the host-side
+# tokenize/ingest lookahead, at one extra ingested wave of HBM each.
+STREAM_PIPELINE_DEPTH = int(os.environ.get("DPARK_PIPELINE_DEPTH",
+                                           "1") or 0)
+
+# donate dead input buffers to the per-wave jitted programs (ingest ->
+# narrow/bucketize, received -> merge, batch -> concat): XLA reuses
+# them in place, so a wave holds ONE copy of its working set in HBM
+# instead of two.  Streamed paths only — in-core programs keep their
+# inputs alive (result cache / shuffle store leaves must survive the
+# call).  stream_chunk_rows raises the auto wave budget when this is
+# on (see its HBM-accounting note).  "0" disables (e.g. when bisecting
+# an aliasing bug).
+DONATE_BUFFERS = os.environ.get("DPARK_DONATE_BUFFERS", "1") != "0"
+
+# background spill writer for the spilled-run stream: compress+write of
+# per-partition runs happens on a dedicated thread with a bounded
+# queue, off the wave loop ("0" = write inline, serial).  Writer
+# errors surface on the next enqueue or at end-of-stream flush.
+SPILL_WRITER = os.environ.get("DPARK_SPILL_WRITER", "1") != "0"
+
+# collective tests over the virtual CPU mesh need roughly one host CPU
+# per mesh device: an 8-device all_to_all on a 2-CPU container wedges
+# (XLA:CPU collectives rendezvous across intra-process threads).  The
+# test harness skips mesh-marked tests when os.cpu_count() is below
+# this; DPARK_MESH_TEST_DEVICES=0 forces them to run anyway.
+MESH_TEST_DEVICES = int(os.environ.get("DPARK_MESH_TEST_DEVICES",
+                                       "8") or 0)
 
 # thread-pool width for text-split tokenize/encode (the C++ tokenizer
 # releases the GIL, so splits tokenize truly concurrently; the reference

@@ -288,11 +288,38 @@ class DAGScheduler:
         if record is not None:
             self._stage_info(record, stage_id).update(kw)
 
+    def pipeline_summary(self):
+        """The overlapped-wave-pipeline snapshot of the DEEPEST streamed
+        stage across the job history (most waves), per-wave detail
+        dropped — the aggregate consumers (bench.py, benchmarks/) report:
+        ingest/compute/exchange/spill ms + device-idle fraction.
+        None when no stage streamed."""
+        best = None
+        for rec in self.history:
+            for st in rec.get("stage_info", ()):
+                p = st.get("pipeline")
+                if p and (best is None
+                          or p.get("waves", 0) > best.get("waves", 0)):
+                    best = p
+        if best is None:
+            return None
+        return {k: v for k, v in best.items()
+                if not k.startswith("per_wave")}
+
     def _finish_stage_info(self, record, stage_id):
         import time as _time
         info = self._stage_info(record, stage_id)
         if info.get("started") and info.get("seconds") is None:
             info["seconds"] = round(_time.time() - info["started"], 3)
+        # streamed stages report per-wave pipeline timings live; once
+        # the stage is done, keep only the tail so a thousand-wave run
+        # doesn't bloat the job history (/api/jobs ships it as JSON)
+        pipe = info.get("pipeline")
+        if isinstance(pipe, dict):
+            per_wave = pipe.get("per_wave")
+            if per_wave and len(per_wave) > 16:
+                pipe["per_wave"] = per_wave[-16:]
+                pipe["per_wave_truncated"] = True
 
     def max_concurrency(self):
         """How many tasks can execute at once (None = unbounded/inline).

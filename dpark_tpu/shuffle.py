@@ -15,8 +15,9 @@ over ICI (backend/tpu/).
 import heapq
 import os
 import pickle
+import struct
 import threading
-from queue import Queue
+from queue import Full, Queue
 
 from dpark_tpu import conf
 from dpark_tpu.utils import atomic_file, compress, decompress
@@ -133,8 +134,10 @@ def read_bucket_any(uris, shuffle_id, map_id, reduce_id):
         return items
     if isinstance(last_err, FetchFailed):
         raise last_err
-    raise FetchFailed(ordered[0] if ordered else None, shuffle_id,
+    err = FetchFailed(ordered[0] if ordered else None, shuffle_id,
                       map_id, reduce_id)
+    err.__cause__ = last_err        # the real I/O error, not a blank tuple
+    raise err
 
 
 class SimpleShuffleFetcher:
@@ -157,7 +160,14 @@ class SimpleShuffleFetcher:
 
 class ParallelShuffleFetcher(SimpleShuffleFetcher):
     """Thread-pool fetch (reference: ParallelShuffleFetcher).  On a single
-    host file reads are fast; a small pool still overlaps decompression."""
+    host file reads are fast; a small pool still overlaps decompression.
+
+    The results queue is BOUNDED (fetched buckets are merged as they
+    arrive; an unbounded queue would buffer a whole shuffle's worth of
+    unmerged items in RAM whenever merge_func runs slower than the
+    reads), and workers stop as soon as the consumer abandons the fetch
+    (merge_func raised mid-merge) instead of fetching the remaining map
+    outputs into a queue nobody will drain."""
 
     def __init__(self, nthreads=4):
         self.nthreads = nthreads
@@ -167,38 +177,62 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
         locs = env.map_output_tracker.get_outputs(shuffle_id)
         if locs is None:
             raise FetchFailed(None, shuffle_id, -1, reduce_id)
-        results = Queue()
         tasks = Queue()
         for map_id, uri in enumerate(locs):
             if uri is None:
                 raise FetchFailed(uri, shuffle_id, map_id, reduce_id)
             tasks.put((map_id, uri))
         nthreads = min(self.nthreads, tasks.qsize() or 1)
+        results = Queue(maxsize=2 * nthreads)
+        stop = threading.Event()
+
+        def _put(x):
+            while not stop.is_set():
+                try:
+                    results.put(x, timeout=0.5)
+                    return True
+                except Full:
+                    continue
+            return False
 
         def worker():
-            while True:
+            while not stop.is_set():
                 try:
                     map_id, uri = tasks.get_nowait()
                 except Exception:
                     return
                 try:
-                    results.put((None,
-                                 read_bucket_any(uri, shuffle_id,
-                                                 map_id, reduce_id)))
-                except BaseException:
-                    # never die silently: the fetch loop counts results
-                    results.put((FetchFailed(uri, shuffle_id, map_id,
-                                             reduce_id), None))
+                    items = read_bucket_any(uri, shuffle_id, map_id,
+                                            reduce_id)
+                except BaseException as e:
+                    # never die silently: the fetch loop counts results.
+                    # A synthesized FetchFailed CHAINS the real error —
+                    # "fetch failed" with the actual OSError/KeyError as
+                    # __cause__, not a blank four-field tuple.
+                    if isinstance(e, FetchFailed):
+                        err = e
+                    else:
+                        err = FetchFailed(uri, shuffle_id, map_id,
+                                          reduce_id)
+                        err.__cause__ = e
+                    _put((err, None))
+                    return
+                if not _put((None, items)):
+                    return
 
-        threads = [threading.Thread(target=worker, daemon=True)
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name="dpark-fetch-worker")
                    for _ in range(nthreads)]
         for t in threads:
             t.start()
-        for _ in range(len(locs)):
-            err, items = results.get()
-            if err is not None:
-                raise err
-            merge_func(items)
+        try:
+            for _ in range(len(locs)):
+                err, items = results.get()
+                if err is not None:
+                    raise err
+                merge_func(items)
+        finally:
+            stop.set()          # consumer done or raised: workers drain out
 
 
 class FetchFailed(Exception):
@@ -264,7 +298,13 @@ class SortMerger:
 class DiskSpillMerger(Merger):
     """Memory-bounded merge: when the in-memory dict exceeds max_items the
     sorted contents spill to a run file; final iteration heap-merges the
-    spills with the in-memory remainder (reference: external merger)."""
+    spills with the in-memory remainder (reference: external merger).
+
+    Run files are written as length-prefixed COMPRESSED CHUNKS and read
+    back through chunked streaming readers feeding heapq.merge, so the
+    final merge holds one chunk per run in memory — re-inflating every
+    run at once would hand back the whole dataset the spills existed to
+    keep out of RAM."""
 
     def __init__(self, aggregator, max_items=None, workdir=None):
         super().__init__(aggregator)
@@ -285,18 +325,36 @@ class DiskSpillMerger(Merger):
         path = os.path.join(self.workdir, "run-%d-%d"
                             % (id(self), len(self.spills)))
         items = sorted(self.combined.items(), key=lambda kv: kv[0])
+        chunk = conf.SHUFFLE_CHUNK_RECORDS
         with atomic_file(path) as f:
-            f.write(compress(pickle.dumps(items, -1)))
+            for i in range(0, len(items), chunk):
+                blob = compress(pickle.dumps(items[i:i + chunk], -1))
+                # 8-byte length: one chunk of giant combiners (a hot
+                # key's list) must not overflow a 4 GiB prefix
+                f.write(struct.pack("<Q", len(blob)))
+                f.write(blob)
         self.spills.append(path)
         self.combined = {}
+
+    @staticmethod
+    def _iter_run(path):
+        """Stream one spill run back chunk by chunk (sorted within and
+        across chunks: the run was sorted before chunking)."""
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(8)
+                if not hdr:
+                    return
+                (n,) = struct.unpack("<Q", hdr)
+                for kv in pickle.loads(decompress(f.read(n))):
+                    yield kv
 
     def __iter__(self):
         if not self.spills:
             return iter(self.combined.items())
-        runs = [sorted(self.combined.items(), key=lambda kv: kv[0])]
-        for path in self.spills:
-            with open(path, "rb") as f:
-                runs.append(pickle.loads(decompress(f.read())))
+        runs = [iter(sorted(self.combined.items(),
+                            key=lambda kv: kv[0]))]
+        runs += [self._iter_run(path) for path in self.spills]
         mc = self.merge_combiners
 
         def gen():

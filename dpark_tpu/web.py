@@ -37,7 +37,9 @@ _PAGE = """<!doctype html>
 <h2>stages <small>(click a row for its tasks; DAG per job below)</small></h2>
 <table id="s"><tr><th>job</th><th>stage</th><th>rdd</th>
 <th>parts</th><th>kind</th><th>seconds</th><th>device run s</th>
-<th>HBM bytes</th><th>wire bytes</th><th>pad eff</th></tr></table>
+<th>HBM bytes</th><th>wire bytes</th><th>pad eff</th>
+<th>waves</th><th>idle %</th><th>pipeline ms (in/cmp/xchg/spill)</th>
+</tr></table>
 <div id="dags"></div>
 <h2>profile</h2>
 <pre id="prof">(run with --profile)</pre>
@@ -84,9 +86,17 @@ async function tick() {
     dags.appendChild(d); dags.appendChild(document.createElement('br'));
     for (const st of (j.stage_info || [])) {
       const sr = s.insertRow();
+      // overlapped wave pipeline (streamed stages): waves, device-idle
+      // fraction, and the per-stage ingest/compute/exchange/spill ms —
+      // live while the stream runs; the idle-percent drop IS the overlap
+      const p = st.pipeline || {};
+      const pms = p.waves ? (p.ingest_ms + '/' + p.compute_ms + '/' +
+                             p.exchange_ms + '/' + p.spill_ms) : '';
+      const idle = p.waves ? (100 * p.device_idle_frac).toFixed(1) : '';
       for (const v of [j.id, st.id, st.rdd, st.parts, st.kind,
                        st.seconds, st.run_seconds, st.hbm_bytes,
-                       st.wire_bytes, st.pad_efficiency])
+                       st.wire_bytes, st.pad_efficiency,
+                       p.waves, idle, pms])
         sr.insertCell().textContent = v === undefined ? '' : v;
       sr.className = 'stage ' + (st.seconds === null ? 'run' : 'done');
       const key = j.id + ':' + st.id;
@@ -96,7 +106,7 @@ async function tick() {
       };
       if (open.has(key)) {
         const dr = s.insertRow();
-        const c = dr.insertCell(); c.colSpan = 10;
+        const c = dr.insertCell(); c.colSpan = 13;
         c.className = 'tasks'; c.innerHTML = taskRows(st);
       }
     }

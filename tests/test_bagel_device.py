@@ -7,6 +7,8 @@ import pytest
 
 from dpark_tpu.bagel import _pregel_host, run_pregel
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 @pytest.fixture()
 def tctx():

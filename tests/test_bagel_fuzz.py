@@ -14,6 +14,8 @@ import random
 
 import pytest
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 def _build_program(rng, n):
     """Random but trace-safe object compute: branches only on the

@@ -12,6 +12,8 @@ import pytest
 
 from dpark_tpu.bagel import Bagel, BasicCombiner, Edge, Message, Vertex
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 def _run_both(program_fn, build_fn, max_superstep=80):
     from dpark_tpu import DparkContext

@@ -84,6 +84,7 @@ def tctx():
     c.stop()
 
 
+@pytest.mark.mesh
 def test_saturating_add_correct_on_tpu(tctx):
     """End-to-end: the misclassifiable merge gets the right answer."""
     from dpark_tpu import DparkContext

@@ -248,6 +248,7 @@ def test_stage_info_records(ctx):
         server.shutdown()
 
 
+@pytest.mark.mesh
 def test_stage_info_array_kind():
     """On the tpu master the array path annotates kind/run time."""
     from dpark_tpu import DparkContext

@@ -5,6 +5,8 @@ driver merge run unchanged, so results match the local master."""
 
 import pytest
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 @pytest.fixture()
 def tctx():

@@ -123,6 +123,7 @@ def _device_kinds(c, last_only=False):
     return kinds
 
 
+@pytest.mark.mesh
 def test_stateful_wordcount_rides_device_end_to_end():
     """The running-sum updateStateByKey idiom rewrites to one flat
     union-reduce per batch (VERDICT r4 #5), so on the tpu master every
@@ -366,6 +367,7 @@ def test_recovery_timeline_rebase(ctx, tmp_path):
     assert dict(sink[-1][1]) == {"k": 11}    # state carried across gap
 
 
+@pytest.mark.mesh
 def test_linear_window_rides_device_end_to_end():
     """(add, sub) reduceByKeyAndWindow rewrites the incremental update
     to prev + new - old as ONE flat union-reduce, so on the tpu master
@@ -438,6 +440,7 @@ def _window_fuzz_run(master, seed):
 
 
 @pytest.mark.parametrize("seed", range(5))
+@pytest.mark.mesh
 def test_window_fuzz_parity(seed):
     """Random incremental windows (sizes, empty batches) must match
     the local master exactly — the (add, sub) linear rewrite included."""
@@ -445,6 +448,7 @@ def test_window_fuzz_parity(seed):
                                                              seed)
 
 
+@pytest.mark.mesh
 def test_noninv_window_rides_device():
     """reduceByKeyAndWindow WITHOUT invFunc recomputes each window as a
     union of batch RDDs feeding a reduce — the union-source device
@@ -471,6 +475,7 @@ def test_noninv_window_rides_device():
     assert {v for k, v in kinds} == {"array"}, kinds
 
 
+@pytest.mark.mesh
 def test_stream_join_rides_device():
     """Per-batch stream joins expand on the device join source in
     steady state (both sides' shuffles HBM-resident)."""

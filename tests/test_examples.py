@@ -41,6 +41,7 @@ def test_demo_local():
     assert "text round-trip: 10" in out
 
 
+@pytest.mark.mesh
 def test_wordcount_both_masters(corpus):
     host = run_example("wordcount.py", corpus)
     tpu = run_example("wordcount.py", corpus, "-m", "tpu")
@@ -65,6 +66,7 @@ def test_pagerank():
     assert "total rank: 1.0000" in out
 
 
+@pytest.mark.mesh
 def test_kmeans_tpu():
     out = run_example("kmeans.py", "-m", "tpu", timeout=400)
     assert "iter 7" in out
@@ -75,6 +77,7 @@ def test_streaming():
     assert "('the', 4)" in out
 
 
+@pytest.mark.mesh
 def test_logistic_regression_tpu():
     out = run_example("logistic_regression.py", "-m", "tpu", timeout=400)
     assert "consistency with true boundary" in out
@@ -82,6 +85,7 @@ def test_logistic_regression_tpu():
     assert pct > 85.0
 
 
+@pytest.mark.mesh
 def test_sssp_both_masters():
     host = run_example("sssp.py")
     tpu = run_example("sssp.py", "-m", "tpu")

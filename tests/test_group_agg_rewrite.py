@@ -40,6 +40,7 @@ def test_rewrite_matches_group_semantics(ctx, f, host):
     assert got == exp
 
 
+@pytest.mark.mesh
 def test_rewrite_cuts_exchange_rows():
     """On the tpu master the rewritten shuffle ships pre-combined rows:
     far fewer valid rows offered for exchange than the no-combine

@@ -15,6 +15,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 @pytest.fixture()
 def tctx():

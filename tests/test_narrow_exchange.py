@@ -12,6 +12,8 @@ fallback) and the byte win itself.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 @pytest.fixture()
 def tctx():

@@ -14,6 +14,8 @@ import math
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 @pytest.fixture()
 def tctx():

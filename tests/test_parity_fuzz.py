@@ -142,6 +142,7 @@ def canonical(rows):
 
 
 @pytest.mark.parametrize("seed", range(20))
+@pytest.mark.mesh
 def test_random_program_parity(seed):
     from dpark_tpu import DparkContext
     rng = random.Random(seed)
@@ -199,6 +200,7 @@ def _text_chain(ctx, path, prog, splitSize):
 
 
 @pytest.mark.parametrize("seed", range(6))
+@pytest.mark.mesh
 def test_text_chain_parity(seed, tmp_path):
     """Random text-source chains: host-prologue ingest + encode +
     device shuffle == local object path, across split layouts."""
@@ -227,6 +229,7 @@ def test_text_chain_parity(seed, tmp_path):
 
 
 @pytest.mark.parametrize("seed", range(4))
+@pytest.mark.mesh
 def test_forced_ooc_columnar_parity(seed):
     """Tiny forced wave sizes push random columnar programs through the
     streamed OOC shuffle paths — in-core results and streamed results

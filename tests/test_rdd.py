@@ -460,6 +460,7 @@ def test_snapshot_materializes_and_rereads(ctx, tmp_path):
     assert len(calls) == 2 * ncalls
 
 
+@pytest.mark.mesh
 def test_snapshot_on_tpu_master(tmp_path):
     """The tpu master honors snapshot semantics (object path for the
     snapshotted stage) with identical results."""

@@ -6,6 +6,8 @@ with the local master (the golden model, SURVEY.md section 4)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 @pytest.fixture(autouse=True)
 def _no_rewrite():

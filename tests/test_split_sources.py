@@ -9,6 +9,7 @@ import gzip
 import io
 
 import numpy as np
+import pytest
 
 
 def _write_multi_member_gz(path, nmembers, lines_per):
@@ -216,6 +217,7 @@ def test_csv_bare_quote_in_unquoted_field(ctx, tmp_path):
     assert r.collect() == expect
 
 
+@pytest.mark.mesh
 def test_csvfile_rides_device_text_path(tmp_path):
     """csvFile chains reach the device text-ingest path on the tpu
     master."""

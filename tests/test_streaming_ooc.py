@@ -10,6 +10,8 @@ import pytest
 
 from dpark_tpu import Columns, conf
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 @pytest.fixture()
 def tctx():

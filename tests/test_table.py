@@ -169,6 +169,7 @@ def test_sql_order_by_variants(ctx, sales):
     assert got == []
 
 
+@pytest.mark.mesh
 def test_sql_group_by_rides_device_shuffle():
     """VERDICT r3 #8: ctx.sql GROUP BY sum/count/avg/min/max compiles
     onto the monoid device shuffle (shuffle_store populated, wire bytes
@@ -200,6 +201,7 @@ def test_sql_group_by_rides_device_shuffle():
         tctx.stop()
 
 
+@pytest.mark.mesh
 def test_table_join_rides_device():
     """Numeric table equi-joins inherit the array-path join source:
     every stage of select-over-join runs on the device (VERDICT r3 #8
@@ -263,6 +265,7 @@ def test_sql_join_having_agg_exprs(ctx, sales):
                 sales=sales)
 
 
+@pytest.mark.mesh
 def test_sql_join_group_rides_device():
     """SQL JOIN -> GROUP BY -> HAVING runs its join and aggregation on
     the array path (the join lowers to the device join source)."""

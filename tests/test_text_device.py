@@ -9,6 +9,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 @pytest.fixture()
 def tctx():

@@ -4,6 +4,8 @@ the 8-virtual-CPU-device mesh from conftest."""
 
 import pytest
 
+pytestmark = pytest.mark.mesh    # full-mesh collectives (see conftest)
+
 
 @pytest.fixture()
 def tctx():
@@ -507,6 +509,7 @@ os.environ["DPARK_TPU_PLATFORM"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
 import numpy as np
 from dpark_tpu import DparkContext, Columns, conf
+
 ctx = DparkContext("tpu"); ctx.start()
 ex = ctx.scheduler.executor
 assert ex.ndev == 1, ex.ndev

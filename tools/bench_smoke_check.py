@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Bench smoke gate (ISSUE 2 satellite): run bench.py at tiny sizes on
+the emulated CPU mesh and assert every emitted JSON line parses AND the
+out-of-core line carries the overlapped-wave-pipeline fields
+(ingest/compute/exchange/spill ms, device-idle fraction).  This is a
+SCHEMA gate, not a performance gate — CI machines are too noisy to
+grade throughput, but a refactor that silently drops the pipeline
+metrics (or breaks the bench's JSON contract) fails here.
+
+Usage: python tools/bench_smoke_check.py
+Env overrides pass straight through to bench.py (BENCH_PAIRS, ...).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+PIPELINE_FIELDS = ("waves", "ingest_ms", "compute_ms", "exchange_ms",
+                   "spill_ms", "device_idle_frac", "pipeline_depth",
+                   "donated")
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    ndev = env.setdefault("BENCH_SMOKE_DEVICES", "2")
+    # tiny sizes + an explicitly requested cpu mesh; the device count
+    # stays small so the smoke runs on 2-CPU runners (8-device
+    # collectives need ~one host CPU per device)
+    env.setdefault("BENCH_PAIRS", "200000")
+    env.setdefault("BENCH_KEYS", "4096")
+    env.setdefault("BENCH_OOC_GB", "0.01")
+    env.setdefault("BENCH_EXTRAS", "0")
+    env.setdefault("BENCH_PROBE_ATTEMPTS", "1")
+    env.setdefault("BENCH_PROBE_TIMEOUT", "120")
+    env.setdefault("BENCH_PLATFORM", "cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%s"
+            % ndev).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, env=env,
+        timeout=int(env.get("BENCH_SMOKE_TIMEOUT", "1500")))
+    sys.stderr.write(proc.stderr[-4000:])
+    print(proc.stdout)
+    if proc.returncode != 0:
+        print("FAIL: bench.py exited %d" % proc.returncode)
+        return 1
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if not lines:
+        print("FAIL: bench.py emitted no JSON lines")
+        return 1
+    parsed = []
+    for ln in lines:
+        try:
+            parsed.append(json.loads(ln))
+        except ValueError as e:
+            print("FAIL: unparseable JSON line %r: %s" % (ln[:120], e))
+            return 1
+    ooc = [p for p in parsed
+           if str(p.get("metric", "")).startswith("ooc_reduceByKey")]
+    if not ooc:
+        print("FAIL: no ooc_reduceByKey line (the streamed path did "
+              "not run)")
+        return 1
+    pipe = ooc[0].get("pipeline")
+    if not isinstance(pipe, dict):
+        print("FAIL: ooc line carries no pipeline dict: %r" % ooc[0])
+        return 1
+    missing = [f for f in PIPELINE_FIELDS if f not in pipe]
+    if missing:
+        print("FAIL: pipeline dict missing %r (got %r)"
+              % (missing, sorted(pipe)))
+        return 1
+    if not pipe["waves"] or pipe["waves"] < 2:
+        print("FAIL: expected a multi-wave stream, got waves=%r"
+              % (pipe["waves"],))
+        return 1
+    print("OK: %d JSON lines, ooc pipeline fields present "
+          "(waves=%d idle=%.3f depth=%d donated=%s)"
+          % (len(parsed), pipe["waves"], pipe["device_idle_frac"],
+             pipe["pipeline_depth"], pipe["donated"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
