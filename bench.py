@@ -189,8 +189,71 @@ def _ooc_phase():
     pipe = _pipeline_stats(ctx)
     if pipe is not None:
         payload["pipeline"] = pipe
+    # per-phase table + fallback reasons: the bench-smoke schema gate
+    # (tools/bench_smoke_check.py) asserts both fields are present
+    phases = getattr(ctx.scheduler, "phase_table", lambda: None)()
+    if phases is not None:
+        payload["phases"] = phases
+    payload["fallback_reasons"] = getattr(
+        ctx.scheduler, "fallback_reasons", lambda: [])()
     ctx.stop()
     print("OOC_RESULT %s" % json.dumps(payload), flush=True)
+
+
+def _tuple_phase():
+    """Child-process entry: composite-key A/B (ISSUE 3 acceptance) —
+    the SAME reduceByKey workload keyed by one int column vs by a
+    2-int-tuple key, both on the tpu master.  Before tuple keys rode
+    the device, the B side silently ran the object path (orders of
+    magnitude slower); the ratio is the regression gate."""
+    import numpy as np
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import Columns, DparkContext
+    n = min(N_PAIRS, int(os.environ.get("BENCH_TUPLE_PAIRS",
+                                        N_PAIRS)))
+    i = np.arange(n, dtype=np.int64)
+    k = (i * 2654435761) % N_KEYS
+    data = Columns(k, i & 0xFFFF)
+    ctx = DparkContext("tpu")
+    ctx.start()
+    ndev = ctx.scheduler.executor.ndev
+
+    def scalar_run():
+        # the map mirrors the tuple side's key-split op, so the A/B
+        # isolates KEY WIDTH (one extra sort/exchange column), not an
+        # extra fused map
+        t0 = time.perf_counter()
+        cnt = (ctx.parallelize(data, ndev)
+               .map(lambda kv: (kv[0] // 64 * 64 + kv[0] % 64, kv[1]))
+               .reduceByKey(lambda a, b: a + b, ndev).count())
+        assert cnt == min(N_KEYS, n), cnt
+        return time.perf_counter() - t0
+
+    def tuple_run():
+        # same rows, key split into a 2-int tuple (k // 64, k % 64) —
+        # same distinct-key count, same combine volume
+        t0 = time.perf_counter()
+        cnt = (ctx.parallelize(data, ndev)
+               .map(lambda kv: ((kv[0] // 64, kv[0] % 64), kv[1]))
+               .reduceByKey(lambda a, b: a + b, ndev).count())
+        assert cnt == min(N_KEYS, n), cnt
+        return time.perf_counter() - t0
+
+    scalar_run(); tuple_run()            # warm-up compiles
+    t_scalar = min(scalar_run() for _ in range(2))
+    t_tuple = min(tuple_run() for _ in range(2))
+    # the tuple job must have ridden the array path, or the ratio is
+    # measuring the very fallback this PR removes
+    kinds = set()
+    for rec in ctx.scheduler.history:
+        for st in rec.get("stage_info", ()):
+            kinds.add(st.get("kind"))
+    ctx.stop()
+    print("TUPLE_RESULT %s" % json.dumps(
+        {"t_scalar": t_scalar, "t_tuple": t_tuple, "ndev": ndev,
+         "pairs": n, "array_path": "array" in kinds}), flush=True)
 
 
 # BASELINE config #2: join/cogroup of two keyed RDDs (TPC-H
@@ -529,6 +592,9 @@ def main():
     if "--join-only" in sys.argv:
         _join_phase()
         return
+    if "--tuple-only" in sys.argv:
+        _tuple_phase()
+        return
     if "--stream-only" in sys.argv:
         _stream_phase()
         return
@@ -654,6 +720,25 @@ def main():
             if emulated:
                 ooc["emulated_cpu_mesh"] = True
             print(json.dumps(ooc))
+    # composite-key A/B (ISSUE 3 acceptance): tuple-key reduceByKey
+    # wall vs the equivalent scalar-key job — must be within 1.3x now
+    # that tuple keys ride the device (the object path was 10x+ off)
+    if os.environ.get("BENCH_TUPLE", "1") != "0":
+        got = _run_child("--tuple-only", child_timeout,
+                         env=extra_env, ok_prefix="TUPLE_RESULT ")
+        if got is not None:
+            tp = json.loads(got)
+            tout = {"metric": _suffix("tuple_key_reduce_vs_scalar"),
+                    "value": round(tp["t_tuple"]
+                                   / max(tp["t_scalar"], 1e-9), 3),
+                    "unit": "x (lower is better; <=1.3 passes)",
+                    "t_scalar_s": round(tp["t_scalar"], 3),
+                    "t_tuple_s": round(tp["t_tuple"], 3),
+                    "pairs": tp["pairs"], "chips": tp["ndev"],
+                    "tuple_rode_array_path": tp["array_path"]}
+            if emulated:
+                tout["emulated_cpu_mesh"] = True
+            print(json.dumps(tout))
     if not extras:
         return
     # third line: join/cogroup, BASELINE config #2

@@ -124,6 +124,14 @@ def main():
     pipe = getattr(ctx.scheduler, "pipeline_summary", lambda: None)()
     if pipe is not None:
         out["pipeline"] = pipe
+    # per-phase wall-time table (ingest/tokenize, narrow, exchange,
+    # spill, export) + every recorded why-the-array-path-was-left
+    # reason: the bench-smoke CI job gates both schema fields
+    phases = getattr(ctx.scheduler, "phase_table", lambda: None)()
+    if phases is not None:
+        out["phases"] = phases
+    out["fallback_reasons"] = getattr(
+        ctx.scheduler, "fallback_reasons", lambda: [])()
     ctx.stop()
     print(json.dumps(out), flush=True)
 

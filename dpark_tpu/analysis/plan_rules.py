@@ -364,6 +364,96 @@ def _rule_monoid_multileaf(r, report):
         "scalar value per record" % kind)
 
 
+def _key_fallback_reason(key, hash_keys=True):
+    """Why this record KEY keeps a shuffle off the array path, or None
+    when the key shape classifies (scalar numeric, or a flat numeric
+    tuple of 2..conf.MAX_KEY_LEAVES leaves — the composite keys the
+    device path now carries end to end).  Mirrors layout.key_width /
+    fuse's epilogue checks without importing jax: `hash_keys` is True
+    for hash-partitioned shuffles, whose device routing additionally
+    needs INT leaves (portable_hash has no device twin for floats);
+    range repartitioning (sortByKey) accepts floats."""
+    from dpark_tpu import conf
+    ints = (int,)
+    floats = (float,)
+    try:
+        import numpy as _np
+        ints = (int, _np.integer)
+        floats = (float, _np.floating)
+    except ImportError:
+        pass
+
+    def leaf_reason(item):
+        if isinstance(item, bool):
+            return "bool key (no device hash semantics)"
+        if isinstance(item, ints):
+            return None
+        if isinstance(item, floats):
+            return ("float key on a hash shuffle (device routing "
+                    "needs int keys; floats ride range/sortByKey)"
+                    if hash_keys else None)
+        return "non-numeric"
+
+    if isinstance(key, (str, bytes)):
+        return ("string key: only text-source chains ride the device "
+                "(dictionary-encoded); everything else takes the "
+                "object path")
+    if isinstance(key, tuple):
+        if not getattr(conf, "TUPLE_KEYS", True):
+            return "tuple key with conf.TUPLE_KEYS disabled"
+        if len(key) < 2 or len(key) > conf.MAX_KEY_LEAVES:
+            return ("tuple key with %d leaves (device path carries "
+                    "flat tuples of 2..conf.MAX_KEY_LEAVES=%d)"
+                    % (len(key), conf.MAX_KEY_LEAVES))
+        for i, item in enumerate(key):
+            r = leaf_reason(item)
+            if r == "non-numeric":
+                if isinstance(item, tuple):
+                    return ("nested tuple key (only FLAT numeric "
+                            "tuples ride the device)")
+                return ("non-numeric key leaf %d (%s) in a tuple key"
+                        % (i, type(item).__name__))
+            if r is not None:
+                return r
+        return None
+    r = leaf_reason(key)
+    if r == "non-numeric":
+        return ("unsupported key type %s (object path)"
+                % type(key).__name__)
+    return r
+
+
+def _rule_host_fallback_key(r, report):
+    """Shuffles whose KEY SHAPE evicts the plan from the array path:
+    the pre-flight twin of fuse.analyze_stage's key checks, reporting
+    WHY (unsupported key shape, non-numeric leaf) instead of silently
+    running orders of magnitude slower on the object path.  Flat
+    numeric tuple keys now ride the device and stay unflagged."""
+    from dpark_tpu import rdd as _rdd
+    from dpark_tpu.dependency import HashPartitioner
+    if not isinstance(r, _rdd.ShuffledRDD):
+        return
+    rows = _peek_source_records(r.parent)
+    if not rows:
+        return                      # not cheaply probeable: stay quiet
+    hash_keys = isinstance(r.partitioner, HashPartitioner)
+    for row in rows:
+        if not (isinstance(row, tuple) and len(row) == 2):
+            continue
+        reason = _key_fallback_reason(row[0], hash_keys=hash_keys)
+        if reason is None:
+            continue
+        severity = "info" if isinstance(row[0], (str, bytes)) \
+            else "warn"
+        report.add(
+            "host-fallback-key", severity, r.scope_name,
+            "this shuffle leaves the array path: %s" % reason,
+            "key by ints/floats or a flat numeric tuple ((k1, k2), v) "
+            "to stay on the device; see the README device-path "
+            "support matrix")
+        return
+
+
 # ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
@@ -383,6 +473,7 @@ def lint_plan(rdd, master="local", report=None, lineage=None):
         _rule_group_agg(r, report)
         _rule_join_repartition(r, report)
         _rule_monoid_multileaf(r, report)
+        _rule_host_fallback_key(r, report)
     _rule_uncached_reshuffle(lineage, report)
     _rule_wide_depth(rdd, report)
     return report

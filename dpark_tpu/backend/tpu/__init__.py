@@ -63,6 +63,15 @@ class TPUScheduler(DAGScheduler):
                                           self.executor)
             except Exception as e:
                 logger.debug("analysis failed for %s: %s", stage, e)
+            if plan is None:
+                reason = fuse.last_fallback_reason()
+                if reason:
+                    # why the plan left the array path (key shape,
+                    # non-numeric leaf, ...): rides the per-stage job
+                    # record next to kind=object, and the
+                    # host-fallback-key lint rule reports the same
+                    # answer pre-flight
+                    self.note_stage(stage.id, fallback_reason=reason)
         if plan is not None:
             try:
                 self._run_array_stage(stage, tasks, plan, report)
@@ -137,16 +146,25 @@ class TPUScheduler(DAGScheduler):
         deps = self._resident_nocombine_deps(cg)
         if deps is None:
             return None
-        # join kernels require plain (k, v) records with a scalar int key
+        # join kernels require (k, v) records whose key is a scalar or
+        # flat numeric tuple, with the SAME width and dtypes both sides
+        from dpark_tpu.backend.tpu import layout
+        import numpy as np
         import jax.tree_util as jtu
+        key_sigs = []
         for dep in deps:
             store = self.executor.shuffle_store[dep.shuffle_id]
-            sample = jtu.tree_unflatten(
-                store["out_treedef"],
-                list(range(len(store["out_specs"]))))
-            if not (isinstance(sample, tuple) and len(sample) == 2
-                    and sample[0] == 0):
-                return None
+            treedef = store["out_treedef"]
+            specs = store["out_specs"]
+            nk = layout.key_width(treedef, specs, kinds="if")
+            sample = jtu.tree_unflatten(treedef,
+                                        list(range(len(specs))))
+            if nk is None or len(sample) != 2:
+                return None          # records must be (k, value) pairs
+            key_sigs.append((nk, tuple(np.dtype(dt)
+                                       for dt, _ in specs[:nk])))
+        if key_sigs[0] != key_sigs[1]:
+            return None
         rows_per_part = self.executor.run_device_join(deps[0], deps[1])
         for p, rows in enumerate(rows_per_part):
             env.cache.put((top.id, p), rows, disk=False)

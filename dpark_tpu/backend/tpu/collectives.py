@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dpark_tpu.utils.phash import phash_device
+from dpark_tpu.utils.phash import phash_device, phash_device_cols
 
 def _sentinel(dtype):
     """Max value of the key dtype — padding rows sort last.  ingest()
@@ -43,11 +43,75 @@ def hash_dst(key, n_dst, valid, r=None):
     return jnp.where(valid, dst, n_dst)
 
 
+def hash_dst_cols(key_cols, n_dst, valid, r=None):
+    """hash_dst over a COMPOSITE key (one or more key columns): the
+    destination is the pair-extended portable hash over all columns —
+    bit-identical to host HashPartitioner.get_partition((k1, ..., kn))
+    — so tuple-keyed shuffles land where the host partitioner (lookup,
+    co-partitioned joins) expects."""
+    r = n_dst if r is None else r
+    h = phash_device_cols(list(key_cols))
+    dst = (h % jnp.uint32(r)).astype(jnp.int32)
+    return jnp.where(valid, dst, n_dst)
+
+
 def range_dst(key, bounds, ascending, n_dst, valid, r=None):
     """Destination partition by sorted bounds (RangePartitioner): the
     device twin of host bisect_left over the sampled bounds."""
     r = n_dst if r is None else r
     idx = jnp.searchsorted(bounds, key, side="left").astype(jnp.int32)
+    dst = idx if ascending else (r - 1 - idx)
+    return jnp.where(valid, dst, n_dst)
+
+
+def _lex_less_cols(a_cols, b_cols):
+    """Row-wise lexicographic a < b over parallel column lists (the
+    device twin of Python tuple comparison)."""
+    lt = a_cols[0] < b_cols[0]
+    eq = a_cols[0] == b_cols[0]
+    for a, b in zip(a_cols[1:], b_cols[1:]):
+        lt = lt | (eq & (a < b))
+        eq = eq & (a == b)
+    return lt
+
+
+def lex_searchsorted(sorted_cols, query_cols, side="left"):
+    """Multi-column searchsorted: for each query row (one value per
+    column), the insertion index into rows of `sorted_cols` (sorted
+    lexicographically ascending).  jnp.searchsorted has no multi-key
+    form, so this runs a vectorized binary search — ceil(log2(m+1))
+    fixed steps of a row-wise lexicographic compare; every query
+    resolves in one fused program, no per-row host work."""
+    m = int(sorted_cols[0].shape[0])
+    nq = query_cols[0].shape[0]
+    lo = jnp.zeros((nq,), jnp.int32)
+    hi = jnp.full((nq,), m, jnp.int32)
+    for _ in range(max(1, m.bit_length())):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        safe = jnp.clip(mid, 0, max(m - 1, 0))
+        mid_cols = [c[safe] for c in sorted_cols]
+        if side == "left":
+            pred = _lex_less_cols(mid_cols, query_cols)
+        else:
+            pred = ~_lex_less_cols(query_cols, mid_cols)
+        lo = jnp.where(active & pred, mid + 1, lo)
+        hi = jnp.where(active & ~pred, mid, hi)
+    return lo
+
+
+def range_dst_cols(key_cols, bounds_cols, ascending, n_dst, valid,
+                   r=None):
+    """range_dst over a COMPOSITE key: bisect_left of each (k1, ..., kn)
+    row into the sampled tuple bounds, compared lexicographically —
+    exactly host RangePartitioner.get_partition on tuple keys."""
+    key_cols = list(key_cols)
+    if len(key_cols) == 1 and bounds_cols[0].ndim <= 1:
+        return range_dst(key_cols[0], bounds_cols[0], ascending, n_dst,
+                         valid, r=r)
+    r = n_dst if r is None else r
+    idx = lex_searchsorted(list(bounds_cols), key_cols,
+                           side="left").astype(jnp.int32)
     dst = idx if ascending else (r - 1 - idx)
     return jnp.where(valid, dst, n_dst)
 
@@ -238,14 +302,35 @@ def bucketize_combine(key, val_leaves, n, n_dst, merge_leaves,
     Returns (key', val_leaves', counts[n_dst], offsets[n_dst]) where rows
     are destination-sorted and combined.
     """
-    cap = key.shape[0]
+    ks, vv, counts, offsets = bucketize_combine_keys(
+        [key], val_leaves, n, n_dst, merge_leaves, monoid=monoid,
+        dst=dst, r=r)
+    return ks[0], vv, counts, offsets
+
+
+def bucketize_combine_keys(key_cols, val_leaves, n, n_dst, merge_leaves,
+                           monoid=None, dst=None, r=None):
+    """bucketize_combine over a COMPOSITE key: sort one device's rows by
+    (destination, k1, ..., kn), merge rows equal in EVERY key column,
+    compact.  Returns (key_cols', vals', counts, offsets).  Only key
+    column 0 carries the sentinel on invalid rows — invalid rows sort
+    into the sentinel bucket and are dropped by the keep mask, so the
+    other columns never need guarding."""
+    key_cols = list(key_cols)
+    cap = key_cols[0].shape[0]
     valid = jnp.arange(cap) < n
     if dst is None:
-        dst = hash_dst(key, n_dst, valid, r)
-    k = jnp.where(valid, key, _sentinel(key.dtype))
-    ks, vv, counts, offsets = _bucketize_combine_cols(
-        dst, [k], val_leaves, n_dst, merge_leaves, monoid)
-    return ks[0], vv, counts, offsets
+        dst = hash_dst_cols(key_cols, n_dst, valid, r)
+    ks = [jnp.where(valid, key_cols[0], _sentinel(key_cols[0].dtype))]
+    ks += key_cols[1:]
+    # composite keys: one hash ordering pass instead of n key argsorts
+    # (the reduce side re-sorts by the true key columns; see
+    # _bucketize_combine_cols on why adjacency is sufficient here)
+    order_col = (phash_device_cols(key_cols) if len(key_cols) > 1
+                 else None)
+    return _bucketize_combine_cols(dst, ks, val_leaves, n_dst,
+                                   merge_leaves, monoid,
+                                   order_col=order_col)
 
 
 def _changed_adjacent(cols):
@@ -282,18 +367,37 @@ def _segment_merge(key_cols, val_leaves, keep_valid, merge_leaves,
 
 
 def _bucketize_combine_cols(dst, key_cols, val_leaves, n_dst,
-                            merge_leaves, monoid):
+                            merge_leaves, monoid, order_col=None):
     """Sort by (dst, *key_cols) carrying values, merge rows equal in
     every key column, compact; dst and key_cols must already carry the
     sentinel / sentinel-bucket on invalid rows.  Returns
-    (key_cols', vals', counts[n_dst], offsets[n_dst])."""
+    (key_cols', vals', counts[n_dst], offsets[n_dst]).
+
+    `order_col` (optional, composite keys): a single synthetic
+    ordering column (e.g. the 32-bit composite key hash) used INSTEAD
+    of the n key columns for the sort — one argsort pass regardless of
+    key width.  Correct because the map-side combine only needs equal
+    keys ADJACENT within their destination run (boundaries are still
+    detected by comparing every real key column, so a hash collision
+    merely splits one group into two partial combiners — the reduce
+    side merges them anyway).  Do NOT use it where callers require
+    true key-sorted output (the spilled-run stream's export relies on
+    lexicographic run order)."""
     nk = len(key_cols)
-    sorted_ops = _lex_sort((dst,) + tuple(key_cols) + tuple(val_leaves),
-                           1 + nk)
-    d = sorted_ops[0]
-    ks = list(sorted_ops[1:1 + nk])
+    if order_col is not None:
+        sorted_ops = _lex_sort(
+            (dst, order_col) + tuple(key_cols) + tuple(val_leaves), 2)
+        d = sorted_ops[0]
+        ks = list(sorted_ops[2:2 + nk])
+        vals = sorted_ops[2 + nk:]
+    else:
+        sorted_ops = _lex_sort(
+            (dst,) + tuple(key_cols) + tuple(val_leaves), 1 + nk)
+        d = sorted_ops[0]
+        ks = list(sorted_ops[1:1 + nk])
+        vals = sorted_ops[1 + nk:]
     keep, reduced = _segment_merge(
-        [d] + ks, sorted_ops[1 + nk:],
+        [d] + ks, vals,
         lambda flags: flags & (d < n_dst), merge_leaves, monoid)
     dd_full = jnp.where(keep, d, n_dst)
     k_fulls = [jnp.where(keep, k, _sentinel(k.dtype)) for k in ks]
@@ -306,23 +410,27 @@ def _bucketize_combine_cols(dst, key_cols, val_leaves, n_dst,
     return list(packed[2:2 + nk]), list(packed[2 + nk:]), counts, offsets
 
 
-def bucketize_combine_rid(rid, key, val_leaves, n, n_dst, merge_leaves,
-                          monoid=None):
+def bucketize_combine_rid(rid, key_cols, val_leaves, n, n_dst,
+                          merge_leaves, monoid=None):
     """Map-side pre-combine for the spilled-run stream (r > mesh): sort
-    one device's rows by (device, rid, key) — device = rid % n_dst —
-    merge equal (rid, key) rows, compact.  Cuts exchange volume to
-    O(#distinct keys per wave) before the wire.
+    one device's rows by (device, rid, k1, ..., kn) — device =
+    rid % n_dst — merge rows equal in (rid, every key column), compact.
+    Cuts exchange volume to O(#distinct keys per wave) before the wire.
+    `key_cols` is a list (composite tuple keys ride as multiple
+    columns).
 
-    Returns (sorted_leaves=[rid', key'] + vals', counts[n_dst],
+    Returns (sorted_leaves=[rid', key cols'...] + vals', counts[n_dst],
     offsets[n_dst]) with rows device-sorted and combined."""
-    cap = key.shape[0]
+    key_cols = list(key_cols)
+    cap = key_cols[0].shape[0]
     valid = jnp.arange(cap) < n
     dev = jnp.where(valid, (rid % n_dst).astype(jnp.int32), n_dst)
     rd = jnp.where(valid, rid, _sentinel(rid.dtype))
-    k = jnp.where(valid, key, _sentinel(key.dtype))
-    ks, vv, counts, offsets = _bucketize_combine_cols(
-        dev, [rd, k], val_leaves, n_dst, merge_leaves, monoid)
-    return ks + vv, counts, offsets
+    ks = [jnp.where(valid, key_cols[0], _sentinel(key_cols[0].dtype))]
+    ks += key_cols[1:]
+    out_ks, vv, counts, offsets = _bucketize_combine_cols(
+        dev, [rd] + ks, val_leaves, n_dst, merge_leaves, monoid)
+    return out_ks + vv, counts, offsets
 
 
 def _segment_reduce_cols(key_cols, val_leaves, valid_mask, merge_leaves,
@@ -347,19 +455,15 @@ def _segment_reduce_cols(key_cols, val_leaves, valid_mask, merge_leaves,
             jnp.sum(keep).astype(jnp.int32))
 
 
-def segment_reduce2(rid, key, val_leaves, valid_mask, merge_leaves,
-                    monoid=None):
-    """segment_reduce over the composite (rid, key): merge values of
-    rows equal in BOTH columns.  Used by the spilled-run stream's
-    per-wave pre-reduce, where the logical partition id rides next to
-    the user key (invalid rows carry the rid-dtype sentinel, set by
-    flatten_received, and sort last).
-
-    Returns (rid', key', reduced_val_leaves, n_unique) with uniques
-    packed to the front, sorted by (rid, key)."""
-    ks, vv, n = _segment_reduce_cols([rid, key], val_leaves, valid_mask,
-                                     merge_leaves, monoid)
-    return ks[0], ks[1], vv, n
+def segment_reduce_keys(key_cols, val_leaves, valid_mask, merge_leaves,
+                        monoid=None):
+    """segment_reduce over a COMPOSITE key: merge values of rows equal
+    in EVERY key column (key column 0 carries the sentinel on invalid
+    rows, as set by flatten_received).  Returns (key_cols', reduced
+    vals', n_unique) with uniques packed to the front, sorted
+    lexicographically by the key columns."""
+    return _segment_reduce_cols(list(key_cols), val_leaves, valid_mask,
+                                merge_leaves, monoid)
 
 
 def segment_reduce(key, val_leaves, valid_mask, merge_leaves,

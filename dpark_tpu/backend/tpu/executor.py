@@ -291,12 +291,13 @@ class _RunPremerger:
     single-run partition is already merged and the export can skip its
     argsort."""
 
-    def __init__(self, runs, read_run, write_run, spool):
+    def __init__(self, runs, read_run, write_run, spool, key_cols=1):
         import threading
         self._runs = runs            # the SAME list object the store holds
         self._read = read_run
         self._write = write_run
         self._spool = spool
+        self._key_cols = max(1, key_cols)   # composite keys: sort ALL
         self._locks = [threading.Lock() for _ in runs]
         self._merged = [len(p) <= 1 for p in runs]
         self._stop = threading.Event()
@@ -330,7 +331,13 @@ class _RunPremerger:
             parts = [self._read(p) for p in paths]
             cols = [np.concatenate([pt[li] for pt in parts])
                     for li in range(len(parts[0]))]
-            order = np.argsort(cols[0], kind="stable")
+            # lexicographic over every key column (np.lexsort sorts by
+            # the LAST key first); equal-key group order must survive
+            # the merge or the export's adjacent-group fold would emit
+            # split groups for tuple keys
+            nk = min(self._key_cols, len(cols))
+            order = (np.argsort(cols[0], kind="stable") if nk == 1
+                     else np.lexsort(tuple(cols[:nk][::-1])))
             merged = os.path.join(self._spool, "merged-%d" % rid)
             self._write(merged, [c[order] for c in cols])
             self._runs[rid] = [merged]
@@ -402,6 +409,7 @@ class JAXExecutor:
         self._result_bytes = 0
         self._hbm_seq = 0             # global LRU clock across both tiers
         self.exchange_wire_bytes = 0  # ICI bytes moved by all_to_all
+        self.export_seconds = 0.0     # host bridge export wall time
         self._exchange_real_rows = 0  # valid rows offered for exchange
         self.exchange_slot_rows = 0   # padded slots moved over the wire;
         #   pad efficiency = real/slot (HARDWARE_CHECKLIST.md step 3)
@@ -448,6 +456,15 @@ class JAXExecutor:
         from dpark_tpu import shuffle as shuffle_mod
         shuffle_mod.HBM_EXPORTERS[id(self)] = self.export_bucket
         self._exporter_key = id(self)
+        # export-bridge device reads are SERIALIZED: slicing a sharded
+        # (ndev, ...) store leaf launches a program with a cross-device
+        # gather, and two such programs dispatched concurrently from
+        # parallel fetcher threads deadlock the XLA:CPU collective
+        # rendezvous (each run pins one device participant; observed as
+        # the classic multi-thread lookup/fetch wedge).  Disk-run
+        # exports stay lock-free — they touch no device.
+        import threading
+        self._export_lock = threading.Lock()
         self._tracing = False
         if conf.TRACE_DIR:
             try:
@@ -527,15 +544,17 @@ class JAXExecutor:
         try:
             merge_fn = fuse._leaves_merge_fn(
                 dep.aggregator.merge_combiners, plan.out_treedef)
-            structs = fuse._batched_spec_struct(plan.out_specs[1:])
+            structs = fuse._batched_spec_struct(
+                plan.out_specs[getattr(plan, "epi_nk", 1) or 1:])
             jax.eval_shape(lambda *v: merge_fn(list(v), list(v)),
                            *structs)
         except Exception:
             merge_fn = None
         if merge_fn is None and monoid is not None:
             specs = plan.out_specs
-            single_scalar_value = (len(specs) == 2
-                                   and specs[1][1] == ())
+            nk = getattr(plan, "epi_nk", 1) or 1
+            single_scalar_value = (len(specs) == nk + 1
+                                   and specs[nk][1] == ())
             if not single_scalar_value:
                 return None, None
         return merge_fn, monoid
@@ -544,24 +563,36 @@ class JAXExecutor:
     def _epilogue_block(plan, lv, n, n_dst, merge_fn, monoid, bounds):
         """Shared shuffle-write tail: destination assignment (hash or
         range bounds over the LOGICAL partition count r <= mesh size) +
-        bucketize[-combine]."""
+        bucketize[-combine].  Composite (tuple) keys occupy the first
+        plan.epi_nk columns: destinations hash over all of them with
+        the pair-extended phash, and the combine merges rows equal in
+        every key column."""
+        nk = getattr(plan, "epi_nk", 1) or 1
         k = lv[0]
         r = plan.epilogue[1].partitioner.num_partitions
+        valid = jnp.arange(k.shape[0]) < n
         if plan.epi_spec is not None and plan.epi_spec[0] == "range":
-            valid = jnp.arange(k.shape[0]) < n
-            dst = collectives.range_dst(k, bounds, plan.epi_spec[1],
-                                        n_dst, valid, r=r)
+            if nk == 1:
+                dst = collectives.range_dst(k, bounds,
+                                            plan.epi_spec[1],
+                                            n_dst, valid, r=r)
+            else:
+                bcols = [bounds[:, i] for i in range(nk)]
+                dst = collectives.range_dst_cols(
+                    lv[:nk], bcols, plan.epi_spec[1], n_dst, valid,
+                    r=r)
         else:
-            dst = None
+            dst = collectives.hash_dst_cols(lv[:nk], n_dst, valid,
+                                            r=r)
         if merge_fn is not None or monoid is not None:
-            k2, v2, cnts, offs = collectives.bucketize_combine(
-                k, lv[1:], n, n_dst, merge_fn, monoid=monoid, dst=dst,
-                r=r)
+            k2s, v2, cnts, offs = collectives.bucketize_combine_keys(
+                lv[:nk], lv[nk:], n, n_dst, merge_fn, monoid=monoid,
+                dst=dst, r=r)
         else:
             sorted_lv, cnts, offs = collectives.bucketize(
                 k, lv, n, n_dst, dst=dst, r=r)
-            k2, v2 = sorted_lv[0], sorted_lv[1:]
-        return (cnts, offs, k2) + tuple(v2)
+            k2s, v2 = sorted_lv[:nk], sorted_lv[nk:]
+        return (cnts, offs) + tuple(k2s) + tuple(v2)
 
     def _widen_entry(self, plan, lv):
         """Cast program inputs up to the spec dtypes: ingest may ship
@@ -732,6 +763,8 @@ class JAXExecutor:
         if epilogue is not None:
             out_merge_fn, out_monoid = self._epilogue_merge(plan)
 
+        src_nk = getattr(plan, "src_nk", 1) or 1
+
         def per_device(*args):
             bounds = args[0][0] if has_bounds else None
             args = args[1:] if has_bounds else args
@@ -743,13 +776,14 @@ class JAXExecutor:
                               for li in range(nleaves)])
             flat, mask = collectives.flatten_received(recvs, cnts)
             if merge_fn is not None:
-                k, vs, n = collectives.segment_reduce(
-                    flat[0], flat[1:], mask, merge_fn, monoid=monoid)
-                lv = [k] + list(vs)
+                ks, vs, n = collectives.segment_reduce_keys(
+                    flat[:src_nk], flat[src_nk:], mask, merge_fn,
+                    monoid=monoid)
+                lv = list(ks) + list(vs)
             else:
-                # no-combine repartition: sort rows by key, valid first
-                packed = collectives._lex_sort(
-                    (flat[0],) + tuple(flat[1:]), 1)
+                # no-combine repartition: sort rows by the FULL key
+                # (every column of a tuple key), valid first
+                packed = collectives._lex_sort(tuple(flat), src_nk)
                 lv = list(packed)
                 n = jnp.sum(mask).astype(jnp.int32)
             for op in ops:
@@ -774,12 +808,15 @@ class JAXExecutor:
         return jitted
 
     def _bounds_arg(self, plan):
-        """plan.epi_bounds tiled per device and sharded, or None."""
+        """plan.epi_bounds tiled per device and sharded, or None.
+        Tuple-key range bounds are 2D (len(bounds), nk) and tile to
+        (ndev, len(bounds), nk)."""
         if plan.epi_bounds is None:
             return None
-        tiled = np.tile(plan.epi_bounds, (self.ndev, 1)) \
-            if plan.epi_bounds.size else np.zeros(
-                (self.ndev, 0), plan.epi_bounds.dtype)
+        b = plan.epi_bounds
+        reps = (self.ndev,) + (1,) * b.ndim
+        tiled = np.tile(b, reps) if b.size else np.zeros(
+            (self.ndev,) + b.shape, b.dtype)
         return layout.put_sharded(tiled, self._sharding())
 
     # ------------------------------------------------------------------
@@ -1158,7 +1195,8 @@ class JAXExecutor:
                 # device with one boundary scan
                 if plan.group_output:
                     counts = layout.host_read(
-                        self._distinct_key_counts(batch))
+                        self._distinct_key_counts(
+                            batch, nk=getattr(plan, "src_nk", 1) or 1))
                 else:
                     counts = layout.host_read(batch.counts)
                 return ("counts", [int(c) for c in counts])
@@ -1211,6 +1249,16 @@ class JAXExecutor:
                 # readback and streaming every row at ~37 MB/s.
                 kspec = fuse.classify_top_key(
                     top[1], plan.out_treedef, plan.out_specs, encoded)
+                if kspec is None and top[1] is not None \
+                        and not encoded:
+                    # ranged-int probe: integer key EXPRESSIONS ride
+                    # the device when the interval check over the
+                    # batch's actual per-column min/max proves no
+                    # intermediate can leave int64 (one tiny masked
+                    # min/max program per int column)
+                    kspec = fuse.classify_top_key(
+                        top[1], plan.out_treedef, plan.out_specs,
+                        encoded, col_ranges=self._int_col_ranges(batch))
                 if kspec is not None:
                     batch = self._device_topk(plan, batch, kspec,
                                               top[0], top[2])
@@ -1249,6 +1297,23 @@ class JAXExecutor:
             "single_map": (plan.source[0] in ("text", "union")
                            or getattr(plan, "reslice", False)),
         })
+
+    def _int_col_ranges(self, batch):
+        """Exact (lo, hi) Python ints per int64 scalar column of a
+        result batch (valid rows only; None for other leaves) — the
+        input of classify_top_key's ranged-int probe."""
+        ranges = []
+        for c in batch.cols:
+            if c.ndim == 2 and np.dtype(c.dtype).kind == "i":
+                try:
+                    r = layout.host_read(
+                        layout._masked_minmax(c, batch.counts))
+                    ranges.append((int(r[0]), int(r[1])))
+                except Exception:
+                    ranges.append(None)
+            else:
+                ranges.append(None)
+        return ranges
 
     def _device_topk(self, plan, batch, kspec, n, smallest):
         """Per-device top-n of a result batch by the classified key:
@@ -1331,26 +1396,32 @@ class JAXExecutor:
             self._compiled[key] = jax.jit(fn)
         return self._compiled[key](batch.counts, col)
 
-    def _distinct_key_counts(self, batch):
+    def _distinct_key_counts(self, batch, nk=1):
         """(ndev,) distinct-key counts of a per-device KEY-SORTED batch
         (the no-combine reduce's row order) — group cardinality without
-        egesting a single row."""
+        egesting a single row.  `nk` key columns: a boundary is ANY of
+        them changing (tuple keys group on every column)."""
         cap = batch.cap
-        k0 = batch.cols[0]
-        key = ("distinct", cap, str(k0.dtype))
+        kcols = batch.cols[:nk]
+        key = ("distinct", cap, nk,
+               tuple(str(k.dtype) for k in kcols))
         if key not in self._compiled:
-            def per_device(counts, keys):
-                n, k = counts[0], keys[0]
+            def per_device(counts, *keys):
+                n = counts[0]
+                ks = [k[0] for k in keys]
                 idx = jnp.arange(cap)
                 valid = idx < n
-                bound = valid & ((idx == 0) | (k != jnp.roll(k, 1)))
+                changed = ks[0] != jnp.roll(ks[0], 1)
+                for kc in ks[1:]:
+                    changed = changed | (kc != jnp.roll(kc, 1))
+                bound = valid & ((idx == 0) | changed)
                 return (jnp.expand_dims(
                     jnp.sum(bound).astype(jnp.int32), 0),)
             fn = _shard_map(per_device, self.mesh,
-                            in_specs=(P(AXIS),) * 2,
+                            in_specs=(P(AXIS),) * (1 + nk),
                             out_specs=(P(AXIS),))
             self._compiled[key] = jax.jit(fn)
-        (out,) = self._compiled[key](batch.counts, batch.cols[0])
+        (out,) = self._compiled[key](batch.counts, *kcols)
         return out
 
     def _register_shuffle(self, dep, plan, store):
@@ -1361,6 +1432,10 @@ class JAXExecutor:
             self.drop_shuffle(sid)          # re-run: no double count
         store["out_treedef"] = plan.out_treedef
         store["out_specs"] = plan.out_specs
+        # composite keys span the first key_cols columns: readers (the
+        # gather sort, run premerger, export bridge) order and group by
+        # ALL of them, not just column 0
+        store["key_cols"] = getattr(plan, "epi_nk", 1) or 1
         store["nbytes"] = sum(int(l.nbytes) for l in store["leaves"])
         store["seq"] = self._next_seq()
         self.shuffle_store[sid] = store
@@ -1677,6 +1752,8 @@ class JAXExecutor:
         if carry_rid and not fuse.is_list_agg(plan.epilogue[1].aggregator):
             merge_fn, monoid = self._merge_probe(plan)
 
+        nk = getattr(plan, "epi_nk", 1) or 1
+
         def per_device(counts, *rest):
             n = counts[0]
             bounds = rest[0][0] if has_bounds else None
@@ -1688,14 +1765,21 @@ class JAXExecutor:
             capn = k.shape[0]
             valid = jnp.arange(capn) < n
             if has_bounds:
-                rid = collectives.range_dst(k, bounds, ascending,
-                                            r, valid, r=r)
+                if nk == 1:
+                    rid = collectives.range_dst(k, bounds, ascending,
+                                                r, valid, r=r)
+                else:
+                    bcols = [bounds[:, i] for i in range(nk)]
+                    rid = collectives.range_dst_cols(
+                        lv[:nk], bcols, ascending, r, valid, r=r)
             else:
-                rid = collectives.hash_dst(k, r, valid, r=r)
+                rid = collectives.hash_dst_cols(lv[:nk], r, valid,
+                                                r=r)
             if carry_rid and (merge_fn is not None
                               or monoid is not None):
                 cols, cnts, offs = collectives.bucketize_combine_rid(
-                    rid, k, lv[1:], n, ndev, merge_fn, monoid=monoid)
+                    rid, lv[:nk], lv[nk:], n, ndev, merge_fn,
+                    monoid=monoid)
             elif carry_rid:
                 dev = jnp.where(valid, rid % ndev,
                                 ndev).astype(jnp.int32)
@@ -1831,13 +1915,15 @@ class JAXExecutor:
                                           donate=donate)
                 exchange_s = stats.now() - t_x
                 slot_floor = max(slot_floor, recv[2])
+                nk = getattr(plan, "epi_nk", 1) or 1
                 if pre_merge is not None or pre_monoid is not None:
                     sorted_batch = self._prereduce_received(
                         plan, recv, pre_merge, pre_monoid,
                         donate=donate)
                 else:
                     sorted_batch = self._sort_received(
-                        plan, recv, nkeys=2 if carry_rid else 1,
+                        plan, recv,
+                        nkeys=(1 + nk) if carry_rid else nk,
                         donate=donate)
                 # start the wave's D2H now; the blocking read happens
                 # one wave later (or immediately when depth == 0)
@@ -1884,7 +1970,9 @@ class JAXExecutor:
         self._note_pipeline(stats)
         host_combine = not fuse.is_list_agg(dep.aggregator)
         premerge = _RunPremerger(runs, self._read_run, self._write_run,
-                                 spool)
+                                 spool,
+                                 key_cols=getattr(plan, "epi_nk", 1)
+                                 or 1)
         if conf.SPILL_WRITER:
             # pre-merge each partition's runs in the background NOW —
             # the reduce tasks that fetch later find a single sorted
@@ -1975,17 +2063,19 @@ class JAXExecutor:
         """Flatten exchange rounds and segment-reduce per (rid, key) on
         device — the spilled-run stream's per-wave pre-combine for
         traceable merges with r beyond the mesh.  Returns the same
-        rid-prefixed Batch shape as _sort_received(nkeys=2), with equal
-        (rid, key) rows already merged."""
+        rid-prefixed Batch shape as _sort_received, with rows equal in
+        (rid, every key column) already merged."""
+        nk = getattr(plan, "epi_nk", 1) or 1
+
         def body(recvs, cnts):
             flat, mask = collectives.flatten_received(recvs, cnts)
-            rid, k, vs, n = collectives.segment_reduce2(
-                flat[0], flat[1], flat[2:], mask, merge_fn,
+            ks, vs, n = collectives.segment_reduce_keys(
+                flat[:1 + nk], flat[1 + nk:], mask, merge_fn,
                 monoid=monoid)
-            return (n, rid, k) + tuple(vs)
+            return (n,) + tuple(ks) + tuple(vs)
 
         outs = self._run_recv_program(plan, recv, "wave_prereduce",
-                                      (), body, donate=donate)
+                                      (nk,), body, donate=donate)
         return layout.Batch(self._rid_prefixed_treedef(plan),
                             list(outs[1:]), outs[0])
 
@@ -2094,6 +2184,7 @@ class JAXExecutor:
         recv_rounds, cnt_rounds, slot = recv
         rounds = len(recv_rounds)
         nleaves = len(recv_rounds[0])
+        nk = getattr(plan, "epi_nk", 1) or 1
         has_state = state is not None
         state_cap = state[0][0].shape[1] if has_state else 0
         key = ("stream_merge", plan.program_key, rounds, slot, nleaves,
@@ -2121,10 +2212,11 @@ class JAXExecutor:
                         jnp.concatenate([sl, fl])
                         for sl, fl in zip(st_leaves[1:], flat[1:])]
                     mask = jnp.concatenate([stv, mask])
-                k, vs, n = collectives.segment_reduce(
-                    flat[0], flat[1:], mask, merge_fn, monoid=monoid)
-                out = (jnp.expand_dims(n, 0),
-                       jnp.expand_dims(k, 0)) + tuple(
+                ks, vs, n = collectives.segment_reduce_keys(
+                    flat[:nk], flat[nk:], mask, merge_fn,
+                    monoid=monoid)
+                out = (jnp.expand_dims(n, 0),) + tuple(
+                    jnp.expand_dims(k, 0) for k in ks) + tuple(
                     jnp.expand_dims(v, 0) for v in vs)
                 return out
 
@@ -2205,12 +2297,17 @@ class JAXExecutor:
             group_output = False
             epi_spec = None
             epi_bounds = None
+            epi_nk = 1
+            # sort gathered rows by the FULL key (tuple keys span
+            # key_cols columns) so cogroup/join consumers see the same
+            # lexicographic order the host merge expects
+            src_nk = store.get("key_cols", 1) or 1
             in_treedef = store["out_treedef"]
             in_specs = store["out_specs"]
             out_treedef = store["out_treedef"]
             out_specs = store["out_specs"]
             stage = None
-            program_key = ("gather",
+            program_key = ("gather", src_nk,
                            tuple((str(dt), shape)
                                  for dt, shape in store["out_specs"]))
 
@@ -2246,41 +2343,56 @@ class JAXExecutor:
         cnt_b, lv_b = self._exchange_sorted(dep_b, store_b)
         na, nb = len(lv_a), len(lv_b)
         cap_a, cap_b = lv_a[0].shape[1], lv_b[0].shape[1]
+        # composite (tuple) keys span the first nk columns on BOTH
+        # sides (fuse._analyze_join_source / _precompute_join verified
+        # the widths and dtypes agree); key matching runs a
+        # lexicographic binary search instead of jnp.searchsorted
+        nk = store_a.get("key_cols", 1) or 1
 
-        count_key = ("join_count", cap_a, cap_b, na, nb,
+        def _key_ranges(a, b, A, B):
+            """(lo, hi) match ranges of each A row in the key-sorted B
+            rows.  Only key column 0 needs the sentinel: invalid rows
+            sort last on it, and comparisons against them resolve on
+            column 0 alone (no valid key ever carries the sentinel)."""
+            sent = collectives._sentinel(A[0].dtype)
+            A0 = jnp.where(jnp.arange(cap_a) < a, A[0], sent)
+            B0 = jnp.where(jnp.arange(cap_b) < b, B[0], sent)
+            if nk == 1:
+                return (jnp.searchsorted(B0, A0, side="left"),
+                        jnp.searchsorted(B0, A0, side="right"))
+            acols = [A0] + list(A[1:nk])
+            bcols = [B0] + list(B[1:nk])
+            return (collectives.lex_searchsorted(bcols, acols, "left"),
+                    collectives.lex_searchsorted(bcols, acols,
+                                                 "right"))
+
+        count_key = ("join_count", cap_a, cap_b, na, nb, nk,
                      tuple(str(l.dtype) for l in lv_a + lv_b))
         if count_key not in self._compiled:
-            def count_dev(ca, cb, ka, kb):
-                a, b, A, B = ca[0], cb[0], ka[0], kb[0]
-                sent = collectives._sentinel(A.dtype)
-                A = jnp.where(jnp.arange(cap_a) < a, A, sent)
-                B = jnp.where(jnp.arange(cap_b) < b, B, sent)
-                lo = jnp.searchsorted(B, A, side="left")
-                hi = jnp.searchsorted(B, A, side="right")
+            def count_dev(ca, cb, *keys):
+                a, b = ca[0], cb[0]
+                A = [k[0] for k in keys[:nk]]
+                B = [k[0] for k in keys[nk:]]
+                lo, hi = _key_ranges(a, b, A, B)
                 per = jnp.where(jnp.arange(cap_a) < a, hi - lo, 0)
                 return (jnp.expand_dims(jnp.sum(per), 0),)
             fn = _shard_map(count_dev, self.mesh,
-                            in_specs=(P(AXIS),) * 4,
+                            in_specs=(P(AXIS),) * (2 + 2 * nk),
                             out_specs=(P(AXIS),))
             self._compiled[count_key] = jax.jit(fn)
-        (totals,) = self._compiled[count_key](cnt_a, cnt_b,
-                                              lv_a[0], lv_b[0])
+        (totals,) = self._compiled[count_key](
+            cnt_a, cnt_b, *lv_a[:nk], *lv_b[:nk])
         cap_out = layout.round_capacity(
             int(layout.host_read(totals).max() or 1))
 
-        exp_key = ("join_expand", cap_a, cap_b, cap_out, na, nb,
+        exp_key = ("join_expand", cap_a, cap_b, cap_out, na, nb, nk,
                    tuple(str(l.dtype) for l in lv_a + lv_b))
         if exp_key not in self._compiled:
             def expand_dev(ca, cb, *leaves):
                 a, b = ca[0], cb[0]
                 A = [l[0] for l in leaves[:na]]
                 B = [l[0] for l in leaves[na:]]
-                ka, kb = A[0], B[0]
-                sent = collectives._sentinel(ka.dtype)
-                ka = jnp.where(jnp.arange(cap_a) < a, ka, sent)
-                kb = jnp.where(jnp.arange(cap_b) < b, kb, sent)
-                lo = jnp.searchsorted(kb, ka, side="left")
-                hi = jnp.searchsorted(kb, ka, side="right")
+                lo, hi = _key_ranges(a, b, A, B)
                 per = jnp.where(jnp.arange(cap_a) < a, hi - lo, 0)
                 offs = jnp.cumsum(per) - per          # exclusive
                 total = jnp.sum(per)
@@ -2291,11 +2403,10 @@ class JAXExecutor:
                     0, cap_a - 1)
                 j = t - offs[i]
                 bi = jnp.clip(lo[i] + j, 0, cap_b - 1)
-                out = [A[0][i]] + [x[i] for x in A[1:]] \
-                    + [x[bi] for x in B[1:]]
+                out = [x[i] for x in A] + [x[bi] for x in B[nk:]]
                 return (jnp.expand_dims(total, 0),) + tuple(
                     jnp.expand_dims(o, 0) for o in out)
-            n_out = 1 + 1 + (na - 1) + (nb - 1)
+            n_out = 1 + na + (nb - nk)
             fn = _shard_map(expand_dev, self.mesh,
                             in_specs=(P(AXIS),) * (2 + na + nb),
                             out_specs=(P(AXIS),) * n_out)
@@ -2303,13 +2414,14 @@ class JAXExecutor:
         outs = self._compiled[exp_key](cnt_a, cnt_b, *lv_a, *lv_b)
         counts, leaves = outs[0], list(outs[1:])
 
-        # rows are (k, va..., vb...); records are (k, (va, vb))
+        # rows are (k..., va..., vb...); records are (k, (va, vb)) with
+        # the key subtree (scalar or flat tuple) taken from side a
         import jax.tree_util as jtu
         ta = store_a["out_treedef"]
         tb = store_b["out_treedef"]
         sample_a = jtu.tree_unflatten(ta, list(range(na)))
         sample_b = jtu.tree_unflatten(tb, list(range(nb)))
-        joined_sample = (0, (sample_a[1], sample_b[1]))
+        joined_sample = (sample_a[0], (sample_a[1], sample_b[1]))
         out_treedef = jtu.tree_structure(joined_sample)
         return layout.Batch(out_treedef, leaves, counts)
 
@@ -2321,7 +2433,17 @@ class JAXExecutor:
 
     def export_bucket(self, sid, map_id, reduce_id):
         """Device-resident map output -> host (k, combiner) items, for
-        host-path reduce stages (shuffle.read_bucket 'hbm://' uris)."""
+        host-path reduce stages (shuffle.read_bucket 'hbm://' uris).
+        Wall time accumulates in `export_seconds` (the per-phase bench
+        table's "export" column)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            return self._export_bucket(sid, map_id, reduce_id)
+        finally:
+            self.export_seconds += _time.perf_counter() - t0
+
+    def _export_bucket(self, sid, map_id, reduce_id):
         store = self.shuffle_store.get(sid)
         if store is None:
             raise KeyError("no HBM shuffle %d" % sid)
@@ -2330,12 +2452,13 @@ class JAXExecutor:
             # as map 0's bucket (other maps contribute nothing)
             if map_id != 0:
                 return []
-            counts = layout.host_read(store["counts"])
-            cnt = int(counts[reduce_id])
-            if not cnt:
-                return []
-            mats = [self._read_dev_slice(l, reduce_id)[:cnt]
-                    for l in store["leaves"]]
+            with self._export_lock:
+                counts = layout.host_read(store["counts"])
+                cnt = int(counts[reduce_id])
+                if not cnt:
+                    return []
+                mats = [self._read_dev_slice(l, reduce_id)[:cnt]
+                        for l in store["leaves"]]
             lists = [m.tolist() for m in mats]
             treedef = store["out_treedef"]
             rows = [jax.tree_util.tree_unflatten(
@@ -2363,7 +2486,9 @@ class JAXExecutor:
             if presorted:
                 lists = [c.tolist() for c in cols]
             else:
-                order = np.argsort(cols[0], kind="stable")
+                nk = min(store.get("key_cols", 1) or 1, len(cols))
+                order = (np.argsort(cols[0], kind="stable") if nk == 1
+                         else np.lexsort(tuple(cols[:nk][::-1])))
                 lists = [c[order].tolist() for c in cols]
             flat2 = jax.tree_util.tree_structure((0, 0))
             treedef = store["out_treedef"]
@@ -2406,17 +2531,19 @@ class JAXExecutor:
             # (text ingest): the whole shuffle exports through map 0
             if map_id != 0:
                 return []
+            with self._export_lock:
+                counts = layout.host_read(store["counts"])
+                offsets = layout.host_read(store["offsets"])
+                rows = []
+                for dev in range(counts.shape[0]):
+                    rows.extend(self._export_one(store, dev, reduce_id,
+                                                 counts, offsets))
+            return self._maybe_decode(store, rows)
+        with self._export_lock:
             counts = layout.host_read(store["counts"])
             offsets = layout.host_read(store["offsets"])
-            rows = []
-            for dev in range(counts.shape[0]):
-                rows.extend(self._export_one(store, dev, reduce_id,
-                                             counts, offsets))
-            return self._maybe_decode(store, rows)
-        counts = layout.host_read(store["counts"])
-        offsets = layout.host_read(store["offsets"])
-        rows = self._export_one(store, map_id, reduce_id, counts,
-                                offsets)
+            rows = self._export_one(store, map_id, reduce_id, counts,
+                                    offsets)
         return self._maybe_decode(store, rows)
 
     @staticmethod

@@ -29,6 +29,22 @@ from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("tpu.fuse")
 
+# why the LAST analyze_stage call declined the array path (set at the
+# key-shape decline sites, cleared per call): the scheduler surfaces it
+# in the per-stage job record and the host-fallback-key lint rule gives
+# the same answer pre-flight.  Best-effort observability — never
+# consulted for control flow.
+_last_fallback = [None]
+
+
+def _fallback(reason):
+    _last_fallback[0] = reason
+    return None
+
+
+def last_fallback_reason():
+    return _last_fallback[0]
+
 
 def is_list_agg(agg):
     """The identity list-aggregator trio used by groupByKey/partitionBy:
@@ -114,7 +130,90 @@ def _subscript_const_index(f):
     return ints[0]
 
 
-def classify_top_key(key, treedef, specs, encoded):
+class _IntInterval:
+    """Exact integer interval for the ranged-int top-k key probe: the
+    user's key expression is EXECUTED once over per-column [min, max]
+    intervals (Python big ints — no wrap), and every intermediate
+    operation checks its bounds against int64.  If the whole expression
+    stays in range, device i64 arithmetic provably never wraps and the
+    device-computed key equals the host's exact Python int for every
+    record — sound, unlike a corner check of the output alone (which
+    misses interior extremes like x*(K-x) and overflowing
+    intermediates).  Any operation outside +, -, *, // (positive
+    divisor), and unary +/- raises and keeps the host path."""
+
+    _LIMIT = 2 ** 63 - 1
+
+    __slots__ = ("lo", "hi")
+
+    def __init__(self, lo, hi):
+        if abs(lo) > self._LIMIT or abs(hi) > self._LIMIT:
+            raise OverflowError("interval exceeds int64")
+        self.lo, self.hi = lo, hi
+
+    @classmethod
+    def _of(cls, other):
+        if isinstance(other, _IntInterval):
+            return other
+        if isinstance(other, bool) or not isinstance(other, int):
+            raise TypeError("non-int operand")
+        return cls(other, other)
+
+    def __add__(self, o):
+        o = self._of(o)
+        return _IntInterval(self.lo + o.lo, self.hi + o.hi)
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        o = self._of(o)
+        return _IntInterval(self.lo - o.hi, self.hi - o.lo)
+
+    def __rsub__(self, o):
+        return self._of(o).__sub__(self)
+
+    def __mul__(self, o):
+        o = self._of(o)
+        corners = [self.lo * o.lo, self.lo * o.hi,
+                   self.hi * o.lo, self.hi * o.hi]
+        return _IntInterval(min(corners), max(corners))
+    __rmul__ = __mul__
+
+    def __floordiv__(self, o):
+        o = self._of(o)
+        if o.lo <= 0:
+            raise ValueError("floordiv needs a provably positive "
+                             "divisor")
+        return _IntInterval(min(self.lo // o.lo, self.lo // o.hi),
+                            max(self.hi // o.lo, self.hi // o.hi))
+
+    def __neg__(self):
+        return _IntInterval(-self.hi, -self.lo)
+
+    def __pos__(self):
+        return self
+
+
+def _ranged_int_key_ok(key, treedef, specs, col_ranges):
+    """True when the user's int key expression provably stays inside
+    int64 over the batch's actual per-column value ranges (the
+    ranged-int probe: `col_ranges[i]` = exact (lo, hi) ints of leaf i,
+    None for non-int leaves — any read of an unranged leaf aborts)."""
+    import jax.tree_util as jtu
+    if col_ranges is None or len(col_ranges) != len(specs):
+        return False
+    leaves = []
+    for rng, (dt, shape) in zip(col_ranges, specs):
+        if rng is None or shape != () or dt.kind != "i":
+            return False
+        leaves.append(_IntInterval(int(rng[0]), int(rng[1])))
+    try:
+        out = key(jtu.tree_unflatten(treedef, leaves))
+        return isinstance(out, _IntInterval)
+    except Exception:
+        return False
+
+
+def classify_top_key(key, treedef, specs, encoded, col_ranges=None):
     """Device top-k eligibility for one result batch: how to compute
     the ordering key of each record on device.
 
@@ -122,7 +221,15 @@ def classify_top_key(key, treedef, specs, encoded):
     order by the traced user key (scalar numeric output), or None
     (host path).  With dictionary-ENCODED string keys in leaf 0, only
     a provable value-leaf subscript (index >= 1) qualifies — anything
-    that could read leaf 0 would order by the raw ids."""
+    that could read leaf 0 would order by the raw ids.
+
+    Traced INT key expressions qualify only with `col_ranges` (exact
+    per-column min/max of the batch): the interval probe re-executes
+    the expression over those ranges in exact Python ints and admits it
+    only when no intermediate can leave int64 — the device then
+    computes the same exact value the host would (overflow-risk keys
+    keep the host path, pinned by test_top_int_key_expression_falls_
+    back)."""
     import jax.tree_util as jtu
     nl = len(specs)
     if key is None:
@@ -149,14 +256,18 @@ def classify_top_key(key, treedef, specs, encoded):
     try:
         fn = _row_fn(key, treedef)
         out = jax.eval_shape(fn, *_spec_struct(specs))
-        # FLOAT outputs only: the host computes key expressions in
-        # exact Python ints while the device wraps at i64 — an
-        # integer key that overflows would silently reorder (review
-        # finding).  Float arithmetic is IEEE-identical per record on
-        # both sides.  Raw stored int COLUMNS (the "leaf" cases) carry
-        # no arithmetic and stay eligible.
-        if (len(out) == 1 and out[0].shape == ()
-                and np.dtype(out[0].dtype).kind == "f"):
+        if len(out) != 1 or out[0].shape != ():
+            return None
+        kind = np.dtype(out[0].dtype).kind
+        # FLOAT outputs ride unconditionally: float arithmetic is
+        # IEEE-identical per record on both sides.  INT outputs ride
+        # only past the ranged probe: the host computes exact Python
+        # ints while the device wraps at i64 — an integer key that
+        # overflows would silently reorder (review finding).
+        if kind == "f":
+            return ("fn", key)
+        if kind == "i" and _ranged_int_key_ok(key, treedef, specs,
+                                              col_ranges):
             return ("fn", key)
     except Exception:
         pass
@@ -212,26 +323,35 @@ class MapOp:
 
 
 class SortOp:
-    """Per-partition sort by the key leaf (backs sortByKey's final
-    mapPartitions(_SortPartFn) on device)."""
+    """Per-partition sort by the key — one scalar leaf, or every column
+    of a flat tuple key, compared lexicographically like the host's
+    tuple sort (backs sortByKey's final mapPartitions(_SortPartFn) on
+    device)."""
 
     def __init__(self, ascending):
         self.ascending = ascending
+        self.nk = 1
         self.key = ("sort", ascending)
 
     def probe(self, treedef, specs):
-        dt, shape = specs[0]
-        if shape != () or dt.kind not in "if":
-            raise TypeError("sort needs a numeric scalar key leaf")
+        nk = layout.key_width(treedef, specs, kinds="if")
+        if nk is None:
+            raise TypeError("sort needs a numeric scalar (or flat "
+                            "numeric tuple) key")
+        self.nk = nk
+        self.key = ("sort", self.ascending, nk)
         return treedef, specs
 
     def apply(self, leaves, n):
         from dpark_tpu.backend.tpu import collectives
         cap = leaves[0].shape[0]
         valid = jnp.arange(cap) < n
+        # only key column 0 needs the sentinel: padding sorts last on
+        # it alone, and no valid row can carry it (ingest guard)
         k = jnp.where(valid, leaves[0],
                       collectives._sentinel(leaves[0].dtype))
-        packed = collectives._lex_sort((k,) + tuple(leaves[1:]), 1)
+        packed = collectives._lex_sort((k,) + tuple(leaves[1:]),
+                                       self.nk)
         out = [packed[0]] + list(packed[1:])
         if not self.ascending:
             # reverse the valid prefix, keep padding in place
@@ -283,16 +403,21 @@ class SegAggOp:
 
     def __init__(self, kind):
         self.kind = kind
+        self.nk = 1
         self.key = ("segagg", kind)
 
     def probe(self, treedef, specs):
-        import jax.tree_util as jtu
-        if treedef != jtu.tree_structure((0, 0)):
-            raise TypeError("segagg needs flat (k, v) records")
-        (kdt, kshape), (vdt, vshape) = specs
-        if kshape != () or vshape != ():
-            raise TypeError("segagg needs scalar key and value")
-        if kdt.kind not in "if" or vdt.kind not in "if":
+        nk = layout.key_width(treedef, specs, kinds="if")
+        if nk is None or len(specs) != nk + 1:
+            raise TypeError("segagg needs flat (k, v) records (scalar "
+                            "or flat-tuple key, one scalar value)")
+        self.nk = nk
+        self.key = ("segagg", self.kind, nk)
+        vdt, vshape = specs[nk]
+        if vshape != ():
+            raise TypeError("segagg needs a scalar value")
+        if vdt.kind not in "if" or any(dt.kind not in "if"
+                                       for dt, _ in specs[:nk]):
             raise TypeError("segagg needs numeric key and value")
         if self.kind == "count":
             odt = np.dtype(np.int64)
@@ -306,19 +431,24 @@ class SegAggOp:
             odt = np.dtype(np.int64)
         else:
             odt = vdt
-        return treedef, [(kdt, kshape), (odt, ())]
+        return treedef, list(specs[:nk]) + [(odt, ())]
 
     def apply(self, leaves, n):
         from dpark_tpu.backend.tpu import collectives
-        k, v = leaves[0], leaves[1]
+        nk = self.nk
+        k, v = leaves[0], leaves[nk]
         cap = k.shape[0]
         idx = jnp.arange(cap)
         valid = idx < n
         ks = jnp.where(valid, k, collectives._sentinel(k.dtype))
-        # segment ids from sorted-key boundaries; invalid rows land in
-        # segment cap-1, past the n_out valid prefix (when every row is
-        # its own segment there are no invalid rows to misplace)
-        starts = valid & ((idx == 0) | (ks != jnp.roll(ks, 1)))
+        # segment ids from sorted-key boundaries (ANY key column
+        # changing starts a group); invalid rows land in segment cap-1,
+        # past the n_out valid prefix (when every row is its own
+        # segment there are no invalid rows to misplace)
+        changed = ks != jnp.roll(ks, 1)
+        for kc in leaves[1:nk]:
+            changed = changed | (kc != jnp.roll(kc, 1))
+        starts = valid & ((idx == 0) | changed)
         seg = jnp.where(valid, jnp.cumsum(starts.astype(jnp.int32)) - 1,
                         cap - 1)
         n_out = jnp.sum(starts).astype(jnp.int32)
@@ -347,10 +477,14 @@ class SegAggOp:
             # int sums true-divide to f64; float sums keep their width
             # (jax promotion: f32 / i64 -> f32) — both match the host
             agg = agg / jnp.maximum(cnt, 1)
-        # per-segment key: min over the segment (all equal); empty
-        # segments keep the sentinel and sit past the valid prefix
-        out_k = collectives._segment_op("min")(ks, seg, num_segments=cap)
-        return [out_k, agg], n_out
+        # per-segment keys: min over the segment (all equal within a
+        # segment, for every key column); empty segments keep the
+        # sentinel in column 0 and sit past the valid prefix
+        seg_min = collectives._segment_op("min")
+        out_ks = [seg_min(ks, seg, num_segments=cap)]
+        out_ks += [seg_min(kc, seg, num_segments=cap)
+                   for kc in leaves[1:nk]]
+        return out_ks + [agg], n_out
 
 
 class StagePlan:
@@ -371,8 +505,13 @@ class StagePlan:
     def _make_key(self):
         """Structural program identity: same ops/specs/aggregators compile
         to the same XLA program regardless of RDD/stage ids — repeated jobs
-        (benchmark loops, DStream batches) reuse the jit cache."""
-        spec_key = tuple((str(dt), shape) for dt, shape in self.in_specs)
+        (benchmark loops, DStream batches) reuse the jit cache.  The
+        record TREEDEFS are part of the identity: ((k1, k2), v) and
+        (k, (v1, v2)) flatten to the same leaf specs but compile
+        different programs (key width drives the epilogue's hash/sort
+        operand count; the value structure drives the lifted merge)."""
+        spec_key = (tuple((str(dt), shape) for dt, shape in self.in_specs),
+                    str(self.in_treedef), str(self.out_treedef))
         op_keys = tuple(op.key for op in self.ops)
         if self.epilogue is None:
             epi_key = None
@@ -664,6 +803,8 @@ def analyze_text_stage(stage, ndev, executor_or_store):
     plan.group_output = False
     plan.epi_spec = epi_spec
     plan.epi_bounds = epi_bounds
+    plan.epi_nk = 1
+    plan.src_nk = 1
     plan.text_rdd = text_rdd
     plan.text_chain = chain
     plan.encoded_keys = key_is_str
@@ -760,14 +901,25 @@ def _big_text(stage):
             > conf.STREAM_TEXT_BYTES)
 
 
-def _numeric_key(specs):
-    """Key leaf 0 is a numeric scalar (int or float) — enough for range
-    repartitioning and sorting (hash shuffles additionally need int,
-    checked via layout.key_leaf_index)."""
-    if not specs:
-        return False
-    dt, shape = specs[0]
-    return shape == () and dt.kind in "if"
+def _range_bounds_array(bounds, specs, nk):
+    """The RangePartitioner bounds as the device array the range
+    epilogue compares against: 1D cast to the key spec dtype for a
+    scalar key, (len(bounds), nk) for a flat tuple key — requiring one
+    SHARED spec dtype across the key columns (mixed int/float tuple
+    bounds have host bisect semantics no single-dtype device compare
+    reproduces).  None = host fallback."""
+    dt = np.dtype(specs[0][0])
+    if nk == 1:
+        return np.asarray(bounds, dtype=dt)
+    if any(np.dtype(s[0]) != dt for s in specs[1:nk]):
+        return _fallback("range partitioner over a tuple key with "
+                         "mixed column dtypes")
+    if not bounds:
+        return np.zeros((0, nk), dtype=dt)
+    arr = np.asarray(bounds, dtype=dt)
+    if arr.ndim != 2 or arr.shape[1] != nk:
+        return _fallback("range bounds do not match the key width")
+    return arr
 
 
 # a union stage materializes every branch before concatenating on
@@ -832,9 +984,12 @@ def _analyze_union_parent(parent, ndev, executor_or_store, cached_ids,
         else:
             src_combine = True
             try:
+                nk = (meta.get("key_cols")
+                      or layout.key_width(treedef, specs, kinds="if")
+                      or 1)
                 merge_fn = _leaves_merge_fn(
                     dep.aggregator.merge_combiners, treedef)
-                vstructs = _batched_spec_struct(specs[1:])
+                vstructs = _batched_spec_struct(specs[nk:])
                 jax.eval_shape(
                     lambda *v: merge_fn(list(v), list(v)), *vstructs)
             except Exception as e:
@@ -856,9 +1011,13 @@ def _analyze_union_parent(parent, ndev, executor_or_store, cached_ids,
     sub.group_output = False
     sub.epi_spec = None
     sub.epi_bounds = None
+    sub.epi_nk = 1
+    sub.src_nk = (layout.key_width(treedef, specs, kinds="if") or 1) \
+        if source[0] == "hbm" else 1
     sub.logical_spill = False
     sub.reslice = reslice
-    sub.program_key = sub.program_key + (src_combine, False, None)
+    sub.program_key = sub.program_key + (src_combine, False, None,
+                                         sub.src_nk)
     return sub
 
 
@@ -890,20 +1049,29 @@ def _analyze_join_source(join_rdd, ndev, executor_or_store):
         return None
     metas = [hbm_sids[d.shuffle_id] for d in deps]
     samples = []
+    nks = []
     for meta in metas:
-        sample = jtu.tree_unflatten(
-            meta["out_treedef"], list(range(len(meta["out_specs"]))))
-        if not (isinstance(sample, tuple) and len(sample) == 2
-                and sample[0] == 0):
-            return None              # join kernels need (k, v) records
-        if meta["out_specs"][0][1] != ():
+        treedef, specs = meta["out_treedef"], meta["out_specs"]
+        nk = layout.key_width(treedef, specs, kinds="if")
+        if nk is None or len(specs) < nk + 1:
+            return None      # join kernels need (k, v) / ((k...), v)
+        sample = jtu.tree_unflatten(treedef, list(range(len(specs))))
+        if len(sample) != 2:
             return None
         samples.append(sample)
-    joined = (0, (samples[0][1], samples[1][1]))
+        nks.append(nk)
+    if nks[0] != nks[1]:
+        return None              # key widths must agree across sides
+    nk = nks[0]
+    a_key = [np.dtype(dt) for dt, _ in metas[0]["out_specs"][:nk]]
+    b_key = [np.dtype(dt) for dt, _ in metas[1]["out_specs"][:nk]]
+    if a_key != b_key:
+        return None              # id-vs-int equality would be spurious
+    joined = (samples[0][0], (samples[0][1], samples[1][1]))
     treedef = jtu.tree_structure(joined)
-    specs = ([metas[0]["out_specs"][0]]
-             + list(metas[0]["out_specs"][1:])
-             + list(metas[1]["out_specs"][1:]))
+    specs = (list(metas[0]["out_specs"][:nk])
+             + list(metas[0]["out_specs"][nk:])
+             + list(metas[1]["out_specs"][nk:]))
     return treedef, specs, (deps[0], deps[1])
 
 
@@ -911,8 +1079,10 @@ def analyze_stage(stage, ndev, executor_or_store):
     """Decide whether `stage` can run on the array path; build its plan.
 
     executor_or_store: the JAXExecutor (HBM shuffle store + result cache)
-    or a bare shuffle-store dict.  Returns StagePlan or None (fallback).
+    or a bare shuffle-store dict.  Returns StagePlan or None (fallback;
+    last_fallback_reason() explains key-shape declines).
     """
+    _last_fallback[0] = None
     hbm_sids = getattr(executor_or_store, "shuffle_store",
                        executor_or_store)
     cached_ids = getattr(executor_or_store, "result_cache_ids",
@@ -935,6 +1105,7 @@ def analyze_stage(stage, ndev, executor_or_store):
 
     # -- source record spec ---------------------------------------------
     reslice = False
+    src_nk = 1
     if source_rdd.id in cached_ids:
         meta = executor_or_store.result_cache_meta(source_rdd.id)
         treedef, specs = meta["treedef"], meta["specs"]
@@ -982,6 +1153,8 @@ def analyze_stage(stage, ndev, executor_or_store):
             # sees decoded rows through the export bridge.
             return None
         treedef, specs = meta["out_treedef"], meta["out_specs"]
+        src_nk = (meta.get("key_cols")
+                  or layout.key_width(treedef, specs, kinds="if") or 1)
         if is_list_agg(dep.aggregator):
             # no-combine shuffle (partitionBy/groupByKey): rows pass
             # through flat; bare groupByKey groups at egest time
@@ -1010,7 +1183,7 @@ def analyze_stage(stage, ndev, executor_or_store):
             try:
                 merge_fn = _leaves_merge_fn(
                     dep.aggregator.merge_combiners, treedef)
-                vstructs = _batched_spec_struct(specs[1:])
+                vstructs = _batched_spec_struct(specs[src_nk:])
                 jax.eval_shape(
                     lambda *v: merge_fn(list(v), list(v)), *vstructs)
             except Exception as e:
@@ -1065,6 +1238,7 @@ def analyze_stage(stage, ndev, executor_or_store):
     epilogue = None
     epi_spec = None
     epi_bounds = None
+    epi_nk = 1
     logical_spill = False
     if stage.is_shuffle_map:
         dep = stage.shuffle_dep
@@ -1072,14 +1246,22 @@ def analyze_stage(stage, ndev, executor_or_store):
         if epi_spec is None:
             return None
         if epi_spec[0] == "hash":
-            if layout.key_leaf_index(cur_treedef, cur_specs) is None:
-                return None
+            epi_nk = layout.key_width(cur_treedef, cur_specs, kinds="i")
+            if epi_nk is None:
+                return _fallback(
+                    "hash shuffle needs an int scalar (or flat "
+                    "int-tuple, <= conf.MAX_KEY_LEAVES columns) key")
         else:
-            if not _numeric_key(cur_specs):
+            epi_nk = layout.key_width(cur_treedef, cur_specs,
+                                      kinds="if")
+            if epi_nk is None:
+                return _fallback(
+                    "range shuffle needs a numeric scalar (or flat "
+                    "numeric-tuple) key")
+            epi_bounds = _range_bounds_array(
+                dep.partitioner.bounds, cur_specs, epi_nk)
+            if epi_bounds is None:
                 return None
-            epi_bounds = np.asarray(
-                dep.partitioner.bounds,
-                dtype=np.dtype(cur_specs[0][0]))
         if is_list_agg(dep.aggregator):
             pass                         # no-combine write: rows as-is
         else:
@@ -1091,9 +1273,13 @@ def analyze_stage(stage, ndev, executor_or_store):
             except Exception as e:
                 logger.debug("create_combiner not traceable: %s", e)
                 return None
-            if epi_spec[0] == "hash" and layout.key_leaf_index(
-                    cur_treedef, cur_specs) is None:
-                return None
+            if epi_spec[0] == "hash":
+                epi_nk = layout.key_width(cur_treedef, cur_specs,
+                                          kinds="i")
+                if epi_nk is None:
+                    return _fallback(
+                        "hash shuffle needs an int scalar (or flat "
+                        "int-tuple) key after create_combiner")
         if dep.partitioner.num_partitions > ndev:
             # more logical partitions than devices: only the spilled
             # no-combine stream supports this (rid rides the exchange,
@@ -1114,8 +1300,13 @@ def analyze_stage(stage, ndev, executor_or_store):
     plan.group_output = group_output
     plan.epi_spec = epi_spec
     plan.epi_bounds = epi_bounds
+    plan.epi_nk = epi_nk
+    # key width of the SOURCE records (hbm reduce side): the segment
+    # reduce / no-combine key sort must span every key column — merging
+    # tuple-keyed rows on column 0 alone would mix distinct keys
+    plan.src_nk = src_nk if source[0] == "hbm" else 1
     plan.logical_spill = logical_spill
     plan.reslice = reslice
     plan.program_key = plan.program_key + (
-        src_combine, group_output, epi_spec)
+        src_combine, group_output, epi_spec, epi_nk, plan.src_nk)
     return plan

@@ -320,13 +320,41 @@ def egest(batch):
     return out
 
 
-def key_leaf_index(treedef, specs):
-    """The key of a KV record is leaf 0 of the pytree (records are
-    ``(k, v...)`` tuples); it must be an integer scalar for the device
-    shuffle.  Returns None when the record has no device-hashable key."""
+def key_width(treedef, specs, kinds="i"):
+    """Number of leading KEY COLUMNS of a ``(key, value...)`` record.
+
+    The key is leaf 0 when it is a scalar, or leaves 0..n-1 when it is
+    a FLAT tuple of n scalars (``((k1, ..., kn), v)`` — the composite
+    keys real dpark jobs use: ``((user, item), v)``, ``((src, dst),
+    w)``).  Every key leaf must be a scalar whose dtype kind is in
+    `kinds` ("i" for hash shuffles — portable_hash semantics are only
+    reproduced on device for ints — "if" for range repartitioning).
+    Nested key pytrees, >conf.MAX_KEY_LEAVES columns, or a disabled
+    conf.TUPLE_KEYS return None (host fallback)."""
+    from dpark_tpu import conf
     if not specs:
         return None
-    dt, shape = specs[0]
-    if shape != () or not np.issubdtype(dt, np.integer):
+    sample = jax.tree_util.tree_unflatten(
+        treedef, list(range(len(specs))))
+    if not (isinstance(sample, tuple) and len(sample) >= 2):
         return None
-    return 0
+    key = sample[0]
+    if key == 0:
+        nk = 1
+    elif (conf.TUPLE_KEYS and isinstance(key, tuple)
+          and 2 <= len(key) <= conf.MAX_KEY_LEAVES
+          and all(key[i] == i for i in range(len(key)))):
+        nk = len(key)
+    else:
+        return None
+    for dt, shape in specs[:nk]:
+        if shape != () or dt.kind not in kinds:
+            return None
+    return nk
+
+
+def key_leaf_index(treedef, specs):
+    """Back-compat shim: 0 when the record has a device-hashable key
+    (scalar int leaf 0 — see key_width for the composite-key form),
+    else None."""
+    return 0 if key_width(treedef, specs, kinds="i") == 1 else None

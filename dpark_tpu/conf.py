@@ -159,6 +159,21 @@ MESH_TEST_DEVICES = int(os.environ.get("DPARK_MESH_TEST_DEVICES",
 # runs hot loop #1 on every executor — SURVEY.md 3.1).  0 = cpu count.
 INGEST_THREADS = int(os.environ.get("DPARK_INGEST_THREADS", "0") or 0)
 
+# composite (tuple) keys on the device path: records keyed by a FLAT
+# tuple of up to MAX_KEY_LEAVES numeric scalars — ((user, item), v),
+# ((src, dst), w) — classify onto the array path end to end (hash
+# destinations via the pair-extended phash, sort/segment/combine over
+# all key columns, tuple repacked at egest).  "0" disables (tuple keys
+# then take the host object path, the pre-PR behavior — useful when
+# bisecting).  Nested key tuples and non-numeric key leaves always
+# fall back; the `host-fallback-key` lint rule reports why.
+TUPLE_KEYS = os.environ.get("DPARK_TUPLE_KEYS", "1") != "0"
+
+# widest flat tuple key the device path accepts: each extra key leaf is
+# one more sort operand in every shuffle program, so keep this small
+# (2-3 covers the (user, item) / (src, dst) shapes real jobs use)
+MAX_KEY_LEAVES = int(os.environ.get("DPARK_MAX_KEY_LEAVES", "4") or 4)
+
 # default dtype for device-side values
 DEFAULT_DTYPE = "int32"
 

@@ -85,6 +85,13 @@ class DparkContext:
             from dpark_tpu.schedule import MultiProcessScheduler
             self.scheduler = MultiProcessScheduler(
                 int(arg) if arg else None)
+        elif master == "fleet":
+            # N workdir-distinct inline executors on this host with
+            # locality-aware placement (chunkserver / cached-partition
+            # hints route tasks to the holder)
+            from dpark_tpu.schedule import LocalFleetScheduler
+            self.scheduler = LocalFleetScheduler(
+                int(arg) if arg else 2)
         elif master == "tpu":
             try:
                 from dpark_tpu.backend.tpu import TPUScheduler
@@ -94,8 +101,9 @@ class DparkContext:
                     "(import failed: %s)" % e) from e
             self.scheduler = TPUScheduler(int(arg) if arg else None)
         else:
-            raise ValueError("unknown master %r (local/process/tpu)"
-                             % self.master)
+            raise ValueError(
+                "unknown master %r (local/process/fleet/tpu)"
+                % self.master)
         self.scheduler.start()
         webui = self.options.webui or os.environ.get("DPARK_WEBUI")
         if webui:

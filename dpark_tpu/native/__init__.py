@@ -62,6 +62,10 @@ def get_lib():
         lib.phash_i64_array.restype = None
         lib.phash_i64_array.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.phash_i64_cols.restype = None
+        lib.phash_i64_cols.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p]
         lib.phash_bytes.restype = ctypes.c_uint32
         lib.phash_bytes.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         lib.crc32c.restype = ctypes.c_uint32
@@ -117,6 +121,25 @@ def phash_i64_bulk(keys):
     for i, k in enumerate(keys.ravel()):
         out.ravel()[i] = portable_hash(int(k))
     return out
+
+
+def phash_i64_cols_bulk(cols):
+    """Composite (tuple-key) uint32 portable hash over N int64 column
+    arrays — C++ when available, phash_np_cols otherwise.  Row i hashes
+    as portable_hash((cols[0][i], ..., cols[-1][i]))."""
+    cols = [np.ascontiguousarray(c, dtype=np.int64) for c in cols]
+    lib = get_lib()
+    if lib is not None and len(cols) >= 1:
+        n = cols[0].size
+        flat = np.concatenate([c.ravel() for c in cols]) \
+            if len(cols) > 1 else cols[0].ravel()
+        flat = np.ascontiguousarray(flat, dtype=np.int64)
+        out = np.empty(n, dtype=np.uint32)
+        lib.phash_i64_cols(flat.ctypes.data, len(cols), n,
+                           out.ctypes.data)
+        return out.reshape(cols[0].shape)
+    from dpark_tpu.utils.phash import phash_np_cols
+    return phash_np_cols(cols)
 
 
 def crc32c(data, crc=0):

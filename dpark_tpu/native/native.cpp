@@ -39,6 +39,25 @@ void phash_i64_array(const int64_t* xs, uint32_t* out, int64_t n) {
     for (int64_t i = 0; i < n; i++) out[i] = phash_i64(xs[i]);
 }
 
+// Composite (tuple) key hash over `ncols` int64 columns laid out
+// contiguously (cols[c*n + i] = column c, row i): portable_hash's own
+// tuple recipe — h = 0x345678; per item h = (h ^ hash(item)) *
+// 0x9E3779B1; fmix32(h ^ ncols) — applied per row.  Bit-identical to
+// phash.py portable_hash((k1, ..., kn)) / phash_np_cols /
+// phash_device_cols, so multi-column shuffle routing agrees across
+// every implementation.
+void phash_i64_cols(const int64_t* cols, int64_t ncols, int64_t n,
+                    uint32_t* out) {
+    if (ncols == 1) { phash_i64_array(cols, out, n); return; }
+    for (int64_t i = 0; i < n; i++) {
+        uint32_t h = 0x345678u;
+        for (int64_t c = 0; c < ncols; c++) {
+            h = (h ^ phash_i64(cols[c * n + i])) * 0x9E3779B1u;
+        }
+        out[i] = fmix32(h ^ (uint32_t)ncols);
+    }
+}
+
 // FNV-1a over bytes + fmix32 finalizer — matches phash.py _hash_bytes.
 uint32_t phash_bytes(const uint8_t* data, int64_t n) {
     uint32_t h = 0x811C9DC5u;

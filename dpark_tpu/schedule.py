@@ -288,6 +288,41 @@ class DAGScheduler:
         if record is not None:
             self._stage_info(record, stage_id).update(kw)
 
+    def fallback_reasons(self):
+        """Every recorded WHY-the-array-path-was-left reason across the
+        job history (the tpu master notes one per declined stage; other
+        masters record none).  Bench artifacts ship this next to the
+        per-phase table so a silent object-path regression is visible
+        in CI."""
+        out = []
+        for rec in self.history:
+            for st in rec.get("stage_info", ()):
+                reason = st.get("fallback_reason")
+                if reason and reason not in out:
+                    out.append(reason)
+        return out
+
+    def phase_table(self):
+        """Per-phase wall-time table of the DEEPEST streamed stage
+        (ingest/tokenize, narrow compute, exchange, spill) plus the
+        executor's host-bridge export total — the bench JSON's
+        `phases` field.  None when no stage streamed."""
+        pipe = self.pipeline_summary()
+        if pipe is None:
+            return None
+        table = {
+            "ingest_tokenize_ms": pipe.get("ingest_ms", 0.0),
+            "narrow_ms": pipe.get("compute_ms", 0.0),
+            "exchange_ms": pipe.get("exchange_ms", 0.0),
+            "spill_ms": pipe.get("spill_ms", 0.0),
+            "export_ms": 0.0,
+        }
+        ex = getattr(self, "executor", None)
+        if ex is not None:
+            table["export_ms"] = round(
+                getattr(ex, "export_seconds", 0.0) * 1e3, 1)
+        return table
+
     def pipeline_summary(self):
         """The overlapped-wave-pipeline snapshot of the DEEPEST streamed
         stage across the job history (most waves), per-wave detail
@@ -394,13 +429,18 @@ class DAGScheduler:
                 tl = self._stage_info(record, task.stage_id) \
                     .setdefault("tasks", [])
                 if len(tl) < 512:
+                    # the host/executor that RAN the task when the
+                    # master records one (locality-aware placement),
+                    # else this process's host
                     tl.append({"p": task.partition,
                                "s": round(_time.time() - started, 3),
-                               "host": env.host,
+                               "host": getattr(task, "_ran_on",
+                                               env.host),
                                "ok": status == "success"})
             if status == "success":
                 result, acc_updates, md_updates = payload
-                self.host_manager.task_succeed_on(env.host)
+                self.host_manager.task_succeed_on(
+                    getattr(task, "_ran_on", env.host))
                 stats = (acc_updates or {}).pop(PROFILE_KEY, None)
                 if stats is not None:
                     if self.profile is None:
@@ -472,7 +512,11 @@ class DAGScheduler:
                     waiting.add(stage)
                     submit_stage(parent)
             else:       # failure
-                self.host_manager.task_failed_on(env.host)
+                # credit the EXECUTOR that ran the task (fleet
+                # placement): blacklist ranking must see failures
+                # against 'exec-N', not this process's hostname
+                self.host_manager.task_failed_on(
+                    getattr(task, "_ran_on", env.host))
                 # losing duplicate of a partition another attempt already
                 # completed: ignore (speculation/retry race), don't count
                 if isinstance(task, ResultTask):
@@ -550,6 +594,84 @@ class LocalScheduler(DAGScheduler):
 
     def default_parallelism(self):
         return 2
+
+
+class InlineExecutor:
+    """One named executor identity on this host, with its own workdir
+    (the unit the locality scheduler places tasks on).  Tasks still run
+    inline in-process — placement, not isolation, is what this models:
+    the executor that ran a task is stamped on it (``task._ran_on``)
+    and lands in the scheduler's per-task host records."""
+
+    def __init__(self, host, workdir):
+        import os as _os
+        self.host = host
+        self.workdir = workdir
+        _os.makedirs(workdir, exist_ok=True)
+        self.tasks_run = 0
+
+    def run(self, task):
+        task._ran_on = self.host
+        self.tasks_run += 1
+        return _run_task_inline(task)
+
+
+class LocalFleetScheduler(DAGScheduler):
+    """Several workdir-distinct InlineExecutors on one host with
+    LOCALITY-AWARE placement (reference: dpark's Mesos offers honoring
+    task.preferredLocations — SURVEY.md 2.1): a task whose
+    preferred_locations() (chunkserver per-chunk hosts, cached-partition
+    holders) name a fleet executor runs THERE; candidates rank through
+    the shared TaskHostManager (blacklisted holders lose the
+    preference); unhinted tasks round-robin.  A successful task on a
+    should_cache RDD records its executor as the partition's holder, so
+    later jobs over the cached RDD chase the data."""
+
+    def __init__(self, executors=2, names=None):
+        super().__init__()
+        names = list(names) if names else [
+            "exec-%d" % i for i in range(int(executors))]
+        if not names:
+            raise ValueError("fleet needs at least one executor")
+        env.start()
+        import os as _os
+        self.executors = [
+            InlineExecutor(n, _os.path.join(env.workdir, "fleet", n))
+            for n in names]
+        self._by_host = {e.host: e for e in self.executors}
+        self._rr = 0
+        self.cache_locs = {}     # (rdd_id, partition) -> executor host
+
+    def _pick_executor(self, task):
+        hints = []
+        key = (task.rdd.id, task.partition)
+        holder = self.cache_locs.get(key)
+        if holder is not None:
+            hints.append(holder)
+        try:
+            hints.extend(task.preferred_locations() or [])
+        except Exception:
+            pass
+        local = [h for h in hints if h in self._by_host]
+        if local:
+            best = self.host_manager.offer_choice(local)
+            if best is not None:
+                return self._by_host[best]
+        ex = self.executors[self._rr % len(self.executors)]
+        self._rr += 1
+        return ex
+
+    def submit_tasks(self, stage, tasks, report):
+        for task in tasks:
+            ex = self._pick_executor(task)
+            status, payload = ex.run(task)
+            if status == "success" \
+                    and getattr(task.rdd, "should_cache", False):
+                self.cache_locs[(task.rdd.id, task.partition)] = ex.host
+            report(task, status, payload)
+
+    def default_parallelism(self):
+        return len(self.executors)
 
 
 def _process_worker(task_bytes, snapshot, environ):

@@ -13,6 +13,13 @@ ways and cross-checked by tests/test_phash.py:
 
 For an int32 key k the partition is  fmix32(u32(k) ^ u32(k >> 31)) % n  on
 every path, so a shuffle planned on host lands where device code expects.
+
+COMPOSITE (tuple) keys reuse portable_hash's own tuple recipe —
+  h = 0x345678; for item: h = (h ^ hash(item)) * 0x9E3779B1; fmix32(h ^ n)
+— as a columnar combine over the per-column int hashes (`phash_np_cols`
+/ `phash_device_cols` / C++ `phash_i64_cols`), so a ((u, i), v) record
+hash-routes to the same partition on the host object path, the jnp
+device path, and the bulk C++ path bit-for-bit.
 """
 
 import struct
@@ -22,6 +29,8 @@ _M2 = 0xC2B2AE35
 _FNV_OFFSET = 0x811C9DC5
 _FNV_PRIME = 0x01000193
 _MASK = 0xFFFFFFFF
+TUPLE_SEED = 0x345678
+TUPLE_MULT = 0x9E3779B1
 
 
 def fmix32(h):
@@ -66,9 +75,9 @@ def portable_hash(obj):
     if t is bytes:
         return _hash_bytes(obj)
     if t is tuple:
-        h = 0x345678
+        h = TUPLE_SEED
         for item in obj:
-            h = ((h ^ portable_hash(item)) * 0x9E3779B1) & _MASK
+            h = ((h ^ portable_hash(item)) * TUPLE_MULT) & _MASK
         return fmix32(h ^ len(obj))
     # fallback: structural hash via pickled bytes (deterministic for the
     # value types that reach partitioners in practice)
@@ -118,6 +127,53 @@ def phash_device(keys):
         lo = k.astype(jnp.uint32)
         hi = (k >> 31).astype(jnp.uint32)      # 0 or 0xFFFFFFFF
     h = lo ^ hi
+    h ^= h >> 16
+    h = h * jnp.uint32(_M1)
+    h ^= h >> 13
+    h = h * jnp.uint32(_M2)
+    h ^= h >> 16
+    return h
+
+
+def _fmix32_np(h):
+    import numpy as np
+    h = h.astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    h = h * np.uint32(_M1)
+    h ^= h >> np.uint32(13)
+    h = h * np.uint32(_M2)
+    h ^= h >> np.uint32(16)
+    return h
+
+
+def phash_np_cols(cols):
+    """Composite (tuple-key) hash of N int column arrays -> uint32
+    array, bit-identical to ``portable_hash((k1, ..., kn))`` per row
+    when every element is a Python int.  The per-column hash is the
+    scalar `phash_np`; columns combine with the tuple recipe."""
+    import numpy as np
+    cols = list(cols)
+    if len(cols) == 1:
+        return phash_np(cols[0])
+    h = np.full(np.asarray(cols[0]).shape, TUPLE_SEED, np.uint32)
+    for c in cols:
+        h = (h ^ phash_np(c)) * np.uint32(TUPLE_MULT)
+    return _fmix32_np(h ^ np.uint32(len(cols)))
+
+
+def phash_device_cols(cols):
+    """Device twin of phash_np_cols: composite hash over int key
+    COLUMNS, matching portable_hash(tuple) bit-for-bit — multi-column
+    shuffle destinations agree across the pure-Python host partitioner,
+    the jnp exchange, and the C++ bulk path (phash_i64_cols)."""
+    import jax.numpy as jnp
+    cols = list(cols)
+    if len(cols) == 1:
+        return phash_device(cols[0])
+    h = jnp.full(cols[0].shape, TUPLE_SEED, jnp.uint32)
+    for c in cols:
+        h = (h ^ phash_device(c)) * jnp.uint32(TUPLE_MULT)
+    h = h ^ jnp.uint32(len(cols))
     h ^= h >> 16
     h = h * jnp.uint32(_M1)
     h ^= h >> 13

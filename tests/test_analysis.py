@@ -37,6 +37,78 @@ def test_monoid_multileaf_fires_on_tuple_values(ctx):
     assert f.severity == "error"
 
 
+def test_host_fallback_key_quiet_on_device_keys(ctx):
+    """Scalar ints AND flat numeric tuple keys ride the array path now
+    — the rule must stay quiet on both."""
+    r = ctx.parallelize([(1, 2), (3, 4)], 2) \
+           .reduceByKey(lambda a, b: a + b)
+    assert "host-fallback-key" not in rules(lint_plan(r))
+    r = ctx.parallelize([((1, 2), 3), ((4, 5), 6)], 2) \
+           .reduceByKey(lambda a, b: a + b)
+    assert "host-fallback-key" not in rules(lint_plan(r))
+
+
+def test_host_fallback_key_fires_on_nested_tuple(ctx):
+    r = ctx.parallelize([(((1, 2), 3), 4)], 2) \
+           .reduceByKey(lambda a, b: a + b)
+    rep = lint_plan(r)
+    assert "host-fallback-key" in rules(rep)
+    [f] = [f for f in rep if f.rule == "host-fallback-key"]
+    assert f.severity == "warn"
+    assert "nested" in f.message
+
+
+def test_host_fallback_key_fires_on_non_numeric_leaf(ctx):
+    r = ctx.parallelize([((1, "a"), 2)], 2) \
+           .reduceByKey(lambda a, b: a + b)
+    rep = lint_plan(r)
+    [f] = [f for f in rep if f.rule == "host-fallback-key"]
+    assert f.severity == "warn"
+    assert "non-numeric key leaf" in f.message
+
+
+def test_host_fallback_key_fires_on_too_wide_tuple(ctx):
+    from dpark_tpu import conf
+    wide = tuple(range(conf.MAX_KEY_LEAVES + 1))
+    r = ctx.parallelize([(wide, 1)], 2).reduceByKey(lambda a, b: a + b)
+    rep = lint_plan(r)
+    [f] = [f for f in rep if f.rule == "host-fallback-key"]
+    assert "MAX_KEY_LEAVES" in f.message
+
+
+def test_host_fallback_key_float_hash_vs_range(ctx):
+    """Float keys fall back on HASH shuffles (no device portable-hash
+    twin) but ride range repartitioning — the rule mirrors both."""
+    r = ctx.parallelize([(1.5, 1), (2.5, 2)], 2) \
+           .reduceByKey(lambda a, b: a + b)
+    rep = lint_plan(r)
+    [f] = [f for f in rep if f.rule == "host-fallback-key"]
+    assert "float key on a hash shuffle" in f.message
+    s = ctx.parallelize([(1.5, 1), (2.5, 2)], 2).sortByKey()
+    assert "host-fallback-key" not in rules(lint_plan(s))
+
+
+def test_host_fallback_key_one_leaf_tuple(ctx):
+    """A 1-leaf tuple is NOT a scalar key — layout.key_width rejects
+    it, so the rule must report it (review finding: the first cut let
+    it through silently)."""
+    r = ctx.parallelize([((1,), 2), ((3,), 4)], 2) \
+           .reduceByKey(lambda a, b: a + b)
+    rep = lint_plan(r)
+    [f] = [f for f in rep if f.rule == "host-fallback-key"]
+    assert "1 leaves" in f.message
+
+
+def test_host_fallback_key_string_is_info(ctx):
+    """String keys are legitimate on the text-source path — the rule
+    reports them at info severity, never warn."""
+    r = ctx.parallelize([("w", 1), ("v", 2)], 2) \
+           .reduceByKey(lambda a, b: a + b)
+    rep = lint_plan(r)
+    [f] = [f for f in rep if f.rule == "host-fallback-key"]
+    assert f.severity == "info"
+
+
 def test_monoid_multileaf_quiet_on_scalar_values(ctx):
     r = ctx.parallelize([(1, 2), (2, 3)], 2) \
            .reduceByKey(lambda a, b: max(a, b))

@@ -61,13 +61,43 @@ def test_top_traced_key_expression(tctx):
 
 def test_top_int_key_expression_falls_back(tctx):
     """An integer key EXPRESSION can exceed i64 on device while the
-    host computes exact Python ints — such keys keep the host path
-    (review finding), and the answer stays right."""
+    host computes exact Python ints — overflow-RISK keys keep the host
+    path (the ranged-int interval probe rejects them), and the answer
+    stays right."""
     rows = [(1, 2 ** 61), (2, 5), (3, 7)]
     r = tctx.parallelize(rows, 2).reduceByKey(lambda a, b: a + b, 2)
     got = r.top(1, key=lambda kv: kv[1] * 100)
     assert "array+top" not in _last_kind(tctx).values()
     assert got == [(1, 2 ** 61)]
+
+
+def test_top_ranged_int_key_rides_device(tctx):
+    """ISSUE 3 satellite: an int key expression whose interval over the
+    batch's actual per-column min/max provably stays inside i64 rides
+    the device — `top(k, key=lambda r: r[1]*1000)` over small ints is
+    the canonical shape.  The device-computed key then equals the
+    host's exact Python int for every record."""
+    r = tctx.parallelize(ROWS, 8).reduceByKey(lambda a, b: a + b, 8)
+    got = r.top(6, key=lambda kv: kv[1] * 1000)
+    assert "array+top" in _last_kind(tctx).values()
+    exp = sorted(ROWS, key=lambda kv: kv[1] * 1000, reverse=True)[:6]
+    assert got == exp
+    # mixed-column affine expression, negative coefficient
+    got = r.top(5, key=lambda kv: kv[1] * 2000 - kv[0])
+    assert "array+top" in _last_kind(tctx).values()
+    exp = sorted(ROWS, key=lambda kv: kv[1] * 2000 - kv[0],
+                 reverse=True)[:5]
+    assert got == exp
+    # product-of-columns shape x*(K - x): interval arithmetic bounds
+    # the INTERMEDIATES, so it still qualifies at small ranges and
+    # matches the host exactly (a corner check of outputs alone would
+    # not be sound for such shapes).  K=3000 keeps the key injective
+    # on the 0..1008 value set (f(a)==f(b) needs a+b=3000).
+    got = r.top(4, key=lambda kv: kv[1] * (3000 - kv[1]))
+    assert "array+top" in _last_kind(tctx).values()
+    exp = sorted(ROWS, key=lambda kv: kv[1] * (3000 - kv[1]),
+                 reverse=True)[:4]
+    assert got == exp
 
 
 def test_top_extreme_float_keys(tctx):

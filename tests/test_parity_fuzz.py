@@ -12,7 +12,7 @@ import pytest
 OPS = ["map_affine", "filter_mod", "map_swap", "reduce_sum", "reduce_min",
        "reduce_max", "group", "group_agg", "sort", "distinct_keys",
        "count_tail", "union_extra", "host_partitions", "join_dim",
-       "cartesian_dim", "zip_index", "sample_det"]
+       "cartesian_dim", "zip_index", "sample_det", "tuple_key"]
 
 
 def build_program(rng, depth=4):
@@ -65,6 +65,17 @@ def build_program(rng, depth=4):
                 continue
             prog.append(("group_agg", rng.choice([2, 4, 8]),
                          rng.choice(["sum", "len", "min", "max"])))
+            shuffled = True
+        elif op == "tuple_key":
+            # composite ((k1, k2), v) keys through a device shuffle
+            # (reduce/group/sort), keys flattened back to ints after —
+            # the ISSUE 3 tentpole shape under random surroundings
+            if shuffled and rng.random() < 0.5:
+                continue
+            prog.append(("tuple_key", rng.randint(2, 30),
+                         rng.randint(2, 7),
+                         rng.choice(["sum", "min", "group", "sort"]),
+                         rng.choice([2, 4, 8])))
             shuffled = True
         elif op in ("reduce_sum", "reduce_min", "reduce_max", "group",
                     "sort", "distinct_keys"):
@@ -134,6 +145,22 @@ def apply_program(ctx, data, prog):
             r = (r.map(lambda kv, m=ksp: (kv[0] % m - m // 2, kv[1]))
                  .join(ctx.parallelize(dim, 8), nsp)
                  .map(lambda kv: (kv[0], kv[1][0] + kv[1][1])))
+        elif op == "tuple_key":
+            _, m, p, red, nsp = step
+            r = r.map(lambda kv, m=m, p=p:
+                      ((kv[0] % m - m // 2, kv[1] % p), kv[1]))
+            if red == "sum":
+                r = r.reduceByKey(operator.add, nsp)
+            elif red == "min":
+                r = r.reduceByKey(lambda a, b: a if a < b else b, nsp)
+            elif red == "group":
+                r = r.groupByKey(nsp).mapValues(len)
+            else:
+                r = r.sortByKey(numSplits=nsp)
+            # flatten the tuple key back to a collision-free int so any
+            # downstream op keeps its (int, int) record contract
+            # (column 2 is bounded by p <= 7 < 37)
+            r = r.map(lambda kv: (kv[0][0] * 37 + kv[0][1], kv[1]))
     return r
 
 
@@ -308,6 +335,46 @@ def test_tuple_value_reduce_minmax_parity():
             rl = sorted(lctx.parallelize(data, 2)
                         .reduceByKey(fn, 2).collect())
             assert rt == rl, (rt[:3], rl[:3])
+    finally:
+        tctx.stop()
+        lctx.stop()
+
+
+def test_tuple_key_parity_small_mesh():
+    """Composite (tuple) keys on a 2-device mesh (runs on any box, no
+    full-mesh marker): reduce/group/sort/join over ((k1, k2), v)
+    records match the local golden model exactly, and the shuffle rode
+    the device (ISSUE 3 tentpole, fuzzed deterministic shapes)."""
+    from dpark_tpu import DparkContext
+
+    rng = random.Random(42)
+    data = [((rng.randint(0, 15), rng.randint(-4, 4)),
+             rng.randint(-500, 500)) for _ in range(3000)]
+    dim = [((rng.randint(0, 15), rng.randint(-4, 4)),
+            rng.randint(0, 99)) for _ in range(400)]
+
+    tctx = DparkContext("tpu:2")
+    lctx = DparkContext("local")
+    tctx.start()
+    try:
+        def both(make):
+            return (sorted(make(tctx)), sorted(make(lctx)))
+
+        got, exp = both(lambda c: c.parallelize(data, 2)
+                        .reduceByKey(operator.add, 2).collect())
+        assert got == exp
+        assert tctx.scheduler.executor.shuffle_store, \
+            "tuple-key reduce did not ride the device"
+        got, exp = both(lambda c: [
+            (k, sorted(v)) for k, v in
+            c.parallelize(data, 2).groupByKey(2).collect()])
+        assert got == exp
+        st = tctx.parallelize(data, 2).sortByKey(numSplits=2).collect()
+        sl = lctx.parallelize(data, 2).sortByKey(numSplits=2).collect()
+        assert [k for k, _ in st] == [k for k, _ in sl]
+        got, exp = both(lambda c: c.parallelize(data, 2)
+                        .join(c.parallelize(dim, 2), 2).collect())
+        assert got == exp
     finally:
         tctx.stop()
         lctx.stop()

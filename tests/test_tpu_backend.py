@@ -478,9 +478,10 @@ def test_device_join_tuple_values(tctx):
     assert got == expect
 
 
-def test_tuple_key_join_falls_back(tctx):
-    """Composite (tuple) keys cannot ride the device join; the cogroup/
-    host fallback must still produce exact results."""
+def test_tuple_key_join_rides_device(tctx):
+    """Composite (tuple) keys now ride the device join end to end (the
+    lexicographic key-match kernels): exact results vs the local golden
+    model, with the join-source stage all-array."""
     a = tctx.parallelize([((i % 3, i % 2), i) for i in range(24)], 8)
     b = tctx.parallelize([((i % 3, i % 2), -i) for i in range(12)], 8)
     got = sorted(a.join(b, 8).collect())
@@ -491,6 +492,86 @@ def test_tuple_key_join_falls_back(tctx):
         .join(lctx.parallelize([((i % 3, i % 2), -i) for i in range(12)],
                                8), 8).collect())
     assert got == expect
+    kinds = _stage_kinds(tctx)
+    assert kinds.get("FlatMappedValuesRDD") == "array", kinds
+
+
+def test_tuple_key_all_array_stage_kinds(tctx):
+    """The ISSUE 3 acceptance shape: reduceByKey / groupByKey /
+    sortByKey over 2-int-tuple keys run with ALL-ARRAY stage kinds (no
+    object fallback — tuple keys were the widest silent host-fallback
+    trigger), with exact parity vs the local golden model."""
+    import random
+    from dpark_tpu import DparkContext
+    rng = random.Random(21)
+    data = [((rng.randint(0, 40), rng.randint(-7, 7)),
+             rng.randint(-1000, 1000)) for _ in range(4000)]
+    lctx = DparkContext("local")
+
+    rt = sorted(tctx.parallelize(data, 8)
+                .reduceByKey(lambda a, b: a + b, 8).collect())
+    rl = sorted(lctx.parallelize(data, 8)
+                .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert rt == rl
+    kinds = _stage_kinds(tctx)
+    assert set(kinds.values()) == {"array"}, kinds
+
+    gt = sorted((k, sorted(v)) for k, v in
+                tctx.parallelize(data, 8).groupByKey(8).collect())
+    gl = sorted((k, sorted(v)) for k, v in
+                lctx.parallelize(data, 8).groupByKey(8).collect())
+    assert gt == gl
+    kinds = _stage_kinds(tctx)
+    assert set(kinds.values()) == {"array"}, kinds
+
+    st = tctx.parallelize(data, 8).sortByKey(numSplits=8).collect()
+    sl = lctx.parallelize(data, 8).sortByKey(numSplits=8).collect()
+    assert [k for k, _ in st] == [k for k, _ in sl]
+    kinds = _stage_kinds(tctx)
+    assert set(kinds.values()) == {"array"}, kinds
+    # descending too (the reversal keeps the lexicographic order)
+    sd = tctx.parallelize(data, 8).sortByKey(
+        ascending=False, numSplits=8).collect()
+    ld = lctx.parallelize(data, 8).sortByKey(
+        ascending=False, numSplits=8).collect()
+    assert [k for k, _ in sd] == [k for k, _ in ld]
+
+
+def test_tuple_key_partition_matches_host_partitioner(tctx):
+    """Device-routed tuple keys land in the partition the HOST
+    HashPartitioner computes (the pair-extended phash contract) —
+    lookup() trusts get_partition to find device-shuffled rows."""
+    from dpark_tpu.dependency import HashPartitioner
+    data = [((i % 11, i % 3), i) for i in range(600)]
+    r = tctx.parallelize(data, 8).reduceByKey(lambda a, b: a + b, 8)
+    expect = {}
+    for k, v in data:
+        expect[k] = expect.get(k, 0) + v
+    for key in list(expect)[:8]:
+        assert r.lookup(key) == [expect[key]], key
+
+
+def test_tuple_key_sentinel_column_falls_back(tctx):
+    """A tuple key whose FIRST column carries the reserved sentinel
+    value still produces exact results (host path, like scalar keys)."""
+    pairs = [((2**63 - 1, 1), 1)] * 4 + [((3, 1), 1)] * 4
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    assert got == {(2**63 - 1, 1): 4, (3, 1): 4}
+
+
+def test_nested_tuple_key_stays_on_host(tctx):
+    """Only FLAT numeric tuples ride the device: a nested key keeps the
+    object path and exact results."""
+    pairs = [(((i % 3, i % 2), i % 2), 1) for i in range(48)]
+    got = dict(tctx.parallelize(pairs, 8)
+               .reduceByKey(lambda a, b: a + b, 8).collect())
+    expect = {}
+    for k, v in pairs:
+        expect[k] = expect.get(k, 0) + v
+    assert got == expect
+    kinds = _stage_kinds(tctx)
+    assert kinds.get("ShuffledRDD") != "array", kinds
 
 
 def test_single_device_mesh_fast_path():

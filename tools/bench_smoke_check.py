@@ -1,9 +1,12 @@
 #!/usr/bin/env python
-"""Bench smoke gate (ISSUE 2 satellite): run bench.py at tiny sizes on
-the emulated CPU mesh and assert every emitted JSON line parses AND the
-out-of-core line carries the overlapped-wave-pipeline fields
-(ingest/compute/exchange/spill ms, device-idle fraction).  This is a
-SCHEMA gate, not a performance gate — CI machines are too noisy to
+"""Bench smoke gate (ISSUE 2 satellite; extended for ISSUE 3): run
+bench.py at tiny sizes on the emulated CPU mesh and assert every
+emitted JSON line parses AND the out-of-core line carries the
+overlapped-wave-pipeline fields (ingest/compute/exchange/spill ms,
+device-idle fraction), the per-phase wall-time table (`phases`:
+ingest/tokenize, narrow, exchange, spill, export), and the
+`fallback_reasons` list (why any stage left the array path).  This is
+a SCHEMA gate, not a performance gate — CI machines are too noisy to
 grade throughput, but a refactor that silently drops the pipeline
 metrics (or breaks the bench's JSON contract) fails here.
 
@@ -19,6 +22,11 @@ import sys
 PIPELINE_FIELDS = ("waves", "ingest_ms", "compute_ms", "exchange_ms",
                    "spill_ms", "device_idle_frac", "pipeline_depth",
                    "donated")
+
+# per-phase wall-time table (ISSUE 3 satellite): the streamed run must
+# report where its time went, phase by phase
+PHASE_FIELDS = ("ingest_tokenize_ms", "narrow_ms", "exchange_ms",
+                "spill_ms", "export_ms")
 
 
 def main():
@@ -80,10 +88,27 @@ def main():
         print("FAIL: expected a multi-wave stream, got waves=%r"
               % (pipe["waves"],))
         return 1
-    print("OK: %d JSON lines, ooc pipeline fields present "
-          "(waves=%d idle=%.3f depth=%d donated=%s)"
+    phases = ooc[0].get("phases")
+    if not isinstance(phases, dict):
+        print("FAIL: ooc line carries no phases dict: %r"
+              % sorted(ooc[0]))
+        return 1
+    missing = [f for f in PHASE_FIELDS if f not in phases]
+    if missing:
+        print("FAIL: phases dict missing %r (got %r)"
+              % (missing, sorted(phases)))
+        return 1
+    if "fallback_reasons" not in ooc[0] \
+            or not isinstance(ooc[0]["fallback_reasons"], list):
+        print("FAIL: ooc line carries no fallback_reasons list: %r"
+              % sorted(ooc[0]))
+        return 1
+    print("OK: %d JSON lines, ooc pipeline+phases fields present "
+          "(waves=%d idle=%.3f depth=%d donated=%s narrow=%.0fms "
+          "fallbacks=%d)"
           % (len(parsed), pipe["waves"], pipe["device_idle_frac"],
-             pipe["pipeline_depth"], pipe["donated"]))
+             pipe["pipeline_depth"], pipe["donated"],
+             phases["narrow_ms"], len(ooc[0]["fallback_reasons"])))
     return 0
 
 
