@@ -256,6 +256,76 @@ def _tuple_phase():
          "pairs": n, "array_path": "array" in kinds}), flush=True)
 
 
+def _groupmap_fn(vs):
+    """The A/B's per-group consumer: a second-moment accumulator —
+    traceable + zero-pad-invariant but NOT one of the five provable
+    aggregates, so only the ISSUE 4 segmented apply keeps it on
+    device.  Natural host code too — the same callable folds Python
+    lists on the object path."""
+    return sum(3 * v * v + 2 * v for v in vs)
+
+
+def _groupmap_phase():
+    """Child-process entry: device segmented apply A/B (ISSUE 4
+    acceptance) — the SAME groupByKey().mapValues(traceable per-group
+    fn) job with conf.SEG_MAP on (SegMapOp: all-array, no host bridge)
+    vs off (the pre-PR host object path through the export bridge).
+    The ratio is the regression gate: >= 5x on the 2-dev CPU mesh."""
+    import numpy as np
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import Columns, DparkContext, conf
+    n = min(N_PAIRS, int(os.environ.get("BENCH_GROUPMAP_PAIRS",
+                                        2_000_000)))
+    nkeys = min(N_KEYS, max(16, n // 64))
+    i = np.arange(n, dtype=np.int64)
+    k = (i * 2654435761) % nkeys
+    data = Columns(k, i & 0xFFFF)
+    ctx = DparkContext("tpu")
+    ctx.start()
+    ndev = ctx.scheduler.executor.ndev
+
+    def run():
+        t0 = time.perf_counter()
+        cnt = (ctx.parallelize(data, ndev).groupByKey(ndev)
+               .mapValues(_groupmap_fn).count())
+        assert cnt == min(nkeys, n), cnt
+        wall = time.perf_counter() - t0
+        # the CONSUME stage's own seconds: both sides share the same
+        # device no-combine shuffle write, so the whole-job wall would
+        # dilute the quantity under test (segmented apply vs the
+        # object path's export-bridge + per-group Python fold)
+        rec = ctx.scheduler.history[-1]
+        consume = sum((st.get("seconds") or 0.0)
+                      for st in rec.get("stage_info", ())
+                      if not st.get("shuffle"))
+        return wall, consume
+
+    conf.SEG_MAP = True
+    run()                               # warm-up compiles
+    t_dev, c_dev = min(run() for _ in range(2))
+    # EVERY stage of the device-side job must be array-kind — a
+    # "kind contains array anywhere" check is vacuously true (the
+    # shuffle write always rides) and would let a consume-stage
+    # fallback measure host-vs-host unnoticed
+    rec = ctx.scheduler.history[-1]
+    array_path = bool(rec.get("stage_info")) and all(
+        str(st.get("kind", "")).startswith("array")
+        for st in rec["stage_info"])
+    conf.SEG_MAP = False
+    try:
+        t_host, c_host = min(run() for _ in range(2))
+    finally:
+        conf.SEG_MAP = True
+    ctx.stop()
+    print("GROUPMAP_RESULT %s" % json.dumps(
+        {"t_device": c_dev, "t_host": c_host,
+         "wall_device": t_dev, "wall_host": t_host, "ndev": ndev,
+         "pairs": n, "keys": nkeys,
+         "device_array_path": array_path}), flush=True)
+
+
 # BASELINE config #2: join/cogroup of two keyed RDDs (TPC-H
 # lineitem⋈orders subset shape: big fact table, smaller key table,
 # every fact key hits).  Sizes are row counts; device default rises.
@@ -595,6 +665,9 @@ def main():
     if "--tuple-only" in sys.argv:
         _tuple_phase()
         return
+    if "--groupmap-only" in sys.argv:
+        _groupmap_phase()
+        return
     if "--stream-only" in sys.argv:
         _stream_phase()
         return
@@ -739,6 +812,30 @@ def main():
             if emulated:
                 tout["emulated_cpu_mesh"] = True
             print(json.dumps(tout))
+    # segmented-apply A/B (ISSUE 4 acceptance): the same grouped
+    # consumer on the device SegMapOp path vs the host object path —
+    # the widest remaining host pocket after tuple keys went device
+    if os.environ.get("BENCH_GROUPMAP", "1") != "0":
+        got = _run_child("--groupmap-only", child_timeout,
+                         env=extra_env, ok_prefix="GROUPMAP_RESULT ")
+        if got is not None:
+            gm = json.loads(got)
+            gout = {"metric": _suffix("group_mapvalues_device_vs_host"),
+                    # consume-stage seconds: both sides share the same
+                    # device shuffle write, so the ratio isolates the
+                    # segmented apply vs the object path
+                    "value": round(gm["t_host"]
+                                   / max(gm["t_device"], 1e-9), 3),
+                    "unit": "x (higher is better; >=5 passes)",
+                    "t_device_s": round(gm["t_device"], 3),
+                    "t_host_s": round(gm["t_host"], 3),
+                    "wall_device_s": round(gm["wall_device"], 3),
+                    "wall_host_s": round(gm["wall_host"], 3),
+                    "pairs": gm["pairs"], "chips": gm["ndev"],
+                    "device_rode_array_path": gm["device_array_path"]}
+            if emulated:
+                gout["emulated_cpu_mesh"] = True
+            print(json.dumps(gout))
     if not extras:
         return
     # third line: join/cogroup, BASELINE config #2

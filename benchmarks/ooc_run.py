@@ -75,9 +75,68 @@ def run_sortgroup(ctx, gb, n_parts, reduce_parts=64):
     return {"sort_head": first_keys, "groups": g.count()}
 
 
+def _ooc_group_fn(vs):
+    """Traceable, zero-pad-invariant, NOT a provable aggregate: only
+    the ISSUE 4 segmented apply keeps this grouped consumer on device."""
+    return sum(v * v for v in vs)
+
+
+def run_groupmap(ctx, gb, n_parts, reduce_parts=None):
+    """Streamed variant of the bench.py group_mapvalues A/B: the
+    no-combine groupByKey write runs through the spilled-run wave
+    stream (chunked waves, key-sorted runs on disk), then the SAME
+    mapValues(traceable fn) consumer runs once with conf.SEG_MAP on
+    (the premerged runs load back as a device batch and the segmented
+    apply answers all-array) and once with it off (the pre-PR host
+    export-bridge path)."""
+    import numpy as np
+    from dpark_tpu import Columns, conf
+    if os.environ.get("DPARK_TPU_PLATFORM") == "cpu":
+        conf.STREAM_CHUNK_ROWS = 1 << 20
+    ctx.start()
+    ex = getattr(ctx.scheduler, "executor", None)
+    if reduce_parts is None:
+        # the seg consume only rides with r <= mesh size; defaulting
+        # past the mesh would silently measure host-vs-host
+        reduce_parts = ex.ndev if ex is not None else 8
+    n = int(gb * (1 << 30)) // 16         # two int64 columns
+    keys = (np.arange(n, dtype=np.int64) * 2654435761) % 100_000
+    vals = np.arange(n, dtype=np.int64) & 0xFFFF
+    data = Columns(keys, vals)
+
+    def once():
+        t0 = time.time()
+        cnt = (ctx.parallelize(data, n_parts)
+               .groupByKey(reduce_parts)
+               .mapValues(_ooc_group_fn).count())
+        return time.time() - t0, cnt
+
+    conf.SEG_MAP = True
+    t_dev, groups = once()
+    # every stage of the device-side job must be array-kind (a
+    # contains-"array" check over all stages is vacuously true)
+    rec = ctx.scheduler.history[-1]
+    dev_array = bool(rec.get("stage_info")) and all(
+        str(st.get("kind", "")).startswith("array")
+        for st in rec["stage_info"])
+    conf.SEG_MAP = False
+    try:
+        t_host, groups_host = once()
+    finally:
+        conf.SEG_MAP = True
+    assert groups == groups_host, (groups, groups_host)
+    return {"groups": groups,
+            "groupmap_device_s": round(t_dev, 1),
+            "groupmap_host_s": round(t_host, 1),
+            "groupmap_device_array_path": dev_array,
+            "groupmap_device_vs_host": round(t_host
+                                             / max(t_dev, 1e-9), 2)}
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", choices=["wordcount", "sortgroup"],
+    ap.add_argument("--config", choices=["wordcount", "sortgroup",
+                                         "groupmap"],
                     default="wordcount")
     ap.add_argument("--master", default="tpu")
     ap.add_argument("--gb", type=float, default=10.0)
@@ -108,6 +167,8 @@ def main():
         out["gen_s"] = round(time.time() - t0, 1)
         t0 = time.time()
         out.update(run_wordcount(ctx, path, args.parts))
+    elif args.config == "groupmap":
+        out.update(run_groupmap(ctx, args.gb, args.parts))
     else:
         out.update(run_sortgroup(ctx, args.gb, args.parts))
     out["wall_s"] = round(time.time() - t0, 1)

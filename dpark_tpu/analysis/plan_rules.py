@@ -18,6 +18,12 @@ shuffle anti-patterns that dominate cost at production scale:
   plan-join-repartition  a cogroup/join whose inputs already share a
                          partitioner, re-exchanged because the join was
                          given a different partition count.
+  host-fallback-group    a groupByKey().mapValues(f) consumer that will
+                         leave the array path, and why (SEG_MAP off,
+                         unsupported value pytree, untraceable or
+                         padding-sensitive per-group function) — the
+                         pre-flight twin of the runtime fallback_reason
+                         the tpu scheduler records per stage.
   monoid-multileaf       reduceByKey/combineByKey with a classified
                          min/max merge over values whose pytree has >1
                          leaf or a non-scalar leaf — the exact round-5
@@ -454,6 +460,95 @@ def _rule_host_fallback_key(r, report):
         return
 
 
+def _rule_host_fallback_group(r, report):
+    """Grouped-value consumers — ``groupByKey().mapValues(f)`` — that
+    will leave the array path, and WHY: the pre-flight twin of
+    fuse._try_seg_map's admission pipeline (the device segmented
+    apply).  Quiet when the chain rides: provable aggregates go
+    through SegAggOp/the combiner rewrite, traceable padding-invariant
+    functions through SegMapOp.  Reported reasons mirror the runtime
+    ``fallback_reason`` exactly: SEG_MAP disabled, unsupported value
+    pytree, data-dependent control flow (AST, no execution), and —
+    only under conf.LINT_PROBE == "deep", because the check EXECUTES
+    the user function on synthetic samples — the exact runtime
+    classifier's non-traceable / not-padding-invariant verdicts."""
+    import numbers
+    from dpark_tpu import conf, rdd as _rdd
+    if not isinstance(r, _rdd.MappedValuesRDD):
+        return
+    prev = r.prev
+    if not isinstance(prev, _rdd.ShuffledRDD):
+        return
+    agg = prev.aggregator
+    if not (agg.create_combiner is _rdd._mk_list
+            and agg.merge_value is _rdd._append
+            and agg.merge_combiners is _rdd._extend):
+        return
+    f = getattr(r, "f", None)
+    if f is None:
+        return
+    state_update = getattr(f, "__dpark_seg_state__", None)
+    f_check = state_update if state_update is not None else f
+    if state_update is None and _classify_segagg(f) is not None:
+        return          # provable aggregate: rides (plan-group-agg
+        #                 separately flags the missed rewrite)
+    reason = None
+    if not getattr(conf, "SEG_MAP", True):
+        reason = ("grouped consumer stays on host: DPARK_SEG_MAP=0")
+    rows = None
+    if reason is None:
+        rows = _peek_source_records(prev.parent)
+        for row in rows or ():
+            if not (isinstance(row, tuple) and len(row) == 2):
+                continue
+            leaves = _value_leaves(row[1])
+            if len(leaves) != 1 or not _leaf_is_scalar(leaves[0]) \
+                    or isinstance(leaves[0], bool) \
+                    or not isinstance(leaves[0], numbers.Number):
+                reason = ("unsupported value pytree for grouped "
+                          "consumption (seg_map needs a single scalar "
+                          "numeric value per record)")
+                break
+    if reason is None:
+        # no-execution check: Python control flow on the group data
+        # cannot trace — the same verdict the runtime's eval_shape
+        # probe reaches, decided from the AST alone
+        try:
+            from dpark_tpu.analysis.closure_rules import lint_function
+            sub = lint_function(f_check, tpu=True)
+            if any(fd.rule == "closure-tracer-branch" for fd in sub):
+                reason = ("per-group function is not traceable "
+                          "(data-dependent Python control flow)")
+        except Exception:
+            pass
+    if reason is None and rows \
+            and getattr(conf, "LINT_PROBE", "shallow") == "deep":
+        import sys
+        if "jax" in sys.modules:
+            try:
+                import numpy as _np
+                from dpark_tpu.backend.tpu import fuse as _fuse
+                vdt = _np.asarray(rows[0][1]).dtype
+                vdt = _np.dtype(_np.int64) if vdt.kind in "iu" \
+                    else _np.dtype(_np.float32)
+                pad, why, _ = _fuse.classify_seg_map(
+                    f_check, vdt, state=state_update is not None)
+                if pad is None:
+                    reason = why
+            except Exception:
+                pass
+    if reason is None:
+        return
+    report.add(
+        "host-fallback-group", "warn", r.scope_name,
+        "this grouped consumer leaves the array path: %s" % reason,
+        "make the per-group function traceable and padding-invariant "
+        "(jnp/arithmetic ops, no data-dependent Python branching; "
+        "sums zero-pad, order statistics repeat-last-pad) or use a "
+        "provable aggregate / reduceByKey — see the README "
+        "device-path support matrix")
+
+
 # ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
@@ -474,6 +569,7 @@ def lint_plan(rdd, master="local", report=None, lineage=None):
         _rule_join_repartition(r, report)
         _rule_monoid_multileaf(r, report)
         _rule_host_fallback_key(r, report)
+        _rule_host_fallback_group(r, report)
     _rule_uncached_reshuffle(lineage, report)
     _rule_wide_depth(rdd, report)
     return report

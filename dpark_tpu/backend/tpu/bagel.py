@@ -187,9 +187,14 @@ class DevicePregel:
         self.e_vals = [put(l) for l in h_evals]
         self.ecnt = put(ecnt)
 
-        # message leaf specs, discovered by tracing `send` once
-        e_structs = [jax.ShapeDtypeStruct((), l.dtype) for l in eleaves]
-        v_structs = [jax.ShapeDtypeStruct((), l.dtype) for l in vleaves]
+        # message leaf specs, discovered by tracing `send` once (the
+        # per-edge/per-vertex structs keep their trailing dims — a
+        # vector vertex state must probe as a vector, or the discovered
+        # message shape collapses to a scalar)
+        e_structs = [jax.ShapeDtypeStruct(l.shape[1:], l.dtype)
+                     for l in eleaves]
+        v_structs = [jax.ShapeDtypeStruct(l.shape[1:], l.dtype)
+                     for l in vleaves]
         out = jax.eval_shape(
             lambda sv, ev, dg: self.send(
                 rewrap(list(sv), self.v_tuple),
@@ -198,9 +203,13 @@ class DevicePregel:
             jax.ShapeDtypeStruct((), np.int64))
         m_leaves, self.m_tuple = as_leaves(out)
         for s in m_leaves:
-            if s.shape != ():
-                raise PregelInputError("message leaves must be scalars")
+            if len(s.shape) > 1:
+                raise PregelInputError("message leaves must be scalars "
+                                       "or 1-D vectors")
         self.msg_dtypes = [np.dtype(s.dtype) for s in m_leaves]
+        # trailing per-message shape of each leaf: () scalars, or (k,)
+        # sum-vector leaves riding as one rank-2 exchange column
+        self.msg_shapes = [tuple(s.shape) for s in m_leaves]
 
         # initial messages, routed to their target's device
         self.init = None
@@ -219,8 +228,9 @@ class DevicePregel:
                 mc = np.bincount(mdev, minlength=ndev)
                 cap_m = layout.round_capacity(int(mc.max() or 1))
                 hm_d = np.full((ndev, cap_m), _SENT, np.int64)
-                hm_v = [np.zeros((ndev, cap_m), dt)
-                        for dt in self.msg_dtypes]
+                hm_v = [np.zeros((ndev, cap_m) + shp, dt)
+                        for dt, shp in zip(self.msg_dtypes,
+                                           self.msg_shapes)]
                 mcnt = np.zeros(ndev, np.int32)
                 for d in range(ndev):
                     m = mdev == d
@@ -287,8 +297,9 @@ class DevicePregel:
                 rewrap(sv, self.v_tuple),
                 rewrap(evs, self.e_tuple) if ne else None, edeg[0])
             m_leaves, _ = as_leaves(msg)
-            m_leaves = [jnp.broadcast_to(jnp.asarray(l), (cap_e,))
-                        for l in m_leaves]
+            m_leaves = [jnp.broadcast_to(jnp.asarray(l),
+                                         (cap_e,) + shp)
+                        for l, shp in zip(m_leaves, self.msg_shapes)]
             dstk = jnp.where(sa, edst[0], collectives._sentinel(jnp.int64))
             packed, cnt = collectives.compact([dstk] + m_leaves, sa)
             kk, vv, counts, offsets = collectives.bucketize_combine(
@@ -354,13 +365,16 @@ class DevicePregel:
                                uk.shape[0] - 1)
                 has = (uk[pos] == ids) & valid_v \
                     & (ids != collectives._sentinel(jnp.int64))
-                msg = [jnp.where(has, u[pos],
+                msg = [jnp.where(collectives._bcast(has, u[pos]),
+                                 u[pos],
                                  monoid_identity(combine, dt))
                        for u, dt in zip(uv, self.msg_dtypes)]
             else:
                 has = jnp.zeros(cap_v, bool)
-                msg = [jnp.full(cap_v, monoid_identity(combine, dt), dt)
-                       for dt in self.msg_dtypes]
+                msg = [jnp.full((cap_v,) + shp,
+                                monoid_identity(combine, dt), dt)
+                       for dt, shp in zip(self.msg_dtypes,
+                                          self.msg_shapes)]
 
             nv_, na_ = self.compute(
                 rewrap(vals, self.v_tuple),

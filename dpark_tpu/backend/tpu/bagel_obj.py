@@ -4,27 +4,45 @@ Replaces the r4 template-in-state columnarizer (VERDICT r4 #4: its
 device subset required degree <= 8, <= 8 degree classes, scalar values,
 and messages only to the vertex's own out-edges).  The lifted design:
 
-* **Class-sliced tracing.**  Vertices are sharded by hash(id) and, per
-  device, grouped into contiguous slices by out-degree.  The user's
-  per-vertex ``compute`` is jax.vmap'd over each class slice with a
-  REAL Python list of that degree's Edge proxies — ``len(outEdges)``
-  stays exact at trace time — so per-class work is proportional to the
-  class size, not the whole graph, and the degree cap rises from 8 to
-  MAX_DEGREE (the number of DISTINCT degrees still bounds compile
-  count; see bagel.MAX_DEGREE_CLASSES).
+* **Bucket-sliced tracing.**  Vertices are sharded by hash(id) and, per
+  device, grouped into contiguous slices by out-degree CLASS.  With
+  ``bagel.DEGREE_BUCKETS`` on (the default) a class is a POWER OF TWO:
+  each vertex's edge list pads to the next power of two with masked
+  dummy edges (target = the padding sentinel, value 0), so an arbitrary
+  degree distribution costs at most ``1 + log2(MAX_DEGREE)`` traces
+  (11 at the default cap) instead of one per distinct degree — the
+  power-law class cap is gone.  Soundness is verified per (class,
+  superstep) by an EXACT-VS-BUCKET CANARY: the user compute runs
+  eagerly on small synthetic slices at exact degrees and at the padded
+  width, and any divergence of vertex values, active flags, or
+  non-dummy messages (plus any ``len(outEdges)`` call, recorded by the
+  traced edge list) falls back to exact degree classes — the r4
+  behavior, still capped by MAX_DEGREE_CLASSES — and from there to the
+  host paths.  The canary is an empirical check on synthetic inputs
+  (the same verification contract as the text tokenizer's sample
+  check): the canonical per-edge message pattern passes because dummy
+  targets carry the sentinel and drop at delivery; computes that fold
+  edge values into vertex state or read the tail diverge on the canary
+  and are rejected.
 * **Messages are data (CSR-style send).**  ``Message.target_id`` may be
   any integer — a traced edge target, a computed id, a constant —
-  because emitted messages leave compute as (dst, value) ARRAYS,
+  because emitted messages leave compute as (dst, value-leaf) ARRAYS,
   flatten across classes into one per-device buffer sized by the total
   message count (not n x max_degree), and route by hash(dst) through
   the same bucketize-combine + all_to_all exchange the shuffle plane
   uses.  Messages to non-neighbors and variable message counts
   (halt-and-send, notify-one) all work; unknown targets drop at
   delivery exactly like the object loop.
-* **Structured vertex values.**  ``Vertex.value`` may be any pytree of
-  numeric scalars/vectors (tuple, dict, nested, np arrays); leaves ride
-  as separate columns.  Message values stay scalar (they feed the
-  monoid combine).
+* **Structured vertex AND message values.**  ``Vertex.value`` may be
+  any pytree of numeric scalars/vectors; ``Message.value`` may be a
+  small numeric pytree too (ISSUE 4 satellite — e.g. a
+  ``(count, sum_vector)`` pair): each leaf rides as one extra exchange
+  column (scalars or small fixed-shape vectors), and the combiner is
+  either a per-leaf monoid (a classified BasicCombiner op over a
+  SINGLE leaf, e.g. ``np.add`` over a vector) or the user's op TRACED
+  as a structure-preserving merge over the leaf tuple (verified at
+  discovery; an op that changes the value structure — tuple ``+`` is
+  host concatenation — stays on the host paths).
 
 Semantics parity with Bagel._run_fast (the host golden model): inactive
 vertices with no mail pass through untouched; only compute-invoked
@@ -53,10 +71,51 @@ logger = get_logger("tpu.bagel_obj")
 AXIS = conf.MESH_AXIS
 _SENT = np.iinfo(np.int64).max
 
+# observability for the degree-bucketing tests: how the LAST
+# DeviceObjectPregel construction classified the graph
+LAST_RUN_STATS = {}
+
 
 def _not_columnar(msg):
     from dpark_tpu.bagel import _NotColumnarizable
     return _NotColumnarizable(msg)
+
+
+class _DegreeDependent(Exception):
+    """Internal: the user compute consults the degree (len(outEdges))
+    or diverges on the exact-vs-bucket canary — buckets are unsound for
+    it; fall back to exact degree classes."""
+
+
+class _EdgeList(list):
+    """The outEdges list handed to compute under BUCKETED tracing: a
+    bucket width is not the true degree, so any len() consultation is
+    recorded and rejects bucketing for this program (exact classes,
+    where len is exact, take over)."""
+
+    def __init__(self, items, cell):
+        super().__init__(items)
+        self._dpark_cell = cell
+
+    def __len__(self):
+        self._dpark_cell["len_used"] = True
+        return super().__len__()
+
+    def __bool__(self):
+        # truthiness is only "any edges?" — every member of a padded
+        # class has >= 1 REAL edge (0-degree vertices sit in the exact
+        # class 0), so emptiness is degree-safe and must NOT flag the
+        # compute as degree-dependent (Vertex.__init__'s `outEdges or
+        # []` would otherwise reject every bucketed program)
+        return list.__len__(self) > 0
+
+
+def _class_width(d, bucketed):
+    """Degree class of a vertex: the exact degree, or the next power of
+    two under bucketing (0 stays 0 — no edges, nothing to pad)."""
+    if not bucketed or d <= 1:
+        return int(d)
+    return 1 << int(d - 1).bit_length()
 
 
 class DeviceObjectPregel:
@@ -67,22 +126,26 @@ class DeviceObjectPregel:
       (the flattened Vertex.value pytree); act (n,) bool; degs (n,)
       int64; tgt_flat (E,) int64 edge targets in per-vertex emission
       order (CSR with offsets = cumsum(degs)); ev_flat: None or (E,)
-      numeric edge values; pend: None or (dst (m,), val (m,)) initial
-      messages; compute: the user's object compute; monoid: the
-      provable BasicCombiner op.
+      numeric edge values; pend: None or (dst (m,), leaf columns,
+      treedef) initial messages; compute: the user's object compute;
+      monoid: the provable BasicCombiner op classification (None when
+      the op must ride as a traced merge); combine_op: the raw op.
     """
 
     def __init__(self, executor, compute, monoid, vdef, ids, vleaves,
-                 act, degs, tgt_flat, ev_flat, pend, max_superstep):
+                 act, degs, tgt_flat, ev_flat, pend, max_superstep,
+                 combine_op=None):
         from dpark_tpu.bagel import PregelInputError
         self.ex = executor
         self.ndev = executor.ndev
         self.mesh = executor.mesh
         self.compute = compute
         self.monoid = monoid
+        self.combine_op = combine_op
         self.vdef = vdef
         self.max_superstep = max_superstep
         self._compiled = {}
+        self._canaried = set()
         n = ids.shape[0]
         if np.unique(ids).shape[0] != n:
             raise PregelInputError("vertex ids must be unique")
@@ -94,18 +157,44 @@ class DeviceObjectPregel:
         self.has_ev = ev_flat is not None
         self.edt = np.dtype(ev_flat.dtype) if self.has_ev else None
 
-        self.classes = sorted(set(degs.tolist())) or [0]
-        self.mdt = self._discover_mdt(pend)
+        from dpark_tpu import bagel as _bagel
+        degs_list = degs.tolist()
+        want_buckets = _bagel.DEGREE_BUCKETS \
+            and len(set(degs_list)) > 1
+        # class selection + message-spec discovery + (bucketed only)
+        # the superstep-0 canary; a degree-dependent compute falls back
+        # to exact classes, re-checking the r4 class-count cap
+        try:
+            self._setup_classes(degs_list, bucketed=want_buckets,
+                                pend=pend)
+        except _DegreeDependent as e:
+            if not want_buckets:
+                raise _not_columnar(str(e))
+            logger.info("degree buckets unsound for this compute "
+                        "(%s); exact degree classes", e)
+            self._setup_classes(degs_list, bucketed=False, pend=pend)
+        LAST_RUN_STATS.clear()
+        LAST_RUN_STATS.update({
+            "bucketed": self.bucketed,
+            "classes": len(self.classes),
+            "widths": list(self.classes),
+            "distinct_degrees": len(set(degs_list)),
+            "msg_leaves": self.nm,
+            "msg_merge": "monoid" if self._mmerge is None else "traced",
+        })
 
         # -- per-(class, device) tables ---------------------------------
         ndev = self.ndev
         vdev = (phash_np(ids) % np.uint32(ndev)).astype(np.int64)
         offs = np.concatenate([[0], np.cumsum(degs)]).astype(np.int64)
+        widths = np.asarray([_class_width(d, self.bucketed)
+                             for d in degs_list], np.int64)
         sh = self._sharding()
         put = lambda a: jax.device_put(a, sh)           # noqa: E731
+        ecap = max(int(tgt_flat.shape[0]) - 1, 0)
         self.tables = []
         for d in self.classes:
-            sel = np.nonzero(degs == d)[0]
+            sel = np.nonzero(widths == d)[0]
             cdev = vdev[sel]
             order = np.argsort(cdev, kind="stable")
             sel = sel[order]
@@ -130,10 +219,18 @@ class DeviceObjectPregel:
                 for h, l in zip(hvl, vleaves):
                     h[dev, :c] = l[s]
                 if d:
-                    eidx = offs[s][:, None] + np.arange(d)[None, :]
-                    htg[dev, :c] = tgt_flat[eidx]
+                    # bucketed classes: each row fills its TRUE degree,
+                    # the tail keeps the sentinel target / zero value
+                    dtrue = degs[s]
+                    col = np.arange(d)[None, :]
+                    eidx = offs[s][:, None] + np.minimum(
+                        col, np.maximum(dtrue[:, None] - 1, 0))
+                    eidx = np.clip(eidx, 0, ecap)
+                    m = col < dtrue[:, None]
+                    htg[dev, :c] = np.where(m, tgt_flat[eidx], _SENT)
                     if self.has_ev:
-                        hev[dev, :c] = ev_flat[eidx]
+                        hev[dev, :c] = np.where(m, ev_flat[eidx],
+                                                np.zeros((), self.edt))
             self.tables.append({
                 "d": d, "cap": cap,
                 "vid": put(vid), "act": put(hact),
@@ -144,13 +241,23 @@ class DeviceObjectPregel:
 
         # -- initial messages, bucketized by hash(dst) -------------------
         self.init = None
+        self.init_count = 0
         if pend is not None and pend[0].size:
-            idst, ivals = pend
+            idst, ivls, imdef = pend
+            if imdef != self.mdef:
+                raise _not_columnar(
+                    "initial message value structure differs from the "
+                    "structure compute emits")
+            for l, dt, shp in zip(ivls, self.mdts, self.mshapes):
+                if tuple(np.asarray(l).shape[1:]) != shp:
+                    raise _not_columnar(
+                        "initial message leaf shape mismatch")
             mdev = (phash_np(idst) % np.uint32(ndev)).astype(np.int64)
             mc = np.bincount(mdev, minlength=ndev)
             cap_m = layout.round_capacity(int(mc.max() or 1))
             hm_d = np.full((ndev, cap_m), _SENT, np.int64)
-            hm_v = np.zeros((ndev, cap_m), self.mdt)
+            hm_v = [np.zeros((ndev, cap_m) + shp, dt)
+                    for dt, shp in zip(self.mdts, self.mshapes)]
             mcnt = np.zeros(ndev, np.int32)
             for dev in range(ndev):
                 m = mdev == dev
@@ -158,35 +265,238 @@ class DeviceObjectPregel:
                 mcnt[dev] = c
                 if c:
                     hm_d[dev, :c] = idst[m]
-                    hm_v[dev, :c] = ivals[m].astype(self.mdt)
-            self.init = (put(mcnt), put(hm_d), put(hm_v))
+                    for hl, l in zip(hm_v, ivls):
+                        hl[dev, :c] = np.asarray(l)[m].astype(hl.dtype)
+            self.init = (put(mcnt), put(hm_d), [put(l) for l in hm_v])
             self.init_count = int(idst.size)
+
+    # ------------------------------------------------------------------
+    # class selection + message-spec discovery
+    # ------------------------------------------------------------------
+    def _setup_classes(self, degs_list, bucketed, pend):
+        from dpark_tpu import bagel as _bagel
+        self.bucketed = bucketed
+        classes = sorted({_class_width(d, bucketed)
+                          for d in degs_list}) or [0]
+        if not bucketed and len(classes) > _bagel.MAX_DEGREE_CLASSES:
+            raise _not_columnar(
+                "%d degree classes > %d (each distinct degree is a "
+                "separate trace)" % (len(classes),
+                                     _bagel.MAX_DEGREE_CLASSES))
+        self.classes = classes
+        # min true degree per class: a class whose members all sit at
+        # the class width has no padding — the canary can skip it
+        self._class_min_deg = {}
+        for d in degs_list:
+            w = _class_width(d, bucketed)
+            cur = self._class_min_deg.get(w)
+            self._class_min_deg[w] = d if cur is None else min(cur, d)
+        self._discover_mspec(pend)
+        self._setup_merge()
+        if bucketed:
+            self._bucket_canary(0)
+
+    def _mail_structs(self, batch=4):
+        return [jax.ShapeDtypeStruct((batch,) + shp, dt)
+                for dt, shp in zip(self.mdts, self.mshapes)]
+
+    def _body_structs(self, d, mail, batch=4, mdts=None, mshapes=None):
+        vs = [jax.ShapeDtypeStruct((batch,) + shp, dt)
+              for dt, shp in zip(self.vdtypes, self.vshapes)]
+        args = vs + [jax.ShapeDtypeStruct((batch,), np.int64),
+                     jax.ShapeDtypeStruct((batch, d), np.int64)]
+        if self.has_ev:
+            args.append(jax.ShapeDtypeStruct((batch, d), self.edt))
+        if mail:
+            mdts = self.mdts if mdts is None else mdts
+            mshapes = self.mshapes if mshapes is None else mshapes
+            args.extend(jax.ShapeDtypeStruct((batch,) + shp, dt)
+                        for dt, shp in zip(mdts, mshapes))
+        args.append(jax.ShapeDtypeStruct((batch,), np.bool_))
+        return args
+
+    def _discover_mspec(self, pend):
+        """Fixed-point discovery of the MESSAGE VALUE SPEC — pytree
+        structure + per-leaf dtype/shape — across ALL classes and both
+        mail variants (a guess would silently truncate, e.g. int state
+        emitting float shares).  Initial messages seed the spec: they
+        feed the same combine and delivery as emitted ones."""
+        import jax.tree_util as jtu
+        if pend is not None and pend[0].size:
+            _, ivls, imdef = pend
+            for l in ivls:
+                if np.asarray(l).dtype.kind not in "if":
+                    raise _not_columnar(
+                        "non-numeric initial message values")
+            spec = (imdef,
+                    [np.asarray(l).dtype for l in ivls],
+                    [tuple(np.asarray(l).shape[1:]) for l in ivls])
+            pure_guess = False
         else:
-            self.init_count = 0
+            guess = np.result_type(
+                *([dt for dt in self.vdtypes if dt.kind in "if"]
+                  or [np.dtype(np.float64)]))
+            spec = (jtu.tree_structure(0), [np.dtype(guess)], [()])
+            pure_guess = True
+        for rnd in range(4):
+            # only the round-0 PURE GUESS may be replaced wholesale by
+            # the first emission; a pend-seeded or settled spec is a
+            # contract emissions must match
+            found = [spec[0], list(spec[1]), list(spec[2]),
+                     not (pure_guess and rnd == 0)]
+            mail_err = None
+            for mail in (False, True):
+                for d in self.classes:
+                    cell = {}
+                    self.mdef, self.mdts, self.mshapes = \
+                        spec[0], list(spec[1]), list(spec[2])
+                    self.nm = len(spec[1])
+                    body = self._class_body(d, 0, mail, cell,
+                                            discovery=True)
+                    try:
+                        jax.eval_shape(jax.vmap(body),
+                                       *self._body_structs(d, mail))
+                    except Exception as e:
+                        from dpark_tpu.bagel import _NotColumnarizable
+                        if isinstance(e, (_NotColumnarizable,
+                                          _DegreeDependent)):
+                            raise
+                        if mail:
+                            # the mail STRUCT may simply be the wrong
+                            # guess this round (compute indexes a tuple
+                            # message while the seed is scalar): retry
+                            # once the no-mail emissions correct it
+                            mail_err = e
+                            continue
+                        raise _not_columnar(
+                            "compute does not trace (%s)" % str(e)[:200])
+                    if self.bucketed and cell.get("len_used"):
+                        raise _DegreeDependent(
+                            "compute consults len(outEdges)")
+                    if "mdef" in cell:
+                        if not found[3]:
+                            # first emission this round: adopt its spec
+                            # wholesale (the seed was only a guess)
+                            found = [cell["mdef"], list(cell["mdts"]),
+                                     list(cell["mshapes"]), True]
+                        elif cell["mdef"] != found[0]:
+                            raise _not_columnar(
+                                "message value structure varies "
+                                "across classes/supersteps")
+                        elif cell["mshapes"] != found[2]:
+                            raise _not_columnar(
+                                "message leaf shapes vary")
+                        else:
+                            found[1] = [np.result_type(a, b)
+                                        for a, b in zip(found[1],
+                                                        cell["mdts"])]
+            found_spec = (found[0], [np.dtype(t) for t in found[1]],
+                          found[2])
+            if found_spec == spec:
+                if mail_err is not None:
+                    raise _not_columnar(
+                        "compute does not trace (%s)"
+                        % str(mail_err)[:200])
+                break
+            spec = found_spec
+        else:
+            raise _not_columnar("message spec does not stabilize")
+        self.mdef, self.mdts, self.mshapes = \
+            spec[0], list(spec[1]), list(spec[2])
+        self.nm = len(self.mdts)
+        for shp in self.mshapes:
+            if len(shp) > 1:
+                raise _not_columnar(
+                    "message leaves must be scalars or 1-D vectors")
 
-        # _discover_mdt's traces double as the early probe: every
-        # unsupported construct in the user compute surfaced there,
-        # before any device state was built
+    def _setup_merge(self):
+        """Choose the message combine: a classified monoid applies
+        PER LEAF only when the value is a single leaf (a bytecode
+        ``a + b`` over a tuple is host concatenation, not elementwise);
+        everything else traces the user's op as a structure-preserving
+        merge over the leaf tuple, used by the same bucketize-combine /
+        segment-reduce call sites."""
+        import jax.tree_util as jtu
+        from dpark_tpu.bagel import PREGEL_MONOIDS
+        if self.nm == 1 and self.monoid in PREGEL_MONOIDS \
+                and not self.mshapes[0]:
+            self._mmerge = None
+            return
+        if self.nm == 1 and self.monoid in PREGEL_MONOIDS \
+                and self.mshapes[0]:
+            # single VECTOR leaf: classified ops (np.add & co) are
+            # elementwise over arrays — the per-leaf monoid is sound
+            self._mmerge = None
+            return
+        op = self.combine_op
+        if op is None:
+            raise _not_columnar("combiner op not a provable monoid")
+        mdef = self.mdef
+        nm = self.nm
 
-    def _sharding(self):
-        return NamedSharding(self.mesh, P(AXIS))
+        def leaf_merge(*flat):
+            a = jtu.tree_unflatten(mdef, list(flat[:nm]))
+            b = jtu.tree_unflatten(mdef, list(flat[nm:]))
+            out = op(a, b)
+            leaves, odef = jtu.tree_flatten(out)
+            if odef != mdef:
+                raise _not_columnar(
+                    "combiner op does not preserve the message value "
+                    "structure (host semantics would differ)")
+            return tuple(leaves)
+
+        vfn = jax.vmap(leaf_merge)
+
+        def merged(va_leaves, vb_leaves):
+            return [l.astype(dt) for l, dt in
+                    zip(vfn(*(list(va_leaves) + list(vb_leaves))),
+                        self.mdts)]
+
+        try:
+            structs = self._mail_structs()
+            outs = jax.eval_shape(lambda *v: merged(
+                list(v[:nm]), list(v[nm:])), *(structs + structs))
+        except Exception as e:
+            from dpark_tpu.bagel import _NotColumnarizable
+            if isinstance(e, _NotColumnarizable):
+                raise
+            raise _not_columnar(
+                "combiner op does not trace over the message leaves "
+                "(%s)" % str(e)[:160])
+        for o, dt, shp in zip(outs, self.mdts, self.mshapes):
+            if tuple(o.shape[1:]) != shp:
+                raise _not_columnar("combiner changes a message leaf "
+                                    "shape")
+        self.monoid = None
+        self._mmerge = merged
+
+    def _ident(self, li):
+        """Filler for 'no message' rows of leaf li: the monoid identity
+        when a monoid combines (absent mail then behaves as the
+        identity at every call site), zeros otherwise (rows without
+        mail take the no-mail trace; the filler value is never read)."""
+        from dpark_tpu.bagel import monoid_identity
+        if self.monoid is not None:
+            return monoid_identity(self.monoid, self.mdts[li])
+        return np.dtype(self.mdts[li]).type(0)
 
     # ------------------------------------------------------------------
     # the per-(class, superstep, mail) traced body
     # ------------------------------------------------------------------
-    def _class_body(self, d, s, mail, cell, mdt=None):
+    def _class_body(self, d, s, mail, cell, discovery=False):
         """Per-vertex fn for jax.vmap over one class slice.  mail=False
         is the object contract's no-mail call (msg is the LITERAL None,
         so ``msg is not None`` branches exactly as on the host paths).
         ``cell["m"]`` reports the static emitted-message count of this
-        trace.  mdt=None puts the body in DISCOVERY mode: emitted
-        dtypes collect into cell["mdt"] instead of being checked."""
+        trace.  discovery=True collects emitted message specs into the
+        cell instead of checking them."""
         from dpark_tpu.bagel import Edge, Message, Vertex
         import jax.tree_util as jtu
         nvl = self.nvl
+        nm = self.nm
         vdef = self.vdef
-        discovery = mdt is None
-        check_mdt = self.mdt if not discovery else None
+        mdef = self.mdef
+        bucketed = self.bucketed
 
         def body(*args):
             i = nvl
@@ -198,11 +508,14 @@ class DeviceObjectPregel:
                 evs = args[i]; i += 1
             m = None
             if mail:
-                m = args[i]; i += 1
+                mleaves = args[i:i + nm]; i += nm
+                m = jtu.tree_unflatten(mdef, list(mleaves))
             a = args[i]
             value = jtu.tree_unflatten(vdef, list(vls))
-            edges = [Edge(tgts[j], evs[j] if evs is not None else None)
-                     for j in range(d)]
+            edge_items = [Edge(tgts[j], evs[j] if evs is not None
+                               else None) for j in range(d)]
+            edges = (_EdgeList(edge_items, cell) if bucketed
+                     else edge_items)
             vert = Vertex(vid, value, edges, a)
             out = self.compute(vert, m, None, s)
             if not (isinstance(out, tuple) and len(out) == 2):
@@ -241,83 +554,218 @@ class DeviceObjectPregel:
                 if td.shape != () or td.dtype.kind not in "iu":
                     raise _not_columnar(
                         "message target must be an integer scalar")
-                mv = jnp.asarray(msg_obj.value)
-                if mv.shape != ():
-                    raise _not_columnar("message values must be scalars")
-                if mv.dtype.kind not in "if":
-                    raise _not_columnar("non-numeric message value")
-                if discovery:
-                    cell["mdt"] = (np.result_type(cell["mdt"], mv.dtype)
-                                   if "mdt" in cell else
-                                   np.dtype(mv.dtype))
-                elif np.result_type(mv.dtype, check_mdt) \
-                        != np.dtype(check_mdt):
+                mleaves, odef = jtu.tree_flatten(msg_obj.value)
+                if not mleaves:
                     raise _not_columnar(
-                        "superstep %d emits %s messages, wider than "
-                        "the discovered %s" % (s, mv.dtype, check_mdt))
+                        "message value has no numeric leaves")
+                marrs = [jnp.asarray(l) for l in mleaves]
+                for arr in marrs:
+                    if arr.dtype.kind not in "if":
+                        raise _not_columnar("non-numeric message value")
+                if discovery:
+                    shapes = [tuple(arr.shape) for arr in marrs]
+                    if "mdef" in cell:
+                        if odef != cell["mdef"] \
+                                or shapes != cell["mshapes"]:
+                            raise _not_columnar(
+                                "message value structure varies "
+                                "within one superstep")
+                        cell["mdts"] = [np.result_type(a, arr.dtype)
+                                        for a, arr in zip(cell["mdts"],
+                                                          marrs)]
+                    else:
+                        cell["mdef"] = odef
+                        cell["mdts"] = [np.dtype(arr.dtype)
+                                        for arr in marrs]
+                        cell["mshapes"] = shapes
+                else:
+                    if odef != mdef:
+                        raise _not_columnar(
+                            "superstep %d emits a different message "
+                            "value structure than discovered" % s)
+                    casted = []
+                    for arr, dt, shp in zip(marrs, self.mdts,
+                                            self.mshapes):
+                        if tuple(arr.shape) != shp:
+                            raise _not_columnar(
+                                "message leaf shape changed at "
+                                "superstep %d" % s)
+                        if np.result_type(arr.dtype, dt) != np.dtype(dt):
+                            raise _not_columnar(
+                                "superstep %d emits %s message leaves, "
+                                "wider than the discovered %s"
+                                % (s, arr.dtype, dt))
+                        casted.append(jnp.asarray(arr, dt))
+                    marrs = casted
                 dsts.append(jnp.asarray(td, jnp.int64))
-                vals.append(jnp.asarray(
-                    mv, check_mdt if not discovery else mv.dtype))
+                vals.append(marrs)
             cell["m"] = len(dsts)
             na = jnp.asarray(nv.active, bool)
             if na.shape != ():
                 raise _not_columnar("Vertex.active must be a scalar")
             md = (jnp.stack(dsts) if dsts
                   else jnp.zeros((0,), jnp.int64))
-            mv_ = (jnp.stack(vals) if vals
-                   else jnp.zeros((0,), check_mdt or jnp.float64))
-            return tuple(outs) + (na, md, mv_)
+            mv_leaves = []
+            for li in range(nm):
+                dt = self.mdts[li]
+                shp = self.mshapes[li]
+                if vals:
+                    mv_leaves.append(jnp.stack(
+                        [v[li] if li < len(v) else jnp.zeros(shp, dt)
+                         for v in vals]))
+                else:
+                    mv_leaves.append(jnp.zeros((0,) + shp, dt))
+            return tuple(outs) + (na, md) + tuple(mv_leaves)
         return body
 
-    def _body_structs(self, d, mdt, mail):
-        vs = [jax.ShapeDtypeStruct((4,) + shp, dt)
-              for dt, shp in zip(self.vdtypes, self.vshapes)]
-        args = vs + [jax.ShapeDtypeStruct((4,), np.int64),
-                     jax.ShapeDtypeStruct((4, d), np.int64)]
-        if self.has_ev:
-            args.append(jax.ShapeDtypeStruct((4, d), self.edt))
-        if mail:
-            args.append(jax.ShapeDtypeStruct((4,), mdt))
-        args.append(jax.ShapeDtypeStruct((4,), np.bool_))
-        return args
+    # ------------------------------------------------------------------
+    # exact-vs-bucket canary
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _canary_draw(rng, dt, shape):
+        """Mixed-sign sample values: a dummy tail's zeros/sentinels are
+        only provably visible when real values can sit on EITHER side
+        of them (max over all-positive edge values equals max with a
+        zero pad — all-positive draws would admit zero-pad-unsound
+        computes; review finding, mirroring fuse._seg_pad_cases)."""
+        if np.dtype(dt).kind == "f":
+            return rng.uniform(-5.0, 5.0, size=shape).astype(dt)
+        return rng.randint(-4, 5, size=shape).astype(dt)
 
-    def _discover_mdt(self, pend):
-        """Fixed-point message-dtype discovery across ALL classes and
-        both mail variants — a guess would silently truncate (e.g. int
-        state emitting float shares).  Initial messages seed the guess:
-        they feed the same combine and delivery as emitted ones."""
-        guess = np.result_type(
-            *( [dt for dt in self.vdtypes if dt.kind in "if"]
-               or [np.dtype(np.float64)] ))
-        if pend is not None and pend[0].size:
-            pdt = np.asarray(pend[1]).dtype
-            if pdt.kind not in "if":
-                raise _not_columnar("non-numeric initial message values")
-            guess = np.result_type(guess, pdt)
-        guess = np.dtype(guess)
-        for _ in range(3):
-            found = guess
-            for d in self.classes:
+    def _canary_rows(self, rng, n, d_true, width):
+        """Synthetic per-vertex inputs at exact degree d_true, plus the
+        same rows padded to `width` with dummy edges (sentinel targets,
+        zero values)."""
+        vids = np.arange(1, n + 1, dtype=np.int64)
+        vals = [self._canary_draw(rng, dt, (n,) + shp)
+                for dt, shp in zip(self.vdtypes, self.vshapes)]
+        tgt_e = rng.randint(1, n + 1,
+                            size=(n, d_true)).astype(np.int64)
+        tgt_b = np.concatenate(
+            [tgt_e, np.full((n, width - d_true), _SENT, np.int64)],
+            axis=1)
+        ev_e = ev_b = None
+        if self.has_ev:
+            ev_e = self._canary_draw(rng, self.edt, (n, d_true))
+            ev_b = np.concatenate(
+                [ev_e, np.zeros((n, width - d_true), self.edt)], axis=1)
+        act = np.ones(n, bool)
+        mleaves = [self._canary_draw(rng, dt, (n,) + shp)
+                   for dt, shp in zip(self.mdts, self.mshapes)]
+        return vids, vals, act, (tgt_e, ev_e), (tgt_b, ev_b), mleaves
+
+    @staticmethod
+    def _same_values(a, b):
+        """Exact equality with NaN == NaN (mixed-sign canary draws can
+        legitimately produce NaN/inf on BOTH sides — e.g. sqrt of a
+        negative — and that must not read as divergence)."""
+        a = np.asarray(a)
+        b = np.asarray(b)
+        if a.shape != b.shape:
+            return False
+        if a.dtype.kind == "f" or b.dtype.kind == "f":
+            return bool(np.array_equal(a.astype(np.float64),
+                                       b.astype(np.float64),
+                                       equal_nan=True))
+        return bool(np.array_equal(a, b))
+
+    @staticmethod
+    def _canary_msgs(outs, nvl, nm):
+        """Per-vertex ordered (dst, leaves...) lists with sentinel
+        (dummy-edge) messages dropped — dropped at delivery in real
+        runs, so they carry no semantics."""
+        md = np.asarray(outs[nvl + 1])
+        leaves = [np.asarray(outs[nvl + 2 + li]) for li in range(nm)]
+        per_vertex = []
+        for i in range(md.shape[0]):
+            row = []
+            for j in range(md.shape[1]):
+                if int(md[i, j]) == _SENT:
+                    continue
+                row.append((int(md[i, j]),
+                            tuple(np.asarray(l[i, j]) for l in leaves)))
+            per_vertex.append(row)
+        return per_vertex
+
+    @classmethod
+    def _canary_msgs_equal(cls, me, mb):
+        if len(me) != len(mb):
+            return False
+        for ra, rb in zip(me, mb):
+            if len(ra) != len(rb):
+                return False
+            for (da, la), (db, lb) in zip(ra, rb):
+                if da != db or len(la) != len(lb):
+                    return False
+                if not all(cls._same_values(x, y)
+                           for x, y in zip(la, lb)):
+                    return False
+        return True
+
+    def _bucket_canary(self, s):
+        """Empirical soundness check of the padded classes at superstep
+        `s`: the user compute, evaluated EAGERLY on small synthetic
+        slices, must produce identical vertex values / active flags and
+        identical non-dummy messages at the exact degree and at the
+        bucket width.  Divergence means the compute reads the dummy
+        tail (or otherwise depends on the padded width) — bucketing is
+        unsound for it."""
+        if not self.bucketed or s in self._canaried:
+            return
+        self._canaried.add(s)
+        for width in self.classes:
+            lb = self._class_min_deg.get(width, width)
+            if width == 0 or lb >= width:
+                continue             # no padded vertex in this class
+            degrees = sorted({lb, (lb + width) // 2, width - 1})
+            rng = np.random.RandomState(0xBA6E1 + 31 * s)
+            for d_true in degrees:
+                if d_true < 1:
+                    continue
+                n = 3
+                (vids, vals, act, (tgt_e, ev_e), (tgt_b, ev_b),
+                 mleaves) = self._canary_rows(rng, n, d_true, width)
                 for mail in (True, False):
-                    cell = {}
-                    body = self._class_body(d, 0, mail, cell, mdt=None)
+                    def run(width_, tgt, ev):
+                        cell = {}
+                        body = self._class_body(width_, s, mail, cell)
+                        args = list(vals) + [vids, tgt]
+                        if self.has_ev:
+                            args.append(ev)
+                        if mail:
+                            args.extend(mleaves)
+                        args.append(act)
+                        return jax.vmap(body)(*args), cell
                     try:
-                        jax.eval_shape(jax.vmap(body),
-                                       *self._body_structs(d, guess,
-                                                           mail))
+                        oe, ce = run(d_true, tgt_e, ev_e)
                     except Exception as e:
-                        from dpark_tpu.bagel import _NotColumnarizable
-                        if isinstance(e, _NotColumnarizable):
-                            raise
-                        raise _not_columnar(
-                            "compute does not trace (%s)" % str(e)[:200])
-                    if "mdt" in cell:
-                        found = np.result_type(found, cell["mdt"])
-            found = np.dtype(found)
-            if found == guess:
-                return found
-            guess = found
-        raise _not_columnar("message dtype does not stabilize")
+                        # the exact-degree trace fails (e.g. compute
+                        # indexes past a small true degree): exact
+                        # classes would fail identically — surface
+                        # through the normal fallback
+                        raise _DegreeDependent(
+                            "compute fails at exact degree %d (%s)"
+                            % (d_true, str(e)[:120]))
+                    ob, cb = run(width, tgt_b, ev_b)
+                    if ce.get("len_used") or cb.get("len_used"):
+                        raise _DegreeDependent(
+                            "compute consults len(outEdges)")
+                    for li in range(self.nvl):
+                        if not self._same_values(oe[li], ob[li]):
+                            raise _DegreeDependent(
+                                "vertex values diverge between exact "
+                                "degree %d and bucket %d at superstep "
+                                "%d" % (d_true, width, s))
+                    if not np.array_equal(np.asarray(oe[self.nvl]),
+                                          np.asarray(ob[self.nvl])):
+                        raise _DegreeDependent(
+                            "active flags diverge under bucketing")
+                    me = self._canary_msgs(oe, self.nvl, self.nm)
+                    mb = self._canary_msgs(ob, self.nvl, self.nm)
+                    if not self._canary_msgs_equal(me, mb):
+                        raise _DegreeDependent(
+                            "non-dummy messages diverge between exact "
+                            "degree %d and bucket %d" % (d_true, width))
 
     # ------------------------------------------------------------------
     # programs
@@ -326,45 +774,50 @@ class DeviceObjectPregel:
         """Bucketize the user's initial messages by hash(dst)."""
         ndev = self.ndev
         monoid = self.monoid
+        mmerge = self._mmerge
+        nm = self.nm
 
-        def per_device(mcnt, mdst, mval):
+        def per_device(mcnt, mdst, *mvals):
+            vs = [v[0] for v in mvals]
             kk, vv, counts, offsets = collectives.bucketize_combine(
-                mdst[0], [mval[0]], mcnt[0], ndev, None, monoid=monoid)
-            out = (counts, offsets, kk, vv[0])
+                mdst[0], vs, mcnt[0], ndev, mmerge, monoid=monoid)
+            out = (counts, offsets, kk) + tuple(vv)
             return tuple(jnp.expand_dims(o, 0) for o in out)
 
         key = ("init",)
         if key not in self._compiled:
             fn = _shard_map(per_device, self.mesh,
-                            in_specs=(P(AXIS),) * 3,
-                            out_specs=(P(AXIS),) * 4)
+                            in_specs=(P(AXIS),) * (2 + nm),
+                            out_specs=(P(AXIS),) * (3 + nm))
             self._compiled[key] = jax.jit(fn)
         return self._compiled[key]
 
     def _p_step(self, s, rounds, slot):
         """One superstep: deliver combined messages to every class
-        slice, run the class-sliced compute, flatten emitted (dst, val)
-        pairs across classes, pre-combine + bucketize them by hash(dst)
-        for the next exchange, and count active vertices and emitted
-        messages."""
+        slice, run the class-sliced compute, flatten emitted (dst,
+        value-leaves) blocks across classes, pre-combine + bucketize
+        them by hash(dst) for the next exchange, and count active
+        vertices and emitted messages."""
         key = ("step", s, rounds, slot)
         if key in self._compiled:
             return self._compiled[key]
+        self._bucket_canary(s)
         ndev = self.ndev
         monoid = self.monoid
-        mdt = self.mdt
+        mmerge = self._mmerge
+        nm = self.nm
         nvl = self.nvl
         ncls = len(self.classes)
         caps = [t["cap"] for t in self.tables]
         degs = [t["d"] for t in self.tables]
         has_ev = self.has_ev
         per_cls_in = 3 + nvl + (1 if has_ev else 0)
-        from dpark_tpu.bagel import monoid_identity
-        ident = monoid_identity(monoid, mdt)
+        idents = [self._ident(li) for li in range(nm)]
+        nleaves = 1 + nm
 
         def per_device(*args):
             # unpack: per class [vid, act, tgts, (evals,) vals...],
-            # then rounds x cnt, rounds x (dst, val) buffers
+            # then rounds x cnt, rounds x (dst, leaf...) buffers
             cls_args = []
             i = 0
             for c in range(ncls):
@@ -377,16 +830,17 @@ class DeviceObjectPregel:
             if rounds:
                 recvs = []
                 for r in range(rounds):
-                    recvs.append([bufs[r * 2][0], bufs[r * 2 + 1][0]])
+                    recvs.append([bufs[r * nleaves + li][0]
+                                  for li in range(nleaves)])
                 flat, mask = collectives.flatten_received(recvs, cnts)
                 uk, uv, _ = collectives.segment_reduce(
-                    flat[0], flat[1:], mask, None, monoid=monoid)
-                uval = uv[0]
+                    flat[0], flat[1:], mask, mmerge, monoid=monoid)
             else:
-                uk = uval = None
+                uk = uv = None
 
             outs = []
-            msg_dsts, msg_vals = [], []
+            msg_dsts = []
+            msg_vals = [[] for _ in range(nm)]
             n_active = jnp.int64(0)
             emitted = jnp.int64(0)
             for c in range(ncls):
@@ -404,20 +858,23 @@ class DeviceObjectPregel:
                     pos = jnp.clip(jnp.searchsorted(uk, vid), 0,
                                    uk.shape[0] - 1)
                     has = (uk[pos] == vid) & valid
-                    msg = jnp.where(has, uval[pos], ident)
+                    msg = [jnp.where(
+                        collectives._bcast(has, u[pos]), u[pos], ident)
+                        for u, ident in zip(uv, idents)]
                 else:
                     has = jnp.zeros(cap, bool)
-                    msg = jnp.full(cap, ident, mdt)
+                    msg = [jnp.full((cap,) + shp, ident, dt)
+                           for dt, shp, ident in zip(self.mdts,
+                                                     self.mshapes,
+                                                     idents)]
                 invoked = (act | has) & valid
 
                 cm, cn = {}, {}
                 margs = vals + [vid, tgts] \
                     + ([evals] if has_ev else [])
-                om = jax.vmap(self._class_body(d, s, True, cm,
-                                               mdt=mdt))(
-                    *(margs + [msg, act]))
-                on = jax.vmap(self._class_body(d, s, False, cn,
-                                               mdt=mdt))(
+                om = jax.vmap(self._class_body(d, s, True, cm))(
+                    *(margs + msg + [act]))
+                on = jax.vmap(self._class_body(d, s, False, cn))(
                     *(margs + [act]))
                 new_vals = []
                 for li in range(nvl):
@@ -428,10 +885,10 @@ class DeviceObjectPregel:
                         vals[li]))
                 new_act = invoked & jnp.where(has, om[nvl], on[nvl])
                 n_active = n_active + jnp.sum(new_act)
-                # emitted (dst, val) blocks: the mail trace's messages
-                # from invoked+has rows, the no-mail trace's from
-                # invoked+~has rows; ungated rows get the sentinel dst
-                # and compact away before the bucketize
+                # emitted (dst, leaves) blocks: the mail trace's
+                # messages from invoked+has rows, the no-mail trace's
+                # from invoked+~has rows; ungated rows get the sentinel
+                # dst and compact away before the bucketize
                 for blk, gate, cell in ((om, invoked & has, cm),
                                         (on, invoked & ~has, cn)):
                     m = cell["m"]
@@ -439,50 +896,57 @@ class DeviceObjectPregel:
                         continue
                     dst_b = jnp.where(gate[:, None], blk[nvl + 1],
                                       _SENT)
-                    val_b = blk[nvl + 2]
                     msg_dsts.append(dst_b.reshape(-1))
-                    msg_vals.append(val_b.reshape(-1).astype(mdt))
+                    for li in range(nm):
+                        leaf = blk[nvl + 2 + li]
+                        msg_vals[li].append(leaf.reshape(
+                            (-1,) + tuple(self.mshapes[li])))
                     emitted = emitted + jnp.sum(gate) * m
                 outs.extend(new_vals)
                 outs.append(new_act)
 
             if msg_dsts:
                 dst_flat = jnp.concatenate(msg_dsts)
-                val_flat = jnp.concatenate(msg_vals)
+                val_flats = [jnp.concatenate(vl) for vl in msg_vals]
                 smask = dst_flat != _SENT
                 packed, cnt = collectives.compact(
-                    [dst_flat, val_flat], smask)
+                    [dst_flat] + val_flats, smask)
                 kk, vv, counts, offsets = collectives.bucketize_combine(
-                    packed[0], packed[1:], cnt, ndev, None,
+                    packed[0], packed[1:], cnt, ndev, mmerge,
                     monoid=monoid)
-                mv = vv[0]
             else:
                 kk = jnp.full((1,), _SENT, jnp.int64)
-                mv = jnp.full((1,), ident, mdt)
+                vv = [jnp.full((1,) + shp, ident, dt)
+                      for dt, shp, ident in zip(self.mdts, self.mshapes,
+                                                idents)]
                 counts = jnp.zeros((ndev,), jnp.int32)
                 offsets = jnp.zeros((ndev,), jnp.int32)
-            outs += [counts, offsets, kk, mv,
-                     jnp.reshape(n_active, (1,)),
-                     jnp.reshape(emitted, (1,))]
+            outs += [counts, offsets, kk] + list(vv) + [
+                jnp.reshape(n_active, (1,)),
+                jnp.reshape(emitted, (1,))]
             return tuple(jnp.expand_dims(o, 0) for o in outs)
 
-        n_in = ncls * per_cls_in + rounds + rounds * 2
-        n_out = ncls * (nvl + 1) + 6
+        n_in = ncls * per_cls_in + rounds + rounds * nleaves
+        n_out = ncls * (nvl + 1) + 5 + nm
         fn = _shard_map(per_device, self.mesh,
                         in_specs=(P(AXIS),) * n_in,
                         out_specs=(P(AXIS),) * n_out)
         self._compiled[key] = jax.jit(fn)
         return self._compiled[key]
 
+    def _sharding(self):
+        return NamedSharding(self.mesh, P(AXIS))
+
     # ------------------------------------------------------------------
     def run(self):
         nvl = self.nvl
-        ncls = len(self.classes)
+        nm = self.nm
         pending = None
         total_msgs = 0
         if self.init is not None:
-            outs = self._p_init()(*self.init)
-            pending = (outs[0], outs[1], outs[2], outs[3])
+            mcnt, mdst, mvals = self.init
+            outs = self._p_init()(mcnt, mdst, *mvals)
+            pending = (outs[0], outs[1], outs[2], list(outs[3:3 + nm]))
             total_msgs = self.init_count
 
         s = 0
@@ -496,7 +960,7 @@ class DeviceObjectPregel:
             if pending is not None and total_msgs > 0:
                 counts, offsets, kk, vv = pending
                 recv_rounds, cnt_rounds, slot = self.ex._exchange_all(
-                    [kk, vv], counts, offsets)
+                    [kk] + vv, counts, offsets)
                 rounds = len(recv_rounds)
                 step = self._p_step(s, rounds, slot)
                 args.extend(cnt_rounds)
@@ -510,12 +974,13 @@ class DeviceObjectPregel:
                 t["vals"] = list(outs[i:i + nvl])
                 t["act"] = outs[i + nvl]
                 i += nvl + 1
-            counts, offsets, kk, mv = outs[i:i + 4]
-            pending = (counts, offsets, kk, mv)
+            counts, offsets, kk = outs[i:i + 3]
+            vv = list(outs[i + 3:i + 3 + nm])
+            pending = (counts, offsets, kk, vv)
             n_active = int(np.asarray(
-                jax.device_get(outs[i + 4])).sum())
+                jax.device_get(outs[i + 3 + nm])).sum())
             total_msgs = int(np.asarray(
-                jax.device_get(outs[i + 5])).sum())
+                jax.device_get(outs[i + 4 + nm])).sum())
             s += 1
             logger.debug("obj superstep %d: active=%d msgs=%d",
                          s, n_active, total_msgs)

@@ -602,15 +602,17 @@ class JAXExecutor:
                 for v, (dt, _) in zip(lv, plan.in_specs)]
 
     def _compile_narrow(self, plan, cap, nleaves_in, in_dtypes=(),
-                        donate=False):
+                        donate=False, extra_key=()):
         """Program A: (counts, [bounds,] in_leaves) -> ops -> result or
         bucketized shuffle output.  Shapes (ndev, cap, ...), dim 0
         sharded.  `donate` hands the input leaves to XLA for in-place
         reuse — STREAMED waves only, where the ingest buffers are dead
         after this program (in-core callers may pass result-cache or
-        shuffle-store leaves, which must survive the call)."""
+        shuffle-store leaves, which must survive the call).
+        `extra_key` extends the program identity for op state decided
+        per run (the SegMapOp bucket layout)."""
         key = ("narrow", plan.program_key, cap, nleaves_in, in_dtypes,
-               donate)
+               donate, extra_key)
         if key in self._compiled:
             return self._compiled[key]
         ops = plan.ops
@@ -877,24 +879,32 @@ class JAXExecutor:
             dep_a, dep_b = plan.source[1]
             batch = self.device_join_batch(dep_a, dep_b)
             return self._run_narrow(plan, batch)
-        if self.shuffle_store[plan.source[1].shuffle_id].get(
-                "pre_reduced"):
+        store = self.shuffle_store[plan.source[1].shuffle_id]
+        if plan.ops and (isinstance(plan.ops[0], fuse.SegMapOp)
+                         or (isinstance(plan.ops[0], fuse.SegAggOp)
+                             and "host_runs" in store)):
+            # segmented apply (and segment aggregates over spilled
+            # runs): two-phase — sort the rows, read the group-size
+            # histogram, compile with the bucket layout
+            return self._run_seg_map(plan)
+        if store.get("pre_reduced"):
             # streamed shuffle already exchanged+combined: device d
             # holds reduce partition d — just run the narrow tail
-            store = self.shuffle_store[plan.source[1].shuffle_id]
             store["seq"] = self._next_seq()
             batch = layout.Batch(store["out_treedef"], store["leaves"],
                                  store["counts"])
             return self._run_narrow(plan, batch)
         return self._run_exchange_and_reduce(plan)
 
-    def _run_narrow(self, plan, batch, bounds=None, donate=False):
+    def _run_narrow(self, plan, batch, bounds=None, donate=False,
+                    extra_key=()):
         """Compile + invoke the narrow stage program on one batch.
         `donate` is for streamed waves only: the batch's leaves are
         dead after this call and XLA may reuse them in place."""
         jitted = self._compile_narrow(
             plan, batch.cap, len(batch.cols),
-            tuple(str(c.dtype) for c in batch.cols), donate=donate)
+            tuple(str(c.dtype) for c in batch.cols), donate=donate,
+            extra_key=extra_key)
         if bounds is None:
             bounds = self._bounds_arg(plan)
         args = (batch.counts,) + ((bounds,) if bounds is not None
@@ -1463,6 +1473,153 @@ class JAXExecutor:
         for r in range(rounds):
             args.extend(recv_rounds[r])
         return reduce_fn(*args)
+
+    # ------------------------------------------------------------------
+    # device segmented apply (fuse.SegMapOp — ISSUE 4 tentpole): an
+    # arbitrary traceable per-group function over groupByKey output
+    # runs as a vmap over power-of-two padded group buckets.  Two-phase
+    # like the device join: sort the rows (exchange, or premerged
+    # spilled runs), read the bucket histogram back, compile the apply
+    # program with that static layout.
+    # ------------------------------------------------------------------
+    def _run_seg_map(self, plan):
+        dep = plan.source[1]
+        store = self.shuffle_store[dep.shuffle_id]
+        store["seq"] = self._next_seq()
+        nk = plan.ops[0].nk if isinstance(plan.ops[0], fuse.SegMapOp) \
+            else getattr(plan, "src_nk", 1) or 1
+        if "host_runs" in store:
+            batch = self._seg_batch_from_runs(store)
+            hist = None
+        else:
+            counts, hist, leaves = self._seg_exchange_sorted(store, nk)
+            batch = layout.Batch(store["out_treedef"], leaves, counts)
+        op = plan.ops[0]
+        extra = ()
+        if isinstance(op, fuse.SegMapOp):
+            op.layout = self._seg_bucket_layout(op.nk, batch, hist)
+            extra = (op.layout,)
+        return self._run_narrow(plan, batch, extra_key=extra)
+
+    def _seg_exchange_sorted(self, store, nk):
+        """The seg path's gather: exchange + key sort, with the bucket
+        HISTOGRAM computed inside the same program — one dispatch and
+        one readback fewer per run than a separate histogram pass."""
+        leaves = store["leaves"]
+        nleaves = len(leaves)
+        recv_rounds, cnt_rounds, slot = self._exchange_all(
+            leaves, store["counts"], store["offsets"])
+        rounds = len(recv_rounds)
+        key = ("seg_gather", rounds, slot, nleaves, nk,
+               tuple(str(l.dtype) for l in leaves))
+        if key not in self._compiled:
+            def per_device(*args):
+                cnts = [c[0] for c in args[:rounds]]
+                bufs = args[rounds:]
+                recvs = []
+                for r in range(rounds):
+                    recvs.append([bufs[r * nleaves + li][0]
+                                  for li in range(nleaves)])
+                flat, mask = collectives.flatten_received(recvs, cnts)
+                packed = collectives._lex_sort(tuple(flat), nk)
+                n = jnp.sum(mask).astype(jnp.int32)
+                hist, _ = collectives.bucket_histogram(
+                    list(packed[:nk]), n)
+                out = (n, hist) + tuple(packed)
+                return tuple(jnp.expand_dims(o, 0) for o in out)
+
+            fn = _shard_map(per_device, self.mesh,
+                            in_specs=(P(AXIS),) * (rounds
+                                                   + rounds * nleaves),
+                            out_specs=(P(AXIS),) * (2 + nleaves))
+            self._compiled[key] = jax.jit(fn)
+        args = list(cnt_rounds)
+        for r in range(rounds):
+            args.extend(recv_rounds[r])
+        outs = self._compiled[key](*args)
+        return outs[0], outs[1], list(outs[2:])
+
+    def _seg_bucket_layout(self, nk, batch, hist=None):
+        """((bucket, width, group_capacity), ...) for the batch's
+        power-of-two group-size classes: read from the gather program's
+        fused histogram when available, else one tiny histogram program
+        (the spilled-run ingest path).  Group capacities round to
+        power-of-two classes so data drift between runs (DStream ticks)
+        reuses compiled apply programs."""
+        if hist is None:
+            cap = batch.cap
+            key = ("seghist", cap, nk,
+                   tuple(str(c.dtype) for c in batch.cols[:nk]))
+            if key not in self._compiled:
+                def per_device(counts, *kcols):
+                    h, _ = collectives.bucket_histogram(
+                        [k[0] for k in kcols], counts[0])
+                    return (jnp.expand_dims(h, 0),)
+                fn = _shard_map(per_device, self.mesh,
+                                in_specs=(P(AXIS),) * (1 + nk),
+                                out_specs=(P(AXIS),))
+                self._compiled[key] = jax.jit(fn)
+            (hist,) = self._compiled[key](batch.counts,
+                                          *batch.cols[:nk])
+        gmax = layout.host_read(hist).max(axis=0)
+        lay = tuple((b, 1 << b, layout.round_capacity(int(g)))
+                    for b, g in enumerate(gmax.tolist()) if g)
+        return lay or ((0, 1, 8),)
+
+    def _partition_run_cols(self, store, rid):
+        """One spilled partition's columns, KEY-SORTED (the background
+        premerger's single run when it got there first, sorted here
+        otherwise) — shared by the export bridge and the seg-map batch
+        loader so the run-reading convention lives once.  None when the
+        partition has no runs."""
+        runs = store["host_runs"]
+        if rid >= len(runs) or not runs[rid]:
+            return None
+        premerge = store.get("premerge")
+        if premerge is not None:
+            paths, presorted = premerge.ensure(rid)
+        else:
+            paths, presorted = runs[rid], False
+        if not paths:
+            return None
+        pieces = [self._read_run(p) for p in paths]
+        cols = [np.concatenate([pt[li] for pt in pieces])
+                for li in range(len(pieces[0]))]
+        if not presorted and len(cols[0]) > 1:
+            nk = min(store.get("key_cols", 1) or 1, len(cols))
+            order = (np.argsort(cols[0], kind="stable") if nk == 1
+                     else np.lexsort(tuple(cols[:nk][::-1])))
+            cols = [c[order] for c in cols]
+        return cols
+
+    def _seg_batch_from_runs(self, store):
+        """Premerged spilled runs -> per-device key-sorted Batch:
+        reduce partition d loads on device d (analyze only admits
+        r <= ndev spilled sources for segment ops).  A whole partition
+        loads at once — groups must be contiguous for the segment scan
+        — so partitions whose columns would blow the HBM budget raise
+        here and the scheduler's object fallback consumes the runs
+        through the (streaming) export bridge instead."""
+        from dpark_tpu.rdd import _ColumnarSlice
+        specs = store["out_specs"]
+        budget = conf.SHUFFLE_HBM_BUDGET // 2
+        total = 0
+        parts = []
+        for d in range(self.ndev):
+            cols = self._partition_run_cols(store, d)
+            if cols is None:
+                parts.append(_ColumnarSlice(
+                    [np.zeros((0,) + shape, dt) for dt, shape in specs]))
+                continue
+            total += sum(int(c.nbytes) for c in cols)
+            if total > budget:
+                raise ValueError(
+                    "spilled partitions (%d MB so far) exceed the "
+                    "seg-map load budget (%d MB): host merge consumes "
+                    "the runs" % (total >> 20, budget >> 20))
+            parts.append(_ColumnarSlice(cols))
+        return layout.ingest(self.mesh, parts, store["out_treedef"],
+                             specs)
 
     # ------------------------------------------------------------------
     # union-source stages (the windowed-stream shape, BASELINE config
@@ -2473,23 +2630,10 @@ class JAXExecutor:
             # whole shuffle exports through map 0.
             if map_id != 0:
                 return []
-            premerge = store.get("premerge")
-            if premerge is not None:
-                paths, presorted = premerge.ensure(reduce_id)
-            else:
-                paths, presorted = store["host_runs"][reduce_id], False
-            if not paths:
+            cols = self._partition_run_cols(store, reduce_id)
+            if cols is None:
                 return []
-            parts = [self._read_run(p) for p in paths]
-            cols = [np.concatenate([pt[li] for pt in parts])
-                    for li in range(len(parts[0]))]
-            if presorted:
-                lists = [c.tolist() for c in cols]
-            else:
-                nk = min(store.get("key_cols", 1) or 1, len(cols))
-                order = (np.argsort(cols[0], kind="stable") if nk == 1
-                         else np.lexsort(tuple(cols[:nk][::-1])))
-                lists = [c[order].tolist() for c in cols]
+            lists = [c.tolist() for c in cols]
             flat2 = jax.tree_util.tree_structure((0, 0))
             treedef = store["out_treedef"]
             if store.get("host_combine"):

@@ -132,6 +132,21 @@ DEVICE_OBJECT_RUN = _os.environ.get("DPARK_BAGEL_DEVICE", "1") != "0"
 MAX_DEGREE_CLASSES = int(_os.environ.get("DPARK_BAGEL_MAX_CLASSES",
                                          "24"))
 MAX_DEGREE = int(_os.environ.get("DPARK_BAGEL_MAX_DEGREE", "1024"))
+# power-of-two degree BUCKETS (ISSUE 4): vertices pad their edge lists
+# to the next power of two with masked dummy edges, so the class count
+# collapses from <= MAX_DEGREE_CLASSES arbitrary degrees to
+# <= 1 + log2(MAX_DEGREE) buckets (11 at the default cap) and the
+# power-law cap disappears.  Soundness is verified per (class,
+# superstep) by an exact-vs-bucket canary (bagel_obj._bucket_canary);
+# degree-dependent computes (len(outEdges), tail reads) fall back to
+# exact degree classes, then to the host paths.  "0" disables.
+DEGREE_BUCKETS = _os.environ.get("DPARK_BAGEL_BUCKETS", "1") != "0"
+# compile-budget guard: each degree class costs two traces (mail /
+# no-mail) per superstep; a graph whose row count (vertices + edges)
+# is below (classes x 2 x this) falls back to the host loop instead of
+# spending more wall time compiling than computing.  0 disables.
+BAGEL_MIN_ROWS_PER_TRACE = int(_os.environ.get(
+    "DPARK_BAGEL_MIN_ROWS_PER_TRACE", "0") or 0)
 
 
 class Bagel:
@@ -262,14 +277,18 @@ class Bagel:
         if aggregator is not None:
             raise _NotColumnarizable("object Aggregator contract")
         if type(combiner) is BasicCombiner:
+            # a provable monoid combines through single-pass segment
+            # scatters; any other op rides IF it traces as a
+            # treedef-preserving merge over the message value pytree
+            # (DeviceObjectPregel verifies at discovery time) — the
+            # per-leaf-monoid-or-traced-merge contract of vector
+            # message values
             monoid = classify_merge(combiner.op)
         elif type(combiner) is Combiner:
             raise _NotColumnarizable("list-combining default Combiner")
         else:
             raise _NotColumnarizable("custom Combiner %r"
                                      % type(combiner).__name__)
-        if monoid not in PREGEL_MONOIDS:
-            raise _NotColumnarizable("combiner op not a provable monoid")
         graph, pend = collected
         n = len(graph)
         if n == 0:
@@ -346,7 +365,11 @@ class Bagel:
 
         ids = np.asarray(ids_l, np.int64)
         degs = np.asarray(deg_l, np.int64)
-        if len(set(deg_l)) > MAX_DEGREE_CLASSES:
+        if not DEGREE_BUCKETS and len(set(deg_l)) > MAX_DEGREE_CLASSES:
+            # with bucketing on, the class-count decision moves into
+            # DeviceObjectPregel: buckets bound the count by
+            # 1 + log2(MAX_DEGREE); only the exact-class FALLBACK
+            # (degree-dependent computes) re-checks this cap
             raise _NotColumnarizable(
                 "%d degree classes > %d (each distinct degree is a "
                 "separate trace)" % (len(set(deg_l)),
@@ -370,18 +393,59 @@ class Bagel:
                                          % ev_flat.dtype)
         pend_cols = None
         if pend:
-            pvals = np.asarray([v for _, v in pend])
-            if pvals.dtype.kind not in "if":
-                raise _NotColumnarizable("non-numeric message value")
+            # initial message VALUES may be any small numeric pytree
+            # (consistent structure): leaves ride as separate columns,
+            # exactly like emitted Message.value leaves
+            mdef0 = None
+            leaf_lists = None
+            for _, v in pend:
+                leaves, mdef = jtu.tree_flatten(v)
+                if mdef0 is None:
+                    mdef0, leaf_lists = mdef, [[] for _ in leaves]
+                elif mdef != mdef0:
+                    raise _NotColumnarizable(
+                        "initial message value structure varies")
+                if not leaves:
+                    raise _NotColumnarizable(
+                        "initial message value has no numeric leaves")
+                for li, leaf in enumerate(leaves):
+                    if isinstance(leaf, bool):
+                        raise _NotColumnarizable(
+                            "non-numeric message value leaf")
+                    leaf_lists[li].append(np.asarray(leaf))
+            try:
+                pleaves = [np.stack(col) for col in leaf_lists]
+            except ValueError:
+                raise _NotColumnarizable(
+                    "initial message leaf shapes vary")
+            for col in pleaves:
+                if col.dtype.kind not in "if":
+                    raise _NotColumnarizable("non-numeric message value")
             pend_cols = (np.asarray([t for t, _ in pend], np.int64),
-                         pvals)
+                         pleaves, mdef0)
+
+        if BAGEL_MIN_ROWS_PER_TRACE:
+            # compile-budget guard: traces ~= 2 x classes (mail +
+            # no-mail) per superstep; buckets bound classes at
+            # 1 + log2(MAX_DEGREE), exact classes at the distinct
+            # count.  Below the budget the host loops win outright.
+            n_classes = (1 + max(int(d).bit_length() for d in
+                                 set(deg_l)) if DEGREE_BUCKETS
+                         else len(set(deg_l))) or 1
+            rows = len(ids_l) + int(tgt_flat.shape[0])
+            if rows < BAGEL_MIN_ROWS_PER_TRACE * 2 * n_classes:
+                raise _NotColumnarizable(
+                    "compile budget: %d graph rows under "
+                    "DPARK_BAGEL_MIN_ROWS_PER_TRACE=%d x ~%d traces"
+                    % (rows, BAGEL_MIN_ROWS_PER_TRACE,
+                       2 * n_classes))
 
         from dpark_tpu.backend.tpu.bagel_obj import DeviceObjectPregel
         try:
             dop = DeviceObjectPregel(
                 ctx.scheduler.executor, compute, monoid, vdef, ids,
                 vleaves, act, degs, tgt_flat, ev_flat, pend_cols,
-                max_superstep)
+                max_superstep, combine_op=combiner.op)
             out_ids, out_leaves, out_act = dop.run()
         except _NotColumnarizable:
             raise
@@ -698,28 +762,34 @@ def _pregel_host(ids, values, edges, compute, send, combine,
     deg = np.bincount(src_idx, minlength=n) if src.size \
         else np.zeros(n, np.int64)
 
-    # message dtypes, discovered by probing `send` on empty slices (the
-    # host twin of the device path's eval_shape)
+    # message dtypes AND trailing shapes (leaves may be scalars or
+    # small fixed-size vectors — the sum-vector exchange), discovered
+    # by probing `send` on empty slices (the host twin of the device
+    # path's eval_shape)
     try:
         probe = send(rewrap([l[:0] for l in vleaves], v_tuple),
                      rewrap([l[:0] for l in eleaves], e_tuple)
                      if eleaves else None, deg[:0])
         m_probe, m_tuple = as_leaves(probe)
         msg_dtypes = [np.asarray(l).dtype for l in m_probe]
+        msg_shapes = [np.asarray(l).shape[1:] for l in m_probe]
     except Exception:
         m_tuple = False
         msg_dtypes = [np.dtype(np.float64)]
+        msg_shapes = [()]
 
     def deliver(pdst, pvals):
         """Combine pending messages per target; unknown targets drop
-        (parity with the object path)."""
+        (parity with the object path).  Vector leaves combine
+        elementwise — the per-leaf monoid."""
         pos = np.searchsorted(ids, pdst)
         pos = np.clip(pos, 0, max(0, n - 1))
         known = ids[pos] == pdst
         pos = pos[known]
         bufs = []
         for l in pvals:
-            buf = np.full(n, monoid_identity(combine, l.dtype), l.dtype)
+            buf = np.full((n,) + l.shape[1:],
+                          monoid_identity(combine, l.dtype), l.dtype)
             _NP_COMBINE[combine].at(buf, pos, l[known])
             bufs.append(buf)
         has = np.bincount(pos, minlength=n) > 0
@@ -750,8 +820,9 @@ def _pregel_host(ids, values, edges, compute, send, combine,
         if pending is not None and pending[0].size:
             msg_leaves, has = deliver(*pending)
         else:
-            msg_leaves = [np.full(n, monoid_identity(combine, dt), dt)
-                          for dt in msg_dtypes]
+            msg_leaves = [np.full((n,) + shp,
+                                  monoid_identity(combine, dt), dt)
+                          for dt, shp in zip(msg_dtypes, msg_shapes)]
             has = np.zeros(n, bool)
         nv_, na_ = compute(rewrap(vleaves, v_tuple),
                            rewrap(msg_leaves, m_tuple), has, act,
@@ -773,7 +844,9 @@ def _pregel_host(ids, values, edges, compute, send, combine,
                        deg[src_idx])
             m_leaves, m_tuple = as_leaves(msg)
             m_leaves = [np.broadcast_to(
-                np.asarray(l), (src.size,)).copy() for l in m_leaves]
+                np.asarray(l),
+                (src.size,) + np.asarray(l).shape[1:]).copy()
+                for l in m_leaves]
             pending = (dst[src_mask],
                        [l[src_mask] for l in m_leaves])
         else:

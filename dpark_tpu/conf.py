@@ -195,6 +195,35 @@ NARROW_EXCHANGE = os.environ.get("DPARK_NARROW_EXCHANGE", "1") != "0"
 GROUP_AGG_REWRITE = os.environ.get("DPARK_GROUP_AGG_REWRITE",
                                    "1") != "0"
 
+# device segmented apply (SegMapOp): groupByKey().mapValues(f) with an
+# arbitrary TRACEABLE per-group f (beyond the five provable aggregates)
+# runs on device as a vmap over power-of-two padded group buckets.
+# Admission additionally verifies f is padding-invariant (zero-pad or
+# repeat-last-pad, checked on seeded samples at classification time);
+# functions that need the true group length (mean-like shapes beyond
+# the provable forms) keep the host path with a recorded
+# fallback_reason.  "0" disables (host object path, the pre-PR
+# behavior — bisection aid).
+SEG_MAP = os.environ.get("DPARK_SEG_MAP", "1") != "0"
+
+# compile-budget guard for the segmented apply: each power-of-two group
+# bucket is one trace/compile of the user's per-group function, so a
+# tiny input with many buckets can spend more wall time compiling than
+# computing.  A stage whose estimated row count is below
+# (estimated buckets x this many rows) degrades to the host loop with
+# fallback_reason "seg_map compile budget".  0 disables the guard
+# (every eligible stage rides; the default — compiles are cached by
+# structural identity, so steady-state streams pay once).
+SEG_MIN_ROWS_PER_TRACE = int(os.environ.get(
+    "DPARK_SEG_MIN_ROWS_PER_TRACE", "0") or 0)
+
+# general traceable updateStateByKey on device: state rides as
+# HBM-resident columns and each batch cogroups with its padded value
+# segments through the same SegMapOp machinery (update(prev, values)
+# traced twice — with a prev scalar and with the literal None).  "0"
+# keeps the host cogroup path.
+SEG_STATE = os.environ.get("DPARK_SEG_STATE", "1") != "0"
+
 # device->host egest: int64 scalar columns at least this large are
 # min/max-probed and ride the link as int32 when every valid value fits
 # (the axon tunnel reads back at ~37 MB/s — BENCH_REAL_r03.md — so

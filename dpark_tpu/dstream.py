@@ -962,6 +962,48 @@ def _classify_state_update(f):
     return None
 
 
+class _TagState:
+    """Record-level tag map for the seg-state rewrite: value -> (value
+    cast to the state dtype, flag).  `+ zero` is the cast that means
+    the same thing on the host (numpy promotion) and under the tracer
+    (jax promotion) — flag 1 marks the carried state row.  ONE instance
+    per (stream, role) so the tpu program cache stays warm across
+    ticks."""
+
+    def __init__(self, zero, flag):
+        self.zero = zero
+        self.flag = flag
+
+    def __call__(self, v):
+        return (v + self.zero, self.flag)
+
+
+class _SegStateApply:
+    """Per-group consumer of the general-updateStateByKey rewrite:
+    the group's items are (value, flag) pairs — flag 1 is the carried
+    state (at most one per key), flag 0 the batch's new values.  On the
+    host paths this callable executes directly over the list; on the
+    tpu master fuse.py recognizes `__dpark_seg_state__` and runs the
+    user's update as a state-mode SegMapOp (vmapped over padded value
+    segments, prev/no-prev dual trace).  Admitted updates return a
+    numeric scalar in BOTH traces, so they never evict (return None) —
+    the rewrite therefore skips the cogroup path's None filter."""
+
+    def __init__(self, update):
+        self.update = update
+        self.__dpark_seg_state__ = update
+
+    def __call__(self, items):
+        prev = None
+        vs = []
+        for v, fl in items:
+            if fl:
+                prev = v
+            else:
+                vs.append(v)
+        return self.update(vs, prev)
+
+
 class StateDStream(DerivedDStream):
     def __init__(self, parent, updateFunc, numSplits=None):
         super().__init__(parent)
@@ -970,6 +1012,14 @@ class StateDStream(DerivedDStream):
         self.must_checkpoint = True
         self._monoid_op = _classify_state_update(updateFunc)
         self._numeric = None            # undecided until data shows up
+        # general TRACEABLE updateFunc (beyond the provable monoid
+        # fold): rewrite to flag-union + groupByKey + _SegStateApply so
+        # the tpu master's state-mode SegMapOp keeps the whole per-tick
+        # update on device (state as HBM-resident columns, padded value
+        # segments, vmapped update(prev, values)).  None = undecided
+        # (needs a data probe), False = declined, else (zero_new,
+        # zero_old, applyer) — built once, stable identities
+        self._seg_state = None
         # one instance for the stream's lifetime — stable identity
         # keeps the tpu backend's compiled-program cache warm across
         # batches (review finding)
@@ -1025,6 +1075,21 @@ class StateDStream(DerivedDStream):
                     return reduced.cache()
                 return prev.union(reduced) \
                     .reduceByKey(op, self.numSplits).cache()
+        from dpark_tpu import conf
+        if self._monoid_op is None and conf.SEG_STATE \
+                and self._seg_state is None and batch is not None:
+            self._seg_state = self._classify_seg_state(batch)
+        if self._monoid_op is None and self._seg_state:
+            tag_new, tag_old, applyer = self._seg_state
+            if batch is None and prev is not None:
+                b = ctx.parallelize([], 1).mapValue(tag_new)
+            elif batch is None:
+                return None
+            else:
+                b = batch.mapValue(tag_new)
+            u = b if prev is None else b.union(prev.mapValue(tag_old))
+            return u.groupByKey(self.numSplits) \
+                    .mapValues(applyer).cache()
         if batch is None:
             batch = ctx.parallelize([], 1)
         if prev is None:
@@ -1033,6 +1098,64 @@ class StateDStream(DerivedDStream):
         updated = grouped.mapValue(_StateUpdate(self.updateFunc)) \
                          .filter(_state_not_none)
         return updated.mapValue(_unwrap_state).cache()
+
+    def _classify_seg_state(self, batch):
+        """(tag_new, tag_old, applyer) when the updateFunc is a
+        traceable, padding-invariant update(values, prev) over numeric
+        scalar values — the admission the state-mode SegMapOp needs —
+        else False (cogroup path).  The state DTYPE is discovered by a
+        fixed-point trace (int values whose update decays to float
+        carry float state; both tag maps cast to it so host and device
+        agree on every column)."""
+        import numbers
+        f = self.updateFunc
+        code = getattr(f, "__code__", None)
+        if code is not None and code.co_argcount != 2:
+            return False
+        probe = _probe_values(batch)
+        if not probe:
+            return None                  # stay undecided: no data yet
+        vals = [rec[1] for rec in probe
+                if isinstance(rec, tuple) and len(rec) == 2]
+        if len(vals) != len(probe) or not all(
+                isinstance(v, numbers.Number)
+                and not isinstance(v, bool) for v in vals):
+            return False
+        try:
+            import numpy as np
+            import jax
+            from dpark_tpu.backend.tpu import fuse
+        except Exception:
+            return False
+        # device value dtype per layout.record_spec conventions
+        vdt = np.result_type(*[np.asarray(v).dtype for v in vals])
+        vdt = np.dtype(np.int64) if vdt.kind in "iu" else \
+            np.dtype(np.float32)
+        ds = vdt
+        try:
+            for _ in range(3):
+                fn_p, _fn_n = fuse._seg_state_row_fns(f)
+                outs = jax.eval_shape(
+                    fn_p, jax.ShapeDtypeStruct((4,), ds),
+                    jax.ShapeDtypeStruct((), ds))
+                if len(outs) != 1 or outs[0].shape != ():
+                    return False
+                nxt = np.result_type(ds, outs[0].dtype)
+                if nxt == ds:
+                    break
+                ds = np.dtype(nxt)
+            else:
+                return False             # state dtype does not settle
+        except Exception:
+            return False
+        pad, reason, _ = fuse.classify_seg_map(f, ds, state=True)
+        if pad is None:
+            logger.debug("updateStateByKey stays on the cogroup path: "
+                         "%s", reason)
+            return False
+        zero = ds.type(0)
+        return (_TagState(zero, 0), _TagState(zero, 1),
+                _SegStateApply(f))
 
 
 class _StateUpdate:

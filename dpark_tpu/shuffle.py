@@ -17,7 +17,7 @@ import os
 import pickle
 import struct
 import threading
-from queue import Full, Queue
+from queue import Queue
 
 from dpark_tpu import conf
 from dpark_tpu.utils import atomic_file, compress, decompress
@@ -162,12 +162,23 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
     """Thread-pool fetch (reference: ParallelShuffleFetcher).  On a single
     host file reads are fast; a small pool still overlaps decompression.
 
-    The results queue is BOUNDED (fetched buckets are merged as they
-    arrive; an unbounded queue would buffer a whole shuffle's worth of
-    unmerged items in RAM whenever merge_func runs slower than the
-    reads), and workers stop as soon as the consumer abandons the fetch
-    (merge_func raised mid-merge) instead of fetching the remaining map
-    outputs into a queue nobody will drain."""
+    Workers stop as soon as the consumer abandons the fetch (merge_func
+    raised mid-merge) instead of fetching the remaining map outputs
+    into buffers nobody will drain.
+
+    Buckets are merged in MAP-ID ORDER, not thread-arrival order: the
+    consumer holds out-of-order results in a reorder buffer until the
+    next expected map id lands.  Combine ORDER is thereby deterministic
+    and identical to the sequential fetcher — order-sensitive combiners
+    (tuple `+` is concatenation) previously produced results that
+    depended on thread scheduling, which surfaced as the order-dependent
+    full-suite flake in test_analysis (ISSUE 4 satellite).  Unmerged
+    buckets stay bounded by a PERMIT semaphore acquired before each
+    fetch and released after each merge: in-flight + queued + reordered
+    buckets never exceed 3 x nthreads, and progress is guaranteed
+    because workers take map ids in order — the next-to-merge map's
+    worker always already holds a permit (one stalled early map cannot
+    let the others inflate the whole shuffle into RAM)."""
 
     def __init__(self, nthreads=4):
         self.nthreads = nthreads
@@ -183,23 +194,21 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
                 raise FetchFailed(uri, shuffle_id, map_id, reduce_id)
             tasks.put((map_id, uri))
         nthreads = min(self.nthreads, tasks.qsize() or 1)
-        results = Queue(maxsize=2 * nthreads)
+        # the permit count bounds every fetched-but-unmerged bucket
+        # (queue + reorder buffer + in-flight); the queue itself can be
+        # unbounded because nothing enters it without a permit
+        permits = threading.Semaphore(3 * nthreads)
+        results = Queue()
         stop = threading.Event()
-
-        def _put(x):
-            while not stop.is_set():
-                try:
-                    results.put(x, timeout=0.5)
-                    return True
-                except Full:
-                    continue
-            return False
 
         def worker():
             while not stop.is_set():
+                if not permits.acquire(timeout=0.5):
+                    continue
                 try:
                     map_id, uri = tasks.get_nowait()
                 except Exception:
+                    permits.release()
                     return
                 try:
                     items = read_bucket_any(uri, shuffle_id, map_id,
@@ -215,10 +224,9 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
                         err = FetchFailed(uri, shuffle_id, map_id,
                                           reduce_id)
                         err.__cause__ = e
-                    _put((err, None))
+                    results.put((map_id, err, None))
                     return
-                if not _put((None, items)):
-                    return
+                results.put((map_id, None, items))
 
         threads = [threading.Thread(target=worker, daemon=True,
                                     name="dpark-fetch-worker")
@@ -226,11 +234,17 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
         for t in threads:
             t.start()
         try:
+            pending = {}                  # map_id -> items, out of order
+            next_id = 0
             for _ in range(len(locs)):
-                err, items = results.get()
+                map_id, err, items = results.get()
                 if err is not None:
-                    raise err
-                merge_func(items)
+                    raise err             # fail fast, order irrelevant
+                pending[map_id] = items
+                while next_id in pending:
+                    merge_func(pending.pop(next_id))
+                    next_id += 1
+                    permits.release()
         finally:
             stop.set()          # consumer done or raised: workers drain out
 

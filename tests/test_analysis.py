@@ -308,3 +308,75 @@ def test_monoid_multileaf_quiet_on_tuple_concat(ctx):
            .reduceByKey(operator.add)
     assert "monoid-multileaf" not in rules(lint_plan(r))
     assert sorted(r.collect()) == [(1, (2, 3, 4, 5))]
+
+
+# ---------------------------------------------------------------------------
+# host-fallback-group (ISSUE 4): why a grouped consumer left the array
+# path, pre-flight
+# ---------------------------------------------------------------------------
+
+def _branchy_group_fn(vs):
+    if len(vs) > 1:                     # data-dependent control flow
+        return max(vs)
+    return 0
+
+
+def test_host_fallback_group_flags_untraceable_fn(ctx):
+    from dpark_tpu import conf
+    old = conf.GROUP_AGG_REWRITE
+    conf.GROUP_AGG_REWRITE = False
+    try:
+        r = ctx.parallelize([(1, 2), (1, 3)], 2).groupByKey(2) \
+               .mapValues(_branchy_group_fn)
+        rep = lint_plan(r)
+    finally:
+        conf.GROUP_AGG_REWRITE = old
+    assert "host-fallback-group" in rules(rep)
+
+
+def test_host_fallback_group_quiet_on_traceable_and_provable(ctx):
+    from dpark_tpu import conf
+    old = conf.GROUP_AGG_REWRITE
+    conf.GROUP_AGG_REWRITE = False
+    try:
+        sumsq = lambda vs: sum(v * v for v in vs)     # noqa: E731
+        r = ctx.parallelize([(1, 2), (1, 3)], 2).groupByKey(2) \
+               .mapValues(sumsq)
+        assert "host-fallback-group" not in rules(lint_plan(r))
+        r = ctx.parallelize([(1, 2), (1, 3)], 2).groupByKey(2) \
+               .mapValues(sum)
+        assert "host-fallback-group" not in rules(lint_plan(r))
+    finally:
+        conf.GROUP_AGG_REWRITE = old
+
+
+def test_host_fallback_group_unsupported_value_pytree(ctx):
+    from dpark_tpu import conf
+    old = conf.GROUP_AGG_REWRITE
+    conf.GROUP_AGG_REWRITE = False
+    try:
+        first = lambda vs: sum(v[0] for v in vs)      # noqa: E731
+        r = ctx.parallelize([(1, (2, 3)), (1, (4, 5))], 2) \
+               .groupByKey(2).mapValues(first)
+        rep = lint_plan(r)
+    finally:
+        conf.GROUP_AGG_REWRITE = old
+    [f] = [f for f in rep if f.rule == "host-fallback-group"]
+    assert "value pytree" in f.message
+
+
+def test_host_fallback_group_conf_disabled(ctx):
+    from dpark_tpu import conf
+    old_rw, old_sm = conf.GROUP_AGG_REWRITE, conf.SEG_MAP
+    conf.GROUP_AGG_REWRITE = False
+    conf.SEG_MAP = False
+    try:
+        sumsq = lambda vs: sum(v * v for v in vs)     # noqa: E731
+        r = ctx.parallelize([(1, 2)], 2).groupByKey(2) \
+               .mapValues(sumsq)
+        rep = lint_plan(r)
+    finally:
+        conf.GROUP_AGG_REWRITE = old_rw
+        conf.SEG_MAP = old_sm
+    [f] = [f for f in rep if f.rule == "host-fallback-group"]
+    assert "DPARK_SEG_MAP=0" in f.message

@@ -144,8 +144,11 @@ def test_object_bagel_fuzz_parity(seed):
 
 
 def test_fallback_boundary_class_count():
-    """One more distinct degree than the trace budget: the program must
-    fall back to the host object path AND match."""
+    """More distinct degrees than the exact-class trace budget: with
+    power-of-two DEGREE BUCKETS (the ISSUE 4 lift) the program now
+    COLUMNARIZES — the class count collapses to <= 1 + log2(max degree)
+    — and still matches; with bucketing disabled the old fallback (and
+    parity) still holds."""
     from dpark_tpu import bagel as bagel_mod
 
     def graph(rng, ctx, n, tuple_vals):
@@ -163,9 +166,21 @@ def test_fallback_boundary_class_count():
         msgs = ctx.parallelize([], 2)
         return verts, msgs, BasicCombiner(operator.add)
 
-    _run_parity(3, expect_device=False,
+    _run_parity(3, expect_device=True,
                 n_override=bagel_mod.MAX_DEGREE_CLASSES + 3,
                 graph_fn=graph)
+    from dpark_tpu.backend.tpu import bagel_obj
+    stats = dict(bagel_obj.LAST_RUN_STATS)
+    assert stats["bucketed"] and stats["classes"] <= 11, stats
+
+    old = bagel_mod.DEGREE_BUCKETS
+    bagel_mod.DEGREE_BUCKETS = False
+    try:
+        _run_parity(3, expect_device=False,
+                    n_override=bagel_mod.MAX_DEGREE_CLASSES + 3,
+                    graph_fn=graph)
+    finally:
+        bagel_mod.DEGREE_BUCKETS = old
 
 
 def test_fallback_boundary_degree():

@@ -215,10 +215,14 @@ def test_edge_values_ride_device():
     _close(tpu, local)
 
 
-def test_too_many_degree_classes_falls_back():
-    """More distinct degrees than the trace budget: host path, parity
-    intact."""
+def test_too_many_degree_classes_now_bucketizes():
+    """More distinct degrees than the exact-class trace budget used to
+    force the host path; power-of-two degree buckets (ISSUE 4) fold
+    the 40 distinct degrees into <= 11 classes — the graph
+    COLUMNARIZES with parity.  With DPARK_BAGEL_BUCKETS off the old
+    fallback behavior (host path, parity intact) is preserved."""
     from dpark_tpu import bagel as bagel_mod
+    from dpark_tpu.backend.tpu import bagel_obj
     n = 80
     rows = [(i, Vertex(i, 0, [Edge((i + k) % n)
                               for k in range(1, 2 + (i % 40))]))
@@ -239,8 +243,22 @@ def test_too_many_degree_classes_falls_back():
                 BasicCombiner(operator.add))
 
     tpu, local, used = _run_both(compute, build)
-    assert not used
+    assert used, "degree buckets should columnarize >24 classes"
+    stats = dict(bagel_obj.LAST_RUN_STATS)
+    assert stats["bucketed"], stats
+    assert stats["classes"] <= 11, stats
+    assert stats["distinct_degrees"] > bagel_mod.MAX_DEGREE_CLASSES, \
+        stats
     assert tpu == local
+
+    old = bagel_mod.DEGREE_BUCKETS
+    bagel_mod.DEGREE_BUCKETS = False
+    try:
+        tpu2, local2, used2 = _run_both(compute, build)
+    finally:
+        bagel_mod.DEGREE_BUCKETS = old
+    assert not used2
+    assert tpu2 == local2
 
 
 def test_non_integer_target_falls_back():
@@ -263,3 +281,133 @@ def test_non_integer_target_falls_back():
     assert not used
     assert tpu == local
     assert local["a"] == 4               # both vertices notify "a" twice
+
+
+def test_degree_dependent_compute_uses_exact_classes():
+    """A compute that consults len(outEdges) (pagerank's share split)
+    is UNSOUND under padded buckets: the adapter detects it (len
+    recording + the exact-vs-bucket canary) and falls back to exact
+    degree classes — still on device, parity intact."""
+    from dpark_tpu.backend.tpu import bagel_obj
+    n = 60
+    rows = [(i, Vertex(i, 1.0, [Edge((i + k + 1) % n)
+                                for k in range(1 + i % 5)]))
+            for i in range(n)]
+
+    def compute(vert, msg, agg, s):
+        v = vert.value + (msg if msg is not None else 0.0)
+        out = []
+        if s < 2:
+            share = v / len(vert.outEdges)
+            out = [Message(e.target_id, share) for e in vert.outEdges]
+        return Vertex(vert.id, v, vert.outEdges, s < 2), out
+
+    def build(c):
+        return (c.parallelize(rows, 8), c.parallelize([], 8),
+                BasicCombiner(operator.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert used
+    stats = dict(bagel_obj.LAST_RUN_STATS)
+    assert not stats["bucketed"], stats       # exact classes took over
+    _close(tpu, local)
+
+
+def test_vector_message_values_ride_device():
+    """Message.value as a (count, sum-vector) pytree (ISSUE 4
+    satellite): leaves ride as extra exchange columns and the user's
+    pairwise op traces as a structure-preserving merge over the leaf
+    tuple — parity vs the local object loop."""
+    from dpark_tpu.backend.tpu import bagel_obj
+    n = 36
+    rows = [(i, Vertex(i, (0.0, np.zeros(3)),
+                       [Edge((i + k + 1) % n)
+                        for k in range(1 + i % 3)]))
+            for i in range(n)]
+
+    def compute(vert, msg, agg, s):
+        cnt, vec = vert.value
+        if msg is not None:
+            mc, mv = msg
+            cnt = cnt + mc
+            vec = vec + mv
+        out = []
+        if s < 3:
+            out = [Message(e.target_id,
+                           (1.0, np.ones(3) * (s + 1.0)))
+                   for e in vert.outEdges]
+        return Vertex(vert.id, (cnt, vec), vert.outEdges, s < 3), out
+
+    def build(c):
+        return (c.parallelize(rows, 8), c.parallelize([], 8),
+                BasicCombiner(lambda a, b: (a[0] + b[0], a[1] + b[1])))
+
+    tpu, local, used = _run_both(compute, build)
+    assert used
+    stats = dict(bagel_obj.LAST_RUN_STATS)
+    assert stats["msg_leaves"] == 2 and stats["msg_merge"] == "traced", \
+        stats
+    assert set(tpu) == set(local)
+    for k in tpu:
+        assert np.isclose(float(tpu[k][0]), float(local[k][0])), k
+        assert np.allclose(np.asarray(tpu[k][1], np.float64),
+                           np.asarray(local[k][1], np.float64)), k
+
+
+def test_vector_message_single_leaf_monoid():
+    """A single ndarray message leaf with a classified op (np.add)
+    combines through the per-leaf monoid — no traced merge needed."""
+    from dpark_tpu.backend.tpu import bagel_obj
+    n = 24
+    rows = [(i, Vertex(i, np.zeros(2),
+                       [Edge((i + 1) % n), Edge((i + 2) % n)]))
+            for i in range(n)]
+
+    def compute(vert, msg, agg, s):
+        v = vert.value + (msg if msg is not None else np.zeros(2))
+        out = []
+        if s < 2:
+            out = [Message(e.target_id, np.ones(2) * (s + 1.0))
+                   for e in vert.outEdges]
+        return Vertex(vert.id, v, vert.outEdges, s < 2), out
+
+    def build(c):
+        return (c.parallelize(rows, 4), c.parallelize([], 4),
+                BasicCombiner(np.add))
+
+    tpu, local, used = _run_both(compute, build)
+    assert used
+    stats = dict(bagel_obj.LAST_RUN_STATS)
+    assert stats["msg_leaves"] == 1 and stats["msg_merge"] == "monoid", \
+        stats
+    _close(tpu, local)
+
+
+def test_bagel_compile_budget_guard_falls_back():
+    """With DPARK_BAGEL_MIN_ROWS_PER_TRACE far above the graph size,
+    the adapter refuses to spend compiles and the host loop answers —
+    parity intact."""
+    from dpark_tpu import bagel as bagel_mod
+    n = 24
+    rows = [(i, Vertex(i, 0, [Edge((i + 1 + k) % n)
+                              for k in range(1 + i % 3)]))
+            for i in range(n)]
+
+    def compute(vert, msg, agg, s):
+        got = msg if msg is not None else 0
+        v = Vertex(vert.id, vert.value + got, vert.outEdges, s < 2)
+        return (v, [Message(e.target_id, 1)
+                    for e in vert.outEdges] if s < 2 else [])
+
+    def build(c):
+        return (c.parallelize(rows, 4), c.parallelize([], 4),
+                BasicCombiner(operator.add))
+
+    old = bagel_mod.BAGEL_MIN_ROWS_PER_TRACE
+    bagel_mod.BAGEL_MIN_ROWS_PER_TRACE = 10_000_000
+    try:
+        tpu, local, used = _run_both(compute, build)
+    finally:
+        bagel_mod.BAGEL_MIN_ROWS_PER_TRACE = old
+    assert not used
+    assert tpu == local

@@ -631,3 +631,74 @@ def test_checked_op_rejects_numpy_strings():
         op(np.str_("a"), np.str_("b"))
     with pytest.raises(_NumericRewriteError):
         op(1, "x")
+
+
+def test_general_traceable_updatestate_rides_device():
+    """A decayed-counter updateFunc — traceable but NOT a provable
+    monoid fold — rewrites to flag-union + groupByKey + the state-mode
+    SegMapOp: in steady state every stage rides the array path (state
+    lives as HBM-resident columns, the per-batch cogroup and the
+    vmapped update(prev, values) run on device), with values matching
+    the local master.  The `prev is None` spelling is the traceable
+    form (the dual trace sees the literal None); `prev or 0` forces a
+    tracer bool and keeps the cogroup path."""
+    from dpark_tpu import DparkContext
+
+    def update(vs, prev):
+        base = 0.0 if prev is None else prev
+        return base * 0.9 + sum(vs)
+
+    def drive(master):
+        c = DparkContext(master)
+        ssc = make_ssc(c, batch=1.0)
+        out = []
+        batches = [[(i % 11, (i * 3) % 7) for i in range(j * 13,
+                                                         j * 13 + 250)]
+                   for j in range(5)]
+        q = ssc.queueStream(batches)
+        q.updateStateByKey(update, numSplits=8).collect_batches(out)
+        run_batches(ssc, 5)
+        kinds = []
+        for rec in c.scheduler.history:
+            for st in rec.get("stage_info", ()):
+                if st.get("kind") is not None:
+                    kinds.append((st.get("rdd"), st["kind"]))
+        c.stop()
+        return ([sorted((int(k), round(float(v), 6)) for k, v in vals)
+                 for _, vals in out], kinds)
+
+    got, kinds = drive("tpu")
+    exp, _ = drive("local")
+    assert got == exp
+    # steady state: the union map stage AND the grouped-update reduce
+    # stage are all-array
+    steady = [v for _, v in kinds[-4:]]
+    assert set(steady) == {"array"}, kinds
+
+
+def test_untraceable_updatestate_keeps_cogroup_parity():
+    """An updateFunc with data-dependent Python control flow cannot
+    trace: the classification declines and the cogroup path answers —
+    identical on both masters (including eviction via None)."""
+    from dpark_tpu import DparkContext
+
+    def update(vs, prev):
+        total = (prev if prev is not None else 0) + sum(vs)
+        if total > 40:                  # tracer-unsafe branch + evict
+            return None
+        return total
+
+    def drive(master):
+        c = DparkContext(master)
+        ssc = make_ssc(c, batch=1.0)
+        out = []
+        batches = [[(i % 5, i % 4) for i in range(j * 7, j * 7 + 40)]
+                   for j in range(4)]
+        q = ssc.queueStream(batches)
+        q.updateStateByKey(update, numSplits=4).collect_batches(out)
+        run_batches(ssc, 4)
+        c.stop()
+        return [sorted((int(k), int(v)) for k, v in vals)
+                for _, vals in out]
+
+    assert drive("tpu") == drive("local")

@@ -12,7 +12,33 @@ import pytest
 OPS = ["map_affine", "filter_mod", "map_swap", "reduce_sum", "reduce_min",
        "reduce_max", "group", "group_agg", "sort", "distinct_keys",
        "count_tail", "union_extra", "host_partitions", "join_dim",
-       "cartesian_dim", "zip_index", "sample_det", "tuple_key"]
+       "cartesian_dim", "zip_index", "sample_det", "tuple_key",
+       "seg_map"]
+
+
+def _seg_fns():
+    """Traceable per-group functions BEYOND the five provable
+    aggregates (the ISSUE 4 SegMapOp shapes) — module-level singletons
+    so classification/program caches key stably across contexts.
+    Mixed zero-pad (sums) and repeat-pad (order statistics) forms."""
+    import jax.numpy as jnp
+    return {
+        "sumsq": lambda vs: sum(v * v for v in vs),
+        "amax": lambda vs: jnp.max(jnp.asarray(vs)),
+        "amin": lambda vs: jnp.min(jnp.asarray(vs)),
+        "span": lambda vs: jnp.max(jnp.asarray(vs))
+        - jnp.min(jnp.asarray(vs)),
+        "wsum": lambda vs: 3 * sum(vs) + sum(v * v for v in vs),
+    }
+
+
+_SEG_FN_CACHE = {}
+
+
+def _seg_fn(kind):
+    if not _SEG_FN_CACHE:
+        _SEG_FN_CACHE.update(_seg_fns())
+    return _SEG_FN_CACHE[kind]
 
 
 def build_program(rng, depth=4):
@@ -66,6 +92,17 @@ def build_program(rng, depth=4):
             prog.append(("group_agg", rng.choice([2, 4, 8]),
                          rng.choice(["sum", "len", "min", "max"])))
             shuffled = True
+        elif op == "seg_map":
+            # groupByKey().mapValues(traceable non-provable f): the
+            # ISSUE 4 SegMapOp shape under random surroundings, over
+            # whatever ragged group-size distribution the pipeline
+            # produced
+            if shuffled and rng.random() < 0.5:
+                continue
+            prog.append(("seg_map", rng.choice([2, 4, 8]),
+                         rng.choice(["sumsq", "amax", "amin", "span",
+                                     "wsum"])))
+            shuffled = True
         elif op == "tuple_key":
             # composite ((k1, k2), v) keys through a device shuffle
             # (reduce/group/sort), keys flattened back to ints after —
@@ -114,6 +151,8 @@ def apply_program(ctx, data, prog):
         elif op == "group_agg":
             f = {"sum": sum, "len": len, "min": min, "max": max}[step[2]]
             r = r.groupByKey(step[1]).mapValues(f)
+        elif op == "seg_map":
+            r = r.groupByKey(step[1]).mapValues(_seg_fn(step[2]))
         elif op == "sort":
             r = r.sortByKey(numSplits=step[1])
         elif op == "distinct_keys":
@@ -272,7 +311,7 @@ def test_forced_ooc_columnar_parity(seed):
                       np.int64)
     vals = np.asarray([rng.randint(-50, 50) for _ in range(n)],
                       np.int64)
-    red = rng.choice(["sum", "max", "group", "sort"])
+    red = rng.choice(["sum", "max", "group", "sort", "segmap"])
     nsp = rng.choice([4, 8, 16])        # 16 > mesh: spilled-run stream
     old = conf.STREAM_CHUNK_ROWS
     conf.STREAM_CHUNK_ROWS = 2048       # force multi-wave streaming
@@ -289,6 +328,11 @@ def test_forced_ooc_columnar_parity(seed):
                     r = r.reduceByKey(lambda a, b: max(a, b), nsp)
                 elif red == "group":
                     r = r.groupByKey(nsp).mapValues(sum)
+                elif red == "segmap":
+                    # forced-OOC waves feeding the segmented apply:
+                    # the spilled no-combine runs load back as a
+                    # device batch (executor._seg_batch_from_runs)
+                    r = r.groupByKey(nsp).mapValues(_seg_fn("sumsq"))
                 else:
                     r = r.sortByKey(numSplits=nsp)
                 got = r.collect()

@@ -103,12 +103,33 @@ def main():
         print("FAIL: ooc line carries no fallback_reasons list: %r"
               % sorted(ooc[0]))
         return 1
+    # ISSUE 4 satellite: the segmented-apply A/B line must be present
+    # with its schema (the ratio itself is not graded here — CI boxes
+    # are too noisy — but the device side must have ridden the array
+    # path, or the metric measures the fallback it exists to catch)
+    gm = [p for p in parsed
+          if str(p.get("metric", "")).startswith(
+              "group_mapvalues_device_vs_host")]
+    if not gm:
+        print("FAIL: no group_mapvalues_device_vs_host line")
+        return 1
+    for field in ("value", "t_device_s", "t_host_s",
+                  "device_rode_array_path"):
+        if field not in gm[0]:
+            print("FAIL: groupmap line missing %r (got %r)"
+                  % (field, sorted(gm[0])))
+            return 1
+    if not gm[0]["device_rode_array_path"]:
+        print("FAIL: groupmap device side left the array path: %r"
+              % gm[0])
+        return 1
     print("OK: %d JSON lines, ooc pipeline+phases fields present "
           "(waves=%d idle=%.3f depth=%d donated=%s narrow=%.0fms "
-          "fallbacks=%d)"
+          "fallbacks=%d groupmap=%.1fx)"
           % (len(parsed), pipe["waves"], pipe["device_idle_frac"],
              pipe["pipeline_depth"], pipe["donated"],
-             phases["narrow_ms"], len(ooc[0]["fallback_reasons"])))
+             phases["narrow_ms"], len(ooc[0]["fallback_reasons"]),
+             gm[0]["value"]))
     return 0
 
 
