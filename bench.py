@@ -196,6 +196,14 @@ def _ooc_phase():
         payload["phases"] = phases
     payload["fallback_reasons"] = getattr(
         ctx.scheduler, "fallback_reasons", lambda: [])()
+    # chaos/recovery accounting (ISSUE 5 satellite): per-site injected
+    # fault counters and the degrade/resubmit/retry summary — gated by
+    # tools/bench_smoke_check.py so a refactor cannot silently drop
+    # the recovery observability
+    recovery = getattr(ctx.scheduler, "recovery_summary",
+                       lambda: {})() or {}
+    payload["faults"] = recovery.pop("faults", {})
+    payload["degrades"] = recovery
     ctx.stop()
     print("OOC_RESULT %s" % json.dumps(payload), flush=True)
 

@@ -193,6 +193,13 @@ def main():
         out["phases"] = phases
     out["fallback_reasons"] = getattr(
         ctx.scheduler, "fallback_reasons", lambda: [])()
+    # chaos/recovery accounting (ISSUE 5): per-site injected fault
+    # counters + degrade/resubmit/retry summary, same shape as the
+    # bench.py OOC line
+    recovery = getattr(ctx.scheduler, "recovery_summary",
+                       lambda: {})() or {}
+    out["faults"] = recovery.pop("faults", {})
+    out["degrades"] = recovery
     ctx.stop()
     print(json.dumps(out), flush=True)
 

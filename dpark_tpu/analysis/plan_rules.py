@@ -15,6 +15,10 @@ shuffle anti-patterns that dominate cost at production scale:
   plan-wide-depth        more than conf.LINT_WIDE_DEPTH shuffle edges on
                          one lineage path with no checkpoint: a lost
                          partition replays the whole chain.
+  unbounded-recovery     the same uncheckpointed depth while fault
+                         injection (DPARK_FAULTS) is active: every
+                         injected failure replays the whole chain —
+                         chaos runs need a recovery pin.
   plan-join-repartition  a cogroup/join whose inputs already share a
                          partitioner, re-exchanged because the join was
                          given a different partition count.
@@ -275,20 +279,50 @@ def _shuffle_depth(r, memo):
     return best
 
 
-def _rule_wide_depth(rdd, report):
+def _excess_wide_depth(rdd):
+    """(depth, limit) when the plan chains more shuffles than
+    conf.LINT_WIDE_DEPTH with no checkpoint pin, else None — shared by
+    plan-wide-depth and its chaos twin unbounded-recovery."""
     from dpark_tpu import conf
     limit = int(getattr(conf, "LINT_WIDE_DEPTH", 4))
     if limit <= 0:
-        return
+        return None
     depth = _shuffle_depth(rdd, {})
-    if depth > limit:
-        report.add(
-            "plan-wide-depth", "warn", rdd.scope_name,
-            "%d chained shuffles with no checkpoint on the path "
-            "(limit %d): a lost partition replays the whole chain"
-            % (depth, limit),
-            "checkpoint() (or cache()) an intermediate RDD; raise "
-            "conf.LINT_WIDE_DEPTH if the depth is intentional")
+    return (depth, limit) if depth > limit else None
+
+
+def _rule_wide_depth(rdd, report, excess):
+    if excess is None:
+        return
+    depth, limit = excess
+    report.add(
+        "plan-wide-depth", "warn", rdd.scope_name,
+        "%d chained shuffles with no checkpoint on the path "
+        "(limit %d): a lost partition replays the whole chain"
+        % (depth, limit),
+        "checkpoint() (or cache()) an intermediate RDD; raise "
+        "conf.LINT_WIDE_DEPTH if the depth is intentional")
+
+
+def _rule_unbounded_recovery(rdd, report, excess):
+    """Fault injection is ACTIVE (DPARK_FAULTS) and this plan chains
+    more shuffles than conf.LINT_WIDE_DEPTH with no checkpoint pin:
+    every injected failure past the last pin replays the whole chain —
+    a chaos run against such a plan measures recompute amplification,
+    not recovery (ISSUE 5 satellite; the chaos twin of
+    plan-wide-depth)."""
+    from dpark_tpu import faults
+    if excess is None or not faults.active():
+        return
+    depth, limit = excess
+    report.add(
+        "unbounded-recovery", "warn", rdd.scope_name,
+        "fault injection is active (DPARK_FAULTS) and this plan "
+        "chains %d shuffles with no checkpoint (limit %d): each "
+        "injected failure replays the whole uncheckpointed chain"
+        % (depth, limit),
+        "checkpoint() an intermediate RDD before running under "
+        "chaos, or raise conf.LINT_WIDE_DEPTH deliberately")
 
 
 def _rule_join_repartition(r, report):
@@ -571,5 +605,7 @@ def lint_plan(rdd, master="local", report=None, lineage=None):
         _rule_host_fallback_key(r, report)
         _rule_host_fallback_group(r, report)
     _rule_uncached_reshuffle(lineage, report)
-    _rule_wide_depth(rdd, report)
+    excess = _excess_wide_depth(rdd)
+    _rule_wide_depth(rdd, report, excess)
+    _rule_unbounded_recovery(rdd, report, excess)
     return report

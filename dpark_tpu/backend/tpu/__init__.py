@@ -17,6 +17,25 @@ from dpark_tpu.utils.log import get_logger
 logger = get_logger("tpu")
 
 
+def _device_error(e):
+    """Is this a device RUNTIME error (XlaRuntimeError, HBM
+    RESOURCE_EXHAUSTED) — the class the stage-level degradation ladder
+    owns — as opposed to a plan/user-code error?  Matched by type name
+    and message so injected stand-ins (faults.py kind=oom) and every
+    jax version's concrete type all classify."""
+    for exc in (e, getattr(e, "__cause__", None)):
+        if exc is None:
+            continue
+        if type(exc).__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+            return True
+        text = str(exc)
+        if "RESOURCE_EXHAUSTED" in text or "RESOURCE EXHAUSTED" in text:
+            return True
+        if "out of memory" in text.lower():
+            return True
+    return False
+
+
 class TPUScheduler(DAGScheduler):
     def __init__(self, ndev=None):
         super().__init__()
@@ -73,13 +92,8 @@ class TPUScheduler(DAGScheduler):
                     # answer pre-flight
                     self.note_stage(stage.id, fallback_reason=reason)
         if plan is not None:
-            try:
-                self._run_array_stage(stage, tasks, plan, report)
+            if self._run_degradable(stage, tasks, plan, report):
                 return
-            except Exception as e:
-                logger.warning(
-                    "array path failed for %s (%s); object fallback",
-                    stage, e)
         # object path: run tasks inline on the driver (golden semantics);
         # cogroup stages first pre-materialize their CoGroupedRDD via the
         # device exchange so only the group-merge runs in Python
@@ -107,6 +121,107 @@ class TPUScheduler(DAGScheduler):
                     from dpark_tpu.env import env
                     env.cache.drop(cg.id, nparts)
                     cg.should_cache = False
+
+    def _spill_write_failed(self, stage, tasks, report, e):
+        """ENOSPC & co mid-spill: NOT a device fault, and the object
+        path would spill to the same disk — surface it on the stage's
+        tasks as task failures so the scheduler's retry/escalation
+        accounting owns it (single-task retries then run the object
+        path inline).  Never a silent fallback, never a job abort
+        before MAX_TASK_FAILURES."""
+        logger.warning("spill write failed for %s: %s", stage, e)
+        self.note_stage(stage.id,
+                        degrade_reason="spill write failed: %s" % e)
+        for task in tasks:
+            report(task, "failed", "spill write failed: %s" % e)
+
+    def _run_degradable(self, stage, tasks, plan, report):
+        """Array path with runtime graceful degradation (ISSUE 5
+        tentpole): a device runtime error (XlaRuntimeError /
+        RESOURCE_EXHAUSTED) first retries the stage with a HALVED wave
+        budget — an HBM OOM usually just means the auto-sized wave was
+        too greedy — then falls back to the object path for THIS STAGE
+        ONLY.  Each step is recorded as the stage's `degrade_reason`
+        (the runtime mirror of `fallback_reason`); the job never
+        aborts on a device error.  Returns True when the stage was
+        fully reported (success or surfaced task failures); False
+        means "run the object path".
+
+        FLOAT CAVEAT (documented in README): an object-path fallback
+        of a reassociated float aggregate can differ in low-order bits
+        from the device fold — same contract as GROUP_AGG_REWRITE.
+        Integer workloads (the chaos parity suite) are exact."""
+        from dpark_tpu import conf
+        from dpark_tpu.shuffle import SpillWriteError
+        try:
+            self._run_array_stage(stage, tasks, plan, report)
+            return True
+        except SpillWriteError as e:
+            self._spill_write_failed(stage, tasks, report, e)
+            return True
+        except Exception as e:
+            if not (conf.DEGRADE and _device_error(e)):
+                logger.warning(
+                    "array path failed for %s (%s); object fallback",
+                    stage, e)
+                self.note_stage(stage.id, degrade_reason=(
+                    "array path error (%s: %s); object path"
+                    % (type(e).__name__, str(e)[:160])))
+                return False
+            first = "%s: %s" % (type(e).__name__, str(e)[:160])
+        # degrade step 1: halve the wave budget and retry the stage.
+        # Device errors raise during run_stage, BEFORE any task is
+        # reported, so the whole-stage retry cannot double-report.
+        # The budget is applied through conf.STREAM_CHUNK_ROWS (not a
+        # per-plan field) DELIBERATELY: fuse._big_columnar's streaming
+        # eligibility reads the same knob, so halving can flip an
+        # in-core stage that OOM'd onto the wave stream — the actual
+        # cure.  Safe because this scheduler runs stages serially on
+        # the event-loop thread (restored in the finally); a future
+        # parallel-stage scheduler must thread it through the plan.
+        old = conf.STREAM_CHUNK_ROWS
+        if isinstance(old, int):
+            eff = old
+        else:
+            # "auto" sizes waves to HBM / row WIDTH: halve the budget
+            # the executor actually used, not the 16-byte-row default
+            # (for wide rows that default is a LARGER wave than the
+            # one that just OOM'd)
+            row_bytes = 16
+            try:
+                from dpark_tpu.backend.tpu import fuse
+                if plan.source[0] == "ingest":
+                    row_bytes = fuse._columnar_row_bytes(
+                        plan.source[1]._slices)
+            except Exception:
+                pass
+            eff = conf.stream_chunk_rows(row_bytes)
+        halved = max(64, int(eff) // 2)
+        conf.STREAM_CHUNK_ROWS = halved
+        logger.warning("device error on %s (%s); retrying with halved "
+                       "wave budget (%d rows/device)", stage, first,
+                       halved)
+        try:
+            self._run_array_stage(stage, tasks, plan, report)
+            self.note_stage(stage.id, degrade_reason=(
+                "%s; stage retried with halved wave budget "
+                "(%d rows/device)" % (first, halved)))
+            return True
+        except SpillWriteError as e:
+            self._spill_write_failed(stage, tasks, report, e)
+            return True
+        except Exception as e2:
+            # degrade step 2: object path for this stage only
+            logger.warning(
+                "halved-wave retry failed for %s (%s); object "
+                "fallback for this stage", stage, e2)
+            self.note_stage(stage.id, degrade_reason=(
+                "%s; halved-wave retry failed (%s: %s); object path "
+                "for this stage" % (first, type(e2).__name__,
+                                    str(e2)[:120])))
+            return False
+        finally:
+            conf.STREAM_CHUNK_ROWS = old
 
     def _resident_nocombine_deps(self, cg):
         """All of a CoGroupedRDD's inputs as HBM-resident no-combine

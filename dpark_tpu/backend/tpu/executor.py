@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from dpark_tpu import conf
+from dpark_tpu import conf, faults
 from dpark_tpu.backend.tpu import collectives, fuse, layout
 from dpark_tpu.utils.log import get_logger
 
@@ -249,6 +249,12 @@ class _SpillWriter:
                 try:
                     self._write(*item)
                 except BaseException as e:
+                    # never leave a partial chunk file behind: a later
+                    # reader would mistake it for a (short) valid run
+                    try:
+                        os.unlink(item[0])
+                    except OSError:
+                        pass
                     self._err = e
                     self._stop.set()
             finally:
@@ -615,6 +621,7 @@ class JAXExecutor:
                donate, extra_key)
         if key in self._compiled:
             return self._compiled[key]
+        faults.hit("executor.compile")     # chaos site: per cache miss
         ops = plan.ops
         epilogue = plan.epilogue
         n_dst = self.ndev
@@ -901,6 +908,7 @@ class JAXExecutor:
         """Compile + invoke the narrow stage program on one batch.
         `donate` is for streamed waves only: the batch's leaves are
         dead after this call and XLA may reuse them in place."""
+        faults.hit("executor.dispatch")    # chaos site: per dispatch
         jitted = self._compile_narrow(
             plan, batch.cap, len(batch.cols),
             tuple(str(c.dtype) for c in batch.cols), donate=donate,
@@ -1893,6 +1901,7 @@ class JAXExecutor:
                donate)
         if key in self._compiled:
             return self._compiled[key]
+        faults.hit("executor.compile")     # chaos site: per cache miss
         ops = plan.ops
         ndev = self.ndev
         has_bounds = plan.epi_bounds is not None
@@ -2056,6 +2065,7 @@ class JAXExecutor:
         try:
             for c, (batch, ingest_s) in enumerate(batches):
                 t_disp = stats.now()
+                faults.hit("executor.dispatch")   # chaos site: per wave
                 jitted = self._compile_stream_nocombine(
                     plan, batch.cap, len(batch.cols), r,
                     tuple(str(c.dtype) for c in batch.cols),
@@ -2238,17 +2248,48 @@ class JAXExecutor:
 
     @staticmethod
     def _write_run(path, rows):
-        from dpark_tpu.utils import compress
+        """One spill run to disk, framed with its crc32c (ISSUE 5):
+        corruption surfaces at read as SpillCorruption -> FetchFailed
+        (lineage recompute), never unpickled garbage.  A failed write
+        (ENOSPC & co, including the shuffle.spill_write chaos site)
+        cleans up its partial file and raises SpillWriteError so the
+        consuming stage fails VISIBLY into the scheduler's task
+        retry/escalation accounting."""
         import pickle
-        with open(path, "wb") as f:
-            f.write(compress(pickle.dumps(rows, -1)))
+        import struct
+        from dpark_tpu import faults
+        from dpark_tpu.shuffle import SpillWriteError, spill_crc
+        from dpark_tpu.utils import atomic_file, compress
+        blob = compress(pickle.dumps(rows, -1))
+        crc = spill_crc(blob)   # over the TRUE bytes, pre-corruption
+        try:
+            blob = faults.hit("shuffle.spill_write", blob)
+            # tmp+rename: a failed or killed write never leaves a
+            # partial file a reader could mistake for a short run
+            with atomic_file(path) as f:
+                f.write(struct.pack("<I", crc))
+                f.write(blob)
+        except OSError as e:
+            raise SpillWriteError(
+                "spill run %s write failed: %s" % (path, e)) from e
 
     @staticmethod
     def _read_run(path):
-        from dpark_tpu.utils import decompress
         import pickle
+        import struct
+        from dpark_tpu import faults
+        from dpark_tpu.shuffle import SpillCorruption, spill_crc
+        from dpark_tpu.utils import decompress
         with open(path, "rb") as f:
-            return pickle.loads(decompress(f.read()))
+            raw = f.read()
+        (crc,) = struct.unpack("<I", raw[:4])
+        blob = faults.hit("shuffle.spill_read", raw[4:])
+        if spill_crc(blob) != crc:
+            # the export bridge's readers turn this into FetchFailed:
+            # the parent device stage recomputes through lineage
+            raise SpillCorruption(
+                "spill run %s: crc32c mismatch (corrupted run)" % path)
+        return pickle.loads(decompress(blob))
 
     def _exchange_all(self, leaves, counts, offsets, slot_floor=0,
                       donate=False):

@@ -17,6 +17,10 @@ import importlib.util
 
 MEM_PER_TASK = 200.0          # MB per task (process/mesos masters)
 MAX_TASK_FAILURES = 4         # retries before a job aborts
+# parent-stage resubmissions (FetchFailed lineage recovery) per stage
+# before the job aborts with a chained error: a shuffle source that
+# keeps failing must not loop the DAG forever (ISSUE 5 satellite)
+MAX_STAGE_FAILURES = 4
 SCHEDULER_STALL_TIMEOUT = 60  # s between event-queue deadlock checks; a
                               # check only aborts when NO task is in flight
 
@@ -40,6 +44,33 @@ DPARK_WORK_DIR = os.environ.get("DPARK_WORK_DIR", "/tmp/dpark_tpu")
 # compression codec for shuffle files / broadcast blocks: zlib always
 # available; lz4 used when importable (reference prefers lz4).
 COMPRESS = "auto"
+
+# ---------------------------------------------------------------------------
+# chaos plane + recovery knobs (dpark_tpu/faults.py — ISSUE 5)
+# ---------------------------------------------------------------------------
+
+# deterministic fault injection spec, e.g.
+#   "shuffle.fetch:p=0.2,seed=7;executor.dispatch:nth=3,kind=oom"
+# empty = no injection (zero hot-path cost).  See faults.py for the
+# full grammar and the list of named sites.
+DPARK_FAULTS = os.environ.get("DPARK_FAULTS", "")
+
+# device-path graceful degradation: an XlaRuntimeError /
+# RESOURCE_EXHAUSTED from a stage program first retries the stage with
+# a HALVED wave budget (stream_chunk_rows), then falls back to the
+# object path for that stage only — recorded as a per-stage
+# `degrade_reason`, never a job abort.  "0" disables (the error then
+# still falls back to the object path, without the halved retry).
+DEGRADE = os.environ.get("DPARK_DEGRADE", "1") != "0"
+
+# dcn transient-connect retry: total attempts (1 = no retry) and the
+# base backoff seconds (exponential with full jitter: attempt k sleeps
+# uniform in [base*2^k/2, base*2^k]).  Application-level ServerError
+# stays non-retryable — only transport connect errors back off.
+DCN_CONNECT_ATTEMPTS = int(os.environ.get("DPARK_DCN_CONNECT_ATTEMPTS",
+                                          "3") or 1)
+DCN_CONNECT_BACKOFF = float(os.environ.get("DPARK_DCN_CONNECT_BACKOFF",
+                                           "0.05"))
 
 # ---------------------------------------------------------------------------
 # TPU-native knobs (no reference analog)

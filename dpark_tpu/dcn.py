@@ -34,10 +34,12 @@ import json
 import os
 import pickle  # encode-only: serializing OUR data for peers, never
                # deserializing network input
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 
 from dpark_tpu.utils.log import get_logger
 
@@ -246,10 +248,53 @@ def _request(sock, req):
     return payload
 
 
-def _connect(uri, timeout):
+def backoff_delays(attempts, base=None, rand=None):
+    """The sleep schedule between transient-connect retries:
+    exponential with FULL JITTER — attempt k (0-based) sleeps uniform
+    in [base * 2^k / 2, base * 2^k], so a fleet of reduce tasks
+    retrying a briefly-down peer doesn't reconnect in lockstep.
+    Yields attempts-1 delays.  `rand` is injectable for deterministic
+    unit tests (tests/test_faults.py runs the schedule on a fake
+    clock)."""
+    from dpark_tpu import conf
+    if base is None:
+        base = conf.DCN_CONNECT_BACKOFF
+    rand = rand if rand is not None else random
+    for k in range(max(0, attempts - 1)):
+        span = base * (2 ** k)
+        yield span * (0.5 + 0.5 * rand.random())
+
+
+def _connect(uri, timeout, attempts=None, sleep=time.sleep, rand=None):
+    """Connect to a peer bucket server with bounded retry + backoff.
+
+    Only TRANSPORT-level errors (refused/reset/timeout — transient by
+    nature: the peer may be restarting or its accept queue full) are
+    retried.  The non-retryable classification is preserved: the
+    application-level ServerError (status-1 responses, MAC mismatches)
+    originates in _request, never here, and callers like FetchPool
+    continue to let it through untouched."""
     assert uri.startswith("tcp://"), uri
+    from dpark_tpu import conf, faults
     host, _, port = uri[len("tcp://"):].partition(":")
-    return socket.create_connection((host, int(port)), timeout=timeout)
+    attempts = max(1, conf.DCN_CONNECT_ATTEMPTS
+                   if attempts is None else attempts)
+    delays = backoff_delays(attempts, rand=rand)
+    last_err = None
+    for k in range(attempts):
+        try:
+            faults.hit("dcn.connect")
+            return socket.create_connection((host, int(port)),
+                                            timeout=timeout)
+        except (ConnectionError, OSError) as e:
+            last_err = e
+            d = next(delays, None)
+            if d is None:
+                break
+            logger.debug("connect to %s failed (%s); retry %d/%d in "
+                         "%.3fs", uri, e, k + 1, attempts - 1, d)
+            sleep(d)
+    raise last_err
 
 
 def fetch(uri, req, timeout=30):

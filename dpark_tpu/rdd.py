@@ -172,6 +172,8 @@ class RDD:
                 rows = list(_cache.get_or_compute(self, split))
             else:
                 rows = list(self.compute(split))
+            from dpark_tpu import faults
+            faults.hit("checkpoint.write")
             with atomic_file(path) as f:
                 pickle.dump(rows, f, -1)
         self._maybe_promote_checkpoint()
@@ -213,6 +215,8 @@ class RDD:
             with open(path, "rb") as f:
                 return iter(pickle.load(f))
         rows = list(self.compute(split))
+        from dpark_tpu import faults
+        faults.hit("checkpoint.write")
         with atomic_file(path) as f:
             pickle.dump(rows, f, -1)
         return iter(rows)
@@ -1277,7 +1281,11 @@ class ShuffledRDD(RDD):
         if conf.SORT_SHUFFLE:
             merger = SortMerger(self.aggregator)
         else:
-            merger = DiskSpillMerger(self.aggregator)
+            # shuffle/reduce tags route a corrupted-spill FetchFailed
+            # back through lineage recovery (see DiskSpillMerger)
+            merger = DiskSpillMerger(self.aggregator,
+                                     shuffle_id=self.dep.shuffle_id,
+                                     reduce_id=split.index)
         env.shuffle_fetcher.fetch(self.dep.shuffle_id, split.index,
                                   merger.merge)
         return iter(merger)

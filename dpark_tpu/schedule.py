@@ -176,6 +176,10 @@ class DAGScheduler:
         running = set()         # stages with submitted tasks
         pending_tasks = {}      # stage -> set of partition ids not yet done
         failures = {}           # task partition retry counters per stage
+        stage_failures = {}     # stage id -> lineage-recovery rounds
+        #   (FetchFailed resubmits/recomputes), capped by
+        #   conf.MAX_STAGE_FAILURES so a persistently failing shuffle
+        #   source aborts with a chained error instead of looping
         progress = Progress(final_rdd.scope_name, len(output_parts))
 
         record = self._new_job_record(final_rdd, len(output_parts))
@@ -245,7 +249,7 @@ class DAGScheduler:
                 output_parts, finished, results, events, in_flight,
                 waiting, running, pending_tasks, failures, progress,
                 stage_of, submit_stage, submit_missing_tasks, record,
-                report, submitted_at, spawn_duplicate)
+                report, submitted_at, spawn_duplicate, stage_failures)
         except GeneratorExit:
             # consumer stopped early (take/first/iterate) — by design
             record["state"] = "partial"
@@ -300,6 +304,36 @@ class DAGScheduler:
                 reason = st.get("fallback_reason")
                 if reason and reason not in out:
                     out.append(reason)
+        return out
+
+    def degrade_reasons(self):
+        """Every recorded runtime DEGRADATION reason across the job
+        history (the tpu master notes one per stage that hit a device
+        error / spill failure and recovered — halved wave budget,
+        object-path fallback).  The runtime twin of
+        fallback_reasons(); bench artifacts ship both."""
+        out = []
+        for rec in self.history:
+            for st in rec.get("stage_info", ()):
+                reason = st.get("degrade_reason")
+                if reason and reason not in out:
+                    out.append(reason)
+        return out
+
+    def recovery_summary(self):
+        """Aggregate recovery accounting across the job history plus
+        the chaos plane's per-site injection counters — the bench
+        JSON's `faults`/`degrades` sections (ISSUE 5 satellite):
+        proves in CI that injected faults actually fired and recovery
+        actually ran."""
+        from dpark_tpu import faults
+        out = {"resubmits": 0, "recomputes": 0, "retries": 0,
+               "fetch_failed": 0, "speculated": 0}
+        for rec in self.history:
+            for k in list(out):
+                out[k] += rec.get(k, 0)
+        out["reasons"] = self.degrade_reasons()
+        out["faults"] = faults.stats()
         return out
 
     def phase_table(self):
@@ -394,7 +428,9 @@ class DAGScheduler:
                     in_flight, waiting, running, pending_tasks, failures,
                     progress, stage_of, submit_stage,
                     submit_missing_tasks, record, report, submitted_at,
-                    spawn_duplicate):
+                    spawn_duplicate, stage_failures=None):
+        if stage_failures is None:
+            stage_failures = {}
         import time as _time
         num_finished = 0
         next_to_yield = 0
@@ -495,12 +531,19 @@ class DAGScheduler:
             elif status == "fetch_failed":
                 e = payload
                 parent = self.shuffle_to_stage.get(e.shuffle_id)
-                logger.warning("fetch failed on %s; resubmitting parent %s",
-                               stage, parent)
+                record["fetch_failed"] = record.get("fetch_failed",
+                                                    0) + 1
                 if parent is not None:
                     if e.map_id >= 0:
                         parent.output_locs[e.map_id] = None
-                    elif e.uri:
+                    if e.uri and (e.map_id < 0
+                                  or str(e.uri).startswith("hbm://")):
+                        # device-resident shuffles compute EVERY
+                        # partition in one stage program and export
+                        # through one uri: losing any hbm bucket means
+                        # the whole store recomputes (a lone-map
+                        # object-path recompute would silently cover
+                        # only that map's rows)
                         parent.remove_outputs_by_uri(e.uri)
                     # publish the surviving outputs (only the lost maps
                     # are None) so in-flight reduces don't treat every
@@ -508,9 +551,66 @@ class DAGScheduler:
                     # recompute (round-1 advisor fix)
                     env.map_output_tracker.register_outputs(
                         e.shuffle_id, list(parent.output_locs))
+                if parent is not None and not parent.is_available:
+                    logger.warning(
+                        "fetch failed on %s; resubmitting parent %s",
+                        stage, parent)
                     running.discard(stage)
                     waiting.add(stage)
+                    # cap lineage-recovery ROUNDS per parent stage: a
+                    # shuffle source that keeps failing must abort the
+                    # job with the real error chained, not loop the
+                    # DAG forever (ISSUE 5 satellite).  A burst of
+                    # sibling FetchFaileds from one lost map counts as
+                    # ONE round — only the event that initiates the
+                    # resubmission increments (later siblings find the
+                    # parent already re-running)
+                    if parent not in running and parent not in waiting:
+                        rounds = stage_failures.get(parent.id, 0) + 1
+                        stage_failures[parent.id] = rounds
+                        if rounds > conf.MAX_STAGE_FAILURES:
+                            err = RuntimeError(
+                                "stage %d failed %d lineage-recovery "
+                                "rounds (conf.MAX_STAGE_FAILURES=%d); "
+                                "aborting job — last fetch failure "
+                                "chained below"
+                                % (parent.id, rounds,
+                                   conf.MAX_STAGE_FAILURES))
+                            err.__cause__ = e
+                            raise err
+                        record["resubmits"] = record.get(
+                            "resubmits", 0) + 1
                     submit_stage(parent)
+                else:
+                    # parent intact (task-local loss — e.g. a spill
+                    # chunk failed its crc) or unknown shuffle: there
+                    # is nothing for the parent to redo, so retry just
+                    # THIS task under the ordinary per-task failure
+                    # cap.  A stage resubmit here would enqueue zero
+                    # parent tasks (deadlock) or duplicate every
+                    # still-pending sibling per event.
+                    logger.warning(
+                        "fetch failed on %s (parent %s intact); "
+                        "retrying the task", stage, parent)
+                    if parent is not None:
+                        record["recomputes"] = record.get(
+                            "recomputes", 0) + 1
+                    key = (task.stage_id, task.partition)
+                    failures[key] = failures.get(key, 0) + 1
+                    if failures[key] >= conf.MAX_TASK_FAILURES:
+                        err = RuntimeError(
+                            "task for partition %d of stage %d hit "
+                            "FetchFailed %d times on shuffle %s with "
+                            "intact parent outputs"
+                            % (task.partition, task.stage_id,
+                               failures[key], e.shuffle_id))
+                        err.__cause__ = e
+                        raise err
+                    record["retries"] = record.get("retries", 0) + 1
+                    retry = task.retry_copy()
+                    in_flight[0] += 1
+                    submitted_at[tkey] = _time.time()
+                    self.submit_tasks(stage, [retry], report)
             else:       # failure
                 # credit the EXECUTOR that ran the task (fleet
                 # placement): blacklist ranking must see failures
@@ -538,6 +638,7 @@ class DAGScheduler:
                 # shared-object mutation between attempts, so completion
                 # attribution stays unambiguous when dispatch crosses
                 # process/host boundaries
+                record["retries"] = record.get("retries", 0) + 1
                 retry = task.retry_copy()
                 in_flight[0] += 1
                 submitted_at[tkey] = _time.time()

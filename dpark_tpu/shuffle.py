@@ -19,11 +19,38 @@ import struct
 import threading
 from queue import Queue
 
-from dpark_tpu import conf
+from dpark_tpu import conf, faults
 from dpark_tpu.utils import atomic_file, compress, decompress
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("shuffle")
+
+
+class SpillWriteError(OSError):
+    """A spill-run write failed (ENOSPC and friends).  The device
+    path's background writer surfaces this on the CONSUMING stage as a
+    task failure — the scheduler's retry/escalation accounting owns
+    it — instead of dying silently on the writer thread."""
+
+
+class SpillCorruption(IOError):
+    """A spill run failed its crc32c integrity check.  Callers
+    translate this into FetchFailed (lineage recompute) rather than
+    unpickling garbage into a silently wrong answer."""
+
+
+def spill_crc(blob):
+    """Checksum for spill-run framing: native crc32c when the C
+    library is loaded, else C-speed zlib.crc32 — the pure-Python
+    crc32c table loop (~MB/s) would dominate the spill hot path the
+    runs exist to accelerate.  Spill runs are written and read by the
+    same host/installation, so the polynomial only needs to be
+    consistent within a process, never across heterogeneous peers."""
+    from dpark_tpu import native
+    if native.get_lib() is not None:
+        return native.crc32c(blob)
+    import zlib
+    return zlib.crc32(blob) & 0xFFFFFFFF
 
 
 class LocalFileShuffle:
@@ -123,6 +150,10 @@ def read_bucket_any(uris, shuffle_id, map_id, reduce_id):
     last_err = None
     for uri in ordered:
         try:
+            # chaos site: one hit per fetch ATTEMPT, so replica
+            # fallback and the FetchFailed translation below are both
+            # exercised by injection
+            faults.hit("shuffle.fetch")
             items = read_bucket(uri, shuffle_id, map_id, reduce_id)
         except Exception as e:
             hm.task_failed_on(uri_host(uri))
@@ -318,12 +349,22 @@ class DiskSpillMerger(Merger):
     back through chunked streaming readers feeding heapq.merge, so the
     final merge holds one chunk per run in memory — re-inflating every
     run at once would hand back the whole dataset the spills existed to
-    keep out of RAM."""
+    keep out of RAM.
 
-    def __init__(self, aggregator, max_items=None, workdir=None):
+    Each chunk is framed with its crc32c (ISSUE 5): a corrupted run
+    surfaces as FetchFailed — the consuming task recomputes through
+    lineage — instead of unpickling garbage.  `shuffle_id`/`reduce_id`
+    tag that FetchFailed so the scheduler can route the recompute;
+    without them corruption raises SpillCorruption (a plain task
+    failure, still never a wrong answer)."""
+
+    def __init__(self, aggregator, max_items=None, workdir=None,
+                 shuffle_id=None, reduce_id=-1):
         super().__init__(aggregator)
         self.max_items = max_items or conf.SHUFFLE_CHUNK_RECORDS * 4
         self.workdir = workdir
+        self.shuffle_id = shuffle_id
+        self.reduce_id = reduce_id
         self.spills = []
 
     def merge(self, items):
@@ -343,24 +384,41 @@ class DiskSpillMerger(Merger):
         with atomic_file(path) as f:
             for i in range(0, len(items), chunk):
                 blob = compress(pickle.dumps(items[i:i + chunk], -1))
+                # crc over the TRUE bytes, computed before the chaos
+                # site may corrupt them — exactly what disk rot does
+                crc = spill_crc(blob)
+                blob = faults.hit("shuffle.spill_write", blob)
                 # 8-byte length: one chunk of giant combiners (a hot
                 # key's list) must not overflow a 4 GiB prefix
-                f.write(struct.pack("<Q", len(blob)))
+                f.write(struct.pack("<QI", len(blob), crc))
                 f.write(blob)
         self.spills.append(path)
         self.combined = {}
 
-    @staticmethod
-    def _iter_run(path):
+    def _iter_run(self, path):
         """Stream one spill run back chunk by chunk (sorted within and
-        across chunks: the run was sorted before chunking)."""
+        across chunks: the run was sorted before chunking), verifying
+        each chunk's crc32c before unpickling."""
         with open(path, "rb") as f:
             while True:
-                hdr = f.read(8)
+                hdr = f.read(12)
                 if not hdr:
                     return
-                (n,) = struct.unpack("<Q", hdr)
-                for kv in pickle.loads(decompress(f.read(n))):
+                n, crc = struct.unpack("<QI", hdr)
+                blob = faults.hit("shuffle.spill_read", f.read(n))
+                if spill_crc(blob) != crc:
+                    err = SpillCorruption(
+                        "spill run %s: crc32c mismatch (corrupted "
+                        "chunk)" % path)
+                    if self.shuffle_id is not None:
+                        # lineage recompute: the scheduler retries the
+                        # consuming stage (its map outputs are intact)
+                        ff = FetchFailed(None, self.shuffle_id, -1,
+                                         self.reduce_id)
+                        ff.__cause__ = err
+                        raise ff
+                    raise err
+                for kv in pickle.loads(decompress(blob)):
                     yield kv
 
     def __iter__(self):

@@ -33,12 +33,14 @@ _PAGE = """<!doctype html>
 <body>
 <h2>dpark_tpu jobs</h2>
 <table id="t"><tr><th>job</th><th>scope</th><th>parts</th>
-<th>finished</th><th>stages</th><th>seconds</th><th>state</th></tr></table>
+<th>finished</th><th>stages</th><th>seconds</th><th>state</th>
+<th>recovery (resubmit/recompute/retry)</th></tr></table>
 <h2>stages <small>(click a row for its tasks; DAG per job below)</small></h2>
 <table id="s"><tr><th>job</th><th>stage</th><th>rdd</th>
 <th>parts</th><th>kind</th><th>seconds</th><th>device run s</th>
 <th>HBM bytes</th><th>wire bytes</th><th>pad eff</th>
 <th>waves</th><th>idle %</th><th>pipeline ms (in/cmp/xchg/spill)</th>
+<th>fallback / degrade</th>
 </tr></table>
 <div id="dags"></div>
 <h2>profile</h2>
@@ -77,8 +79,12 @@ async function tick() {
   dags.innerHTML = '';
   for (const j of jobs) {
     const row = t.insertRow();
+    // lineage-recovery accounting (ISSUE 5): FetchFailed parent
+    // resubmits / intact-parent recomputes / task retries per job
+    const rec = (j.resubmits || 0) + '/' + (j.recomputes || 0) + '/' +
+                (j.retries || 0);
     for (const v of [j.id, j.scope, j.parts, j.finished, j.stages,
-                     j.seconds, j.state])
+                     j.seconds, j.state, rec])
       row.insertCell().textContent = v;
     row.className = j.state === 'done' ? 'done' : 'run';
     const d = document.createElement('div');
@@ -93,10 +99,13 @@ async function tick() {
       const pms = p.waves ? (p.ingest_ms + '/' + p.compute_ms + '/' +
                              p.exchange_ms + '/' + p.spill_ms) : '';
       const idle = p.waves ? (100 * p.device_idle_frac).toFixed(1) : '';
+      // why the stage left (or nearly left) the array path: the
+      // analyze-time fallback_reason or the runtime degrade_reason
+      const why = st.fallback_reason || st.degrade_reason || '';
       for (const v of [j.id, st.id, st.rdd, st.parts, st.kind,
                        st.seconds, st.run_seconds, st.hbm_bytes,
                        st.wire_bytes, st.pad_efficiency,
-                       p.waves, idle, pms])
+                       p.waves, idle, pms, why])
         sr.insertCell().textContent = v === undefined ? '' : v;
       sr.className = 'stage ' + (st.seconds === null ? 'run' : 'done');
       const key = j.id + ':' + st.id;
@@ -106,7 +115,7 @@ async function tick() {
       };
       if (open.has(key)) {
         const dr = s.insertRow();
-        const c = dr.insertCell(); c.colSpan = 13;
+        const c = dr.insertCell(); c.colSpan = 14;
         c.className = 'tasks'; c.innerHTML = taskRows(st);
       }
     }

@@ -1,0 +1,661 @@
+"""Chaos plane (ISSUE 5): deterministic fault injection + recovery
+parity.
+
+The suite proves recovery is EXERCISED, not assumed: real jobs
+(reduceByKey, groupByKey().mapValue, join, a dstream window) run under
+injected faults — fetch failures, spill corruption, device OOM, disk
+full, checkpoint write errors — with fixed seeds, and every result is
+asserted BIT-IDENTICAL to the clean run while the job record shows the
+expected recovery events (parent resubmit / recompute / per-stage
+degrade_reason).  No job aborts.
+
+Device tests run on a 2-device sliced mesh ("tpu:2") so the suite
+works on small containers (see the `mesh` marker note in conftest)."""
+
+import operator
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+from dpark_tpu import conf, faults
+from dpark_tpu.shuffle import (FetchFailed, SpillCorruption,
+                               SpillWriteError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends without an installed chaos plane."""
+    faults.configure(None)
+    yield
+    faults.configure(None)
+
+
+@pytest.fixture()
+def tctx2():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu:2")
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def tiny_waves():
+    old = conf.STREAM_CHUNK_ROWS
+    conf.STREAM_CHUNK_ROWS = 500
+    yield
+    conf.STREAM_CHUNK_ROWS = old
+
+
+def _recovery(sched):
+    return sched.recovery_summary()
+
+
+# ---------------------------------------------------------------------------
+# the plane itself: grammar, determinism, corruption
+# ---------------------------------------------------------------------------
+
+def test_spec_grammar():
+    plane = faults.configure(
+        "shuffle.fetch:p=0.2,seed=7;executor.dispatch:nth=3,kind=oom")
+    specs = plane.specs
+    assert set(specs) == {"shuffle.fetch", "executor.dispatch"}
+    assert specs["shuffle.fetch"].p == 0.2
+    assert specs["shuffle.fetch"].seed == 7
+    assert specs["executor.dispatch"].nth == 3
+    assert specs["executor.dispatch"].kind == "oom"
+
+
+def test_spec_rejects_unknown_site_and_kind():
+    with pytest.raises(ValueError):
+        faults.configure("shuffle.fetchx:nth=1")
+    with pytest.raises(ValueError):
+        faults.configure("shuffle.fetch:kind=explode")
+
+
+def test_seeded_probability_is_deterministic():
+    def pattern():
+        faults.configure("shuffle.fetch:p=0.5,seed=7")
+        out = []
+        for _ in range(32):
+            try:
+                faults.hit("shuffle.fetch")
+                out.append(0)
+            except OSError:
+                out.append(1)
+        return out
+
+    first, second = pattern(), pattern()
+    assert first == second
+    assert 1 in first and 0 in first        # p=0.5 over 32 draws
+
+
+def test_nth_fires_exactly_once():
+    faults.configure("executor.dispatch:nth=3")
+    fired = []
+    for i in range(10):
+        try:
+            faults.hit("executor.dispatch")
+        except OSError:
+            fired.append(i)
+    assert fired == [2]
+    st = faults.stats()["executor.dispatch"]
+    assert st["hits"] == 10 and st["fired"] == 1
+
+
+def test_bare_spec_fires_once_and_times_caps():
+    faults.configure("shuffle.fetch")
+    with pytest.raises(OSError):
+        faults.hit("shuffle.fetch")
+    faults.hit("shuffle.fetch")             # exhausted: no-op
+    faults.configure("executor.dispatch:p=1,times=2")
+    fired = 0
+    for _ in range(5):
+        try:
+            faults.hit("executor.dispatch")
+        except OSError:
+            fired += 1
+    assert fired == 2
+
+
+def test_corrupt_preserves_length_and_oom_shape():
+    faults.configure("shuffle.spill_write:nth=1,kind=corrupt")
+    blob = bytes(range(64))
+    out = faults.hit("shuffle.spill_write", blob)
+    assert len(out) == len(blob) and out != blob
+    assert faults.hit("shuffle.spill_write", blob) == blob   # once
+    faults.configure("executor.dispatch:nth=1,kind=oom")
+    with pytest.raises(Exception) as e:
+        faults.hit("executor.dispatch")
+    assert "RESOURCE_EXHAUSTED" in str(e.value)
+    from dpark_tpu.backend.tpu import _device_error
+    assert _device_error(e.value)
+
+
+def test_inactive_plane_is_passthrough():
+    assert not faults.active()
+    blob = b"xyz"
+    assert faults.hit("shuffle.fetch", blob) is blob
+    assert faults.stats() == {}
+
+
+# ---------------------------------------------------------------------------
+# chaos parity: fetch failure (host path)
+# ---------------------------------------------------------------------------
+
+def _reduce_job(ctx):
+    return sorted(ctx.parallelize([(i % 7, i) for i in range(210)], 4)
+                  .reduceByKey(operator.add, 3).collect())
+
+
+def _group_job(ctx):
+    # 150 distinct keys over 3 reduce partitions: ~50 keys per reduce
+    # task, above the forced DiskSpillMerger threshold in the spill
+    # tests (max_items = SHUFFLE_CHUNK_RECORDS * 4 = 32)
+    return sorted(
+        ctx.parallelize([(i % 150, i % 5) for i in range(600)], 4)
+        .groupByKey(3).mapValue(lambda vs: tuple(sorted(vs)))
+        .collect())
+
+
+def _join_job(ctx):
+    a = ctx.parallelize([(i % 6, i) for i in range(60)], 3)
+    b = ctx.parallelize([(i % 6, i * 10) for i in range(30)], 2)
+    return sorted(a.join(b, 3).collect())
+
+
+def test_fetch_fault_reduce_parity(ctx):
+    clean = _reduce_job(ctx)
+    faults.configure("shuffle.fetch:nth=1")
+    got = _reduce_job(ctx)
+    assert got == clean
+    st = faults.stats()["shuffle.fetch"]
+    assert st["fired"] == 1
+    rec = ctx.scheduler.history[-1]
+    assert rec["state"] == "done"
+    assert rec.get("resubmits", 0) >= 1         # parent stage re-ran
+
+
+def test_fetch_fault_join_parity(ctx):
+    clean = _join_job(ctx)
+    faults.configure("shuffle.fetch:nth=2")
+    got = _join_job(ctx)
+    assert got == clean
+    assert faults.stats()["shuffle.fetch"]["fired"] == 1
+    rec = ctx.scheduler.history[-1]
+    assert rec["state"] == "done"
+    assert rec.get("resubmits", 0) >= 1
+
+
+def test_fetch_fault_probabilistic_parity(ctx):
+    """Seeded p= injection across a multi-fetch job still converges to
+    the exact clean result (each retry redraws deterministically)."""
+    clean = _reduce_job(ctx)
+    faults.configure("shuffle.fetch:p=0.3,seed=11,times=3")
+    got = _reduce_job(ctx)
+    assert got == clean
+    assert ctx.scheduler.history[-1]["state"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# chaos parity: spill corruption -> crc32c -> FetchFailed -> recompute
+# ---------------------------------------------------------------------------
+
+def test_spill_corruption_group_parity(ctx):
+    """A corrupted host spill chunk (DiskSpillMerger) surfaces as
+    FetchFailed via its crc32c frame; the consuming stage recomputes
+    (the parent's outputs are intact) and the result is bit-identical
+    — never unpickled garbage."""
+    old = conf.SHUFFLE_CHUNK_RECORDS
+    conf.SHUFFLE_CHUNK_RECORDS = 8          # max_items 32: force spills
+    try:
+        clean = _group_job(ctx)
+        faults.configure("shuffle.spill_write:nth=1,kind=corrupt")
+        got = _group_job(ctx)
+        assert got == clean
+        assert faults.stats()["shuffle.spill_write"]["fired"] == 1
+        rec = ctx.scheduler.history[-1]
+        assert rec["state"] == "done"
+        assert rec.get("recomputes", 0) >= 1    # intact-parent retry
+    finally:
+        conf.SHUFFLE_CHUNK_RECORDS = old
+
+
+def test_disk_spill_merger_crc_detects_corruption(tmp_path):
+    from dpark_tpu.dependency import Aggregator
+    from dpark_tpu.shuffle import DiskSpillMerger
+    agg = Aggregator(lambda v: v, operator.add, operator.add)
+
+    def build(shuffle_id):
+        m = DiskSpillMerger(agg, max_items=10, workdir=str(tmp_path),
+                            shuffle_id=shuffle_id, reduce_id=2)
+        for _ in range(4):
+            m.merge([(k, 1) for k in range(25)])
+        return m
+
+    # clean round trip first
+    assert dict(build(None)) == {k: 4 for k in range(25)}
+    # corrupt one chunk: tagged merger raises FetchFailed for lineage
+    faults.configure("shuffle.spill_write:nth=1,kind=corrupt")
+    m = build(7)
+    with pytest.raises(FetchFailed) as e:
+        dict(m)
+    assert e.value.shuffle_id == 7 and e.value.reduce_id == 2
+    assert isinstance(e.value.__cause__, SpillCorruption)
+    # untagged merger: a plain (task-failing) corruption error
+    faults.configure("shuffle.spill_write:nth=1,kind=corrupt")
+    with pytest.raises(SpillCorruption):
+        dict(build(None))
+
+
+def test_executor_run_crc_round_trip(tmp_path):
+    from dpark_tpu.backend.tpu.executor import JAXExecutor
+    p = str(tmp_path / "run")
+    cols = [np.arange(100, dtype=np.int64), np.ones(100)]
+    JAXExecutor._write_run(p, cols)
+    back = JAXExecutor._read_run(p)
+    assert np.array_equal(back[0], cols[0])
+    faults.configure("shuffle.spill_write:nth=1,kind=corrupt")
+    JAXExecutor._write_run(p, cols)
+    with pytest.raises(SpillCorruption, match="crc32c"):
+        JAXExecutor._read_run(p)
+
+
+# ---------------------------------------------------------------------------
+# chaos parity: device path (tpu master)
+# ---------------------------------------------------------------------------
+
+def _device_reduce(ctx):
+    from dpark_tpu import Columns
+    i = np.arange(20000, dtype=np.int64)
+    data = Columns((i * 2654435761) % 997, i % 11)
+    return sorted(ctx.parallelize(data, 2)
+                  .reduceByKey(operator.add, 2).collect())
+
+
+def _degrade_reasons(sched):
+    return sched.degrade_reasons()
+
+
+def _join_premergers(ex):
+    """Wait out background premerge walkers from PREVIOUS runs on this
+    executor so a freshly configured chaos plane cannot be consumed by
+    a stale store's merged-run writes."""
+    for s in list(ex.shuffle_store.values()):
+        pm = s.get("premerge")
+        if pm is not None and pm._thread is not None:
+            pm._thread.join(timeout=10)
+
+
+def test_device_oom_halved_wave_retry_parity(tctx2, tiny_waves):
+    """An injected device OOM on a stage dispatch retries the stage
+    with a HALVED wave budget; the job completes bit-identically and
+    the stage records a degrade_reason — never a job abort."""
+    clean = _device_reduce(tctx2)
+    faults.configure("executor.dispatch:nth=1,kind=oom")
+    got = _device_reduce(tctx2)
+    assert got == clean
+    assert faults.stats()["executor.dispatch"]["fired"] == 1
+    reasons = _degrade_reasons(tctx2.scheduler)
+    assert any("halved wave budget" in r for r in reasons), reasons
+    assert tctx2.scheduler.history[-1]["state"] == "done"
+
+
+def test_device_oom_object_fallback_parity(tctx2, tiny_waves):
+    """A persistent device OOM (first attempt AND the halved-wave
+    retry) degrades the stage to the OBJECT PATH only — results stay
+    bit-identical and degrade_reason says why."""
+    clean = _device_reduce(tctx2)
+    faults.configure("executor.dispatch:p=1,times=2,kind=oom")
+    got = _device_reduce(tctx2)
+    assert got == clean
+    assert faults.stats()["executor.dispatch"]["fired"] == 2
+    reasons = _degrade_reasons(tctx2.scheduler)
+    assert any("object path" in r for r in reasons), reasons
+    assert tctx2.scheduler.history[-1]["state"] == "done"
+
+
+def test_compile_fault_degrades_to_object_path(tctx2, tiny_waves):
+    """A failure at the compile site (not a device runtime error)
+    falls back to the object path for the stage, recorded.  The
+    faulted run goes FIRST — a prior clean run would warm the program
+    cache and the compile site (hit per cache miss) would never
+    fire."""
+    faults.configure("executor.compile:nth=1")
+    got = _device_reduce(tctx2)
+    assert faults.stats()["executor.compile"]["fired"] == 1
+    reasons = _degrade_reasons(tctx2.scheduler)
+    assert any("array path error" in r for r in reasons), reasons
+    faults.configure(None)
+    assert got == _device_reduce(tctx2)
+
+
+def test_device_spill_corruption_recomputes_stage(tctx2, tiny_waves):
+    """A corrupted device spill RUN (the streamed no-combine path)
+    fails its crc32c at export, surfaces as FetchFailed on the hbm
+    uri, and the WHOLE parent device stage recomputes (a device stage
+    computes every partition in one program) — parity holds."""
+    def job():
+        from dpark_tpu import Columns
+        keys = np.arange(15000, dtype=np.int64) % 97
+        vals = np.arange(15000, dtype=np.int64) % 13
+        return {k: sorted(v) for k, v in
+                tctx2.parallelize(Columns(keys, vals), 2)
+                .groupByKey(8).collect()}
+
+    clean = job()
+    _join_premergers(tctx2.scheduler.executor)
+    faults.configure("shuffle.spill_write:nth=3,kind=corrupt")
+    got = job()
+    assert got == clean
+    assert faults.stats()["shuffle.spill_write"]["fired"] == 1
+    summary = _recovery(tctx2.scheduler)
+    assert summary["resubmits"] >= 1 or summary["recomputes"] >= 1, \
+        summary
+    assert tctx2.scheduler.history[-1]["state"] == "done"
+
+
+def test_device_spill_disk_full_is_task_failure(tctx2, tiny_waves):
+    """ENOSPC during the background spill surfaces on the consuming
+    stage as TASK failures (retry/escalate through the scheduler's
+    accounting), the partial chunk is cleaned up, and the retried
+    tasks complete the job on the object path."""
+    from dpark_tpu.env import env
+
+    def job():
+        from dpark_tpu import Columns
+        rng = np.random.RandomState(17)
+        # UNIQUE keys: equal-key tie order may legitimately differ
+        # between the device path and the object-path retry
+        keys = rng.permutation(12000).astype(np.int64)
+        vals = np.arange(12000, dtype=np.int64)
+        return tctx2.parallelize(Columns(keys, vals), 2) \
+            .sortByKey(numSplits=8).collect()
+
+    clean = job()
+    _join_premergers(tctx2.scheduler.executor)
+    faults.configure("shuffle.spill_write:nth=1,kind=enospc")
+    got = job()
+    assert got == clean
+    assert faults.stats()["shuffle.spill_write"]["fired"] == 1
+    summary = _recovery(tctx2.scheduler)
+    assert summary["retries"] >= 1, summary
+    assert any("spill write failed" in r
+               for r in summary["reasons"]), summary
+    # no partial chunk files left in any spool dir
+    spool_root = os.path.join(env.workdir, "hbmruns")
+    if os.path.isdir(spool_root):
+        for root, _, files in os.walk(spool_root):
+            for f in files:
+                # every surviving run must read back clean
+                from dpark_tpu.backend.tpu.executor import JAXExecutor
+                JAXExecutor._read_run(os.path.join(root, f))
+
+
+def test_spill_writer_cleans_partial_file(tmp_path):
+    """The background writer unlinks a partially-written chunk when
+    the write fails (faked full filesystem) and surfaces the error on
+    the consumer, not the writer thread."""
+    from dpark_tpu.backend.tpu.executor import _SpillWriter
+
+    def partial_write(path, cols):
+        with open(path, "wb") as f:
+            f.write(b"partial")
+        raise OSError(28, "No space left on device")
+
+    w = _SpillWriter(partial_write)
+    p1 = str(tmp_path / "r1")
+    w.put(p1, [np.arange(3)])
+    with pytest.raises(OSError):
+        for _ in range(100):
+            w.put(str(tmp_path / "r2"), [np.arange(3)])
+            time.sleep(0.02)
+        w.finish()
+    w.abort()
+    assert not os.path.exists(p1), "partial chunk file left behind"
+
+
+# ---------------------------------------------------------------------------
+# chaos parity: dstream window
+# ---------------------------------------------------------------------------
+
+def test_window_job_parity_under_fetch_fault(ctx):
+    """A dstream reduceByKeyAndWindow run recovers from an injected
+    fetch failure mid-stream with per-batch outputs identical to the
+    clean run."""
+    from dpark_tpu.dstream import StreamingContext
+
+    batches = [[("k", 1), ("j", 2)], [("k", 2)], [("k", 4), ("j", 1)],
+               [("k", 8)]]
+
+    def run():
+        ssc = StreamingContext(ctx, 1.0)
+        out = []
+        q = ssc.queueStream([list(b) for b in batches])
+        q.reduceByKeyAndWindow(operator.add, 2.0).collect_batches(out)
+        ssc.ctx.start()
+        for ins in ssc.input_streams:
+            ins.start()
+        ssc.zero_time = 1000.0
+        for k in range(1, len(batches) + 1):
+            ssc.run_batch(1000.0 + k * ssc.batch_duration)
+        return [(t, sorted(v)) for t, v in out]
+
+    clean = run()
+    faults.configure("shuffle.fetch:nth=2")
+    got = run()
+    assert got == clean
+    assert faults.stats()["shuffle.fetch"]["fired"] == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint.write site
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_write_fault_retries(ctx, tmp_path):
+    ctx.setCheckpointDir(str(tmp_path / "ckpt"))
+    r = ctx.parallelize(range(40), 4).map(lambda x: x * 3)
+    r.checkpoint()
+    clean = list(range(0, 120, 3))
+    faults.configure("checkpoint.write:nth=1")
+    assert r.collect() == clean
+    assert faults.stats()["checkpoint.write"]["fired"] == 1
+    rec = ctx.scheduler.history[-1]
+    assert rec["state"] == "done" and rec.get("retries", 0) >= 1
+    # the checkpoint completed despite the injected failure: a fresh
+    # read comes from the part files (lineage truncated)
+    assert r.collect() == clean
+    assert r._checkpoint_rdd is not None
+
+
+# ---------------------------------------------------------------------------
+# MAX_STAGE_FAILURES: bounded lineage recovery
+# ---------------------------------------------------------------------------
+
+def test_stage_failure_cap_aborts_with_chained_error(ctx):
+    """A PERSISTENTLY failing fetch aborts the job after
+    conf.MAX_STAGE_FAILURES lineage-recovery rounds with the real
+    fetch error chained — instead of resubmitting the parent stage
+    forever."""
+    faults.configure("shuffle.fetch:p=1")       # every fetch fails
+    r = ctx.parallelize([(i % 3, 1) for i in range(30)], 2) \
+           .reduceByKey(operator.add, 2)
+    with pytest.raises(RuntimeError) as e:
+        r.collect()
+    assert "MAX_STAGE_FAILURES" in str(e.value)
+    assert isinstance(e.value.__cause__, FetchFailed)
+    rec = ctx.scheduler.history[-1]
+    assert rec["state"] == "aborted"
+    assert rec.get("resubmits", 0) == conf.MAX_STAGE_FAILURES
+
+
+# ---------------------------------------------------------------------------
+# dcn connect: bounded retry with exponential backoff + jitter
+# ---------------------------------------------------------------------------
+
+def test_backoff_schedule_fake_clock():
+    from dpark_tpu import dcn
+    delays = list(dcn.backoff_delays(5, base=0.1,
+                                     rand=random.Random(0)))
+    assert len(delays) == 4
+    for k, d in enumerate(delays):
+        span = 0.1 * (2 ** k)
+        assert span / 2 <= d <= span, (k, d)
+    # deterministic under the same rand seed
+    again = list(dcn.backoff_delays(5, base=0.1,
+                                    rand=random.Random(0)))
+    assert delays == again
+
+
+def test_connect_retries_transient_then_succeeds(tmp_path):
+    """An injected transient connect failure is retried with backoff
+    (fake clock records the sleeps) and the fetch then succeeds."""
+    from dpark_tpu import dcn
+    from dpark_tpu.dcn import BucketServer
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    srv = BucketServer(wd, host="127.0.0.1").start()
+    slept = []
+    try:
+        uri = "tcp://%s:%d" % srv.bind_address
+        faults.configure("dcn.connect:nth=1")
+        sock = dcn._connect(uri, 5, attempts=3, sleep=slept.append,
+                            rand=random.Random(3))
+        sock.close()
+        assert len(slept) == 1 and slept[0] > 0
+        assert faults.stats()["dcn.connect"]["fired"] == 1
+    finally:
+        srv.stop()
+
+
+def test_connect_exhausts_attempts_and_raises(tmp_path):
+    from dpark_tpu import dcn
+    slept = []
+    faults.configure("dcn.connect:p=1")
+    with pytest.raises(OSError):
+        dcn._connect("tcp://127.0.0.1:1", 1, attempts=3,
+                     sleep=slept.append, rand=random.Random(1))
+    assert len(slept) == 2                  # attempts-1 backoffs
+    assert slept[1] > slept[0] / 2          # exponential-ish growth
+
+
+def test_server_error_stays_non_retryable(tmp_path):
+    """The application-level ServerError classification is preserved:
+    a status-1 response raises once, with no connect retries."""
+    from dpark_tpu import dcn
+    from dpark_tpu.dcn import BucketServer, ServerError
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    srv = BucketServer(wd, host="127.0.0.1").start()
+    try:
+        uri = "tcp://%s:%d" % srv.bind_address
+        pool = dcn.FetchPool()
+        with pytest.raises(ServerError):
+            pool.fetch(uri, ("no-such-kind",))
+        pool.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# speculation / retry accounting + hostatus decay (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+def test_speculation_first_result_wins_no_double_count(pctx):
+    """An injected straggler triggers a speculative duplicate; the
+    first completion wins, the duplicate never double-counts in the
+    job record, and the result is exact."""
+    def straggle(i, it):
+        import time as _t
+        items = list(it)
+        if i == 0:
+            _t.sleep(4)
+        return [sum(items)]
+
+    old = (conf.SPECULATION_MULTIPLIER, conf.SPECULATION_QUANTILE)
+    conf.SPECULATION_MULTIPLIER = 1.5
+    conf.SPECULATION_QUANTILE = 0.5
+    try:
+        got = pctx.parallelize(list(range(100)), 10) \
+                  .mapPartitionsWithIndex(straggle).collect()
+        assert sum(got) == 4950
+        rec = pctx.scheduler.history[-1]
+        assert rec.get("speculated", 0) >= 1
+        # the duplicate's completion must not double-count
+        assert rec["finished"] == rec["parts"] == 10
+        assert rec["state"] == "done"
+        # per-task records carry at most one SUCCESS per partition
+        for st in rec["stage_info"]:
+            by_part = {}
+            for t in st.get("tasks", ()):
+                if t["ok"]:
+                    by_part[t["p"]] = by_part.get(t["p"], 0) + 1
+            assert all(n == 1 for n in by_part.values()), by_part
+    finally:
+        conf.SPECULATION_MULTIPLIER, conf.SPECULATION_QUANTILE = old
+
+
+def test_blacklisted_host_recovers_after_decay():
+    """hostatus blacklisting is a RECENT-failure view: after the purge
+    window elapses the host is offered work again."""
+    from dpark_tpu.hostatus import TaskHostManager
+    hm = TaskHostManager(purge_elapsed=60)
+    t0 = 1000.0
+    for _ in range(4):
+        hm.task_failed_on("bad-host", now=t0)
+    assert hm.is_blacklisted("bad-host", now=t0 + 1)
+    ranked = hm.rank_hosts(["bad-host", "good-host"], now=t0 + 1)
+    assert ranked[0] == "good-host"
+    # decay: past the purge horizon the failures age out
+    assert not hm.is_blacklisted("bad-host", now=t0 + 61)
+    assert hm.offer_choice(["bad-host"], now=t0 + 61) == "bad-host"
+
+
+# ---------------------------------------------------------------------------
+# unbounded-recovery lint rule
+# ---------------------------------------------------------------------------
+
+def test_unbounded_recovery_rule_fires_only_under_injection(ctx):
+    from dpark_tpu.analysis import lint_plan
+    old = conf.LINT_WIDE_DEPTH
+    conf.LINT_WIDE_DEPTH = 1
+    try:
+        r = ctx.parallelize([(i % 5, 1) for i in range(50)], 2) \
+               .reduceByKey(operator.add, 2) \
+               .map(lambda kv: (kv[1], kv[0])) \
+               .reduceByKey(operator.add, 2)
+        rules = {f.rule for f in lint_plan(r)}
+        assert "unbounded-recovery" not in rules     # no injection
+        faults.configure("shuffle.fetch:p=0.1,seed=1")
+        rules = {f.rule for f in lint_plan(r)}
+        assert "unbounded-recovery" in rules
+        # a checkpoint pin silences it
+        faults.configure("shuffle.fetch:p=0.1,seed=1")
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            mid = ctx.parallelize([(i % 5, 1) for i in range(50)], 2) \
+                     .reduceByKey(operator.add, 2).checkpoint(d)
+            top = mid.map(lambda kv: (kv[1], kv[0])) \
+                     .reduceByKey(operator.add, 2)
+            rules = {f.rule for f in lint_plan(top)}
+            assert "unbounded-recovery" not in rules
+    finally:
+        conf.LINT_WIDE_DEPTH = old
+
+
+# ---------------------------------------------------------------------------
+# recovery summary plumbing (bench's faults/degrades sections)
+# ---------------------------------------------------------------------------
+
+def test_recovery_summary_shape(ctx):
+    faults.configure("shuffle.fetch:nth=1")
+    _reduce_job(ctx)
+    summary = ctx.scheduler.recovery_summary()
+    for field in ("resubmits", "recomputes", "retries", "fetch_failed",
+                  "speculated", "reasons", "faults"):
+        assert field in summary, summary
+    assert summary["fetch_failed"] >= 1
+    assert summary["faults"]["shuffle.fetch"]["fired"] == 1

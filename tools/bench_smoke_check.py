@@ -103,6 +103,20 @@ def main():
         print("FAIL: ooc line carries no fallback_reasons list: %r"
               % sorted(ooc[0]))
         return 1
+    # ISSUE 5 satellite: chaos/recovery observability must ride the
+    # bench JSON — per-site fault counters (empty dict when no
+    # injection) and the degrade/resubmit/retry summary with reasons
+    if not isinstance(ooc[0].get("faults"), dict):
+        print("FAIL: ooc line carries no faults dict: %r"
+              % sorted(ooc[0]))
+        return 1
+    degrades = ooc[0].get("degrades")
+    if not isinstance(degrades, dict) \
+            or not isinstance(degrades.get("reasons"), list) \
+            or "resubmits" not in degrades:
+        print("FAIL: ooc line carries no degrades summary "
+              "(reasons/resubmits): %r" % (degrades,))
+        return 1
     # ISSUE 4 satellite: the segmented-apply A/B line must be present
     # with its schema (the ratio itself is not graded here — CI boxes
     # are too noisy — but the device side must have ridden the array
