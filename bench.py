@@ -203,6 +203,9 @@ def _ooc_phase():
     recovery = getattr(ctx.scheduler, "recovery_summary",
                        lambda: {})() or {}
     payload["faults"] = recovery.pop("faults", {})
+    # coded-shuffle decode counters (ISSUE 6): repair/straggler_win/
+    # decode_failures + the active mode, schema-gated like faults
+    payload["decodes"] = recovery.pop("decodes", {})
     payload["degrades"] = recovery
     ctx.stop()
     print("OOC_RESULT %s" % json.dumps(payload), flush=True)
@@ -567,6 +570,44 @@ def _stream_phase():
     print("STREAM_RESULT %s" % json.dumps({"t": dt}), flush=True)
 
 
+def _coded_phase():
+    """Child-process entry: coded-shuffle overhead A/B (ISSUE 6
+    acceptance) — the SAME shuffle-heavy host-path reduceByKey job
+    with the code off vs rs(4,2), NO faults injected.  The coded side
+    pays encode at map time plus the k-of-n framed shard reads at
+    reduce time; the acceptance bound is <= 15% wall overhead.  Runs
+    on the local master: the host bucket exchange is the path the
+    parity shards ride (the in-device all_to_all never touches
+    them)."""
+    from dpark_tpu import DparkContext, coding
+    n = int(os.environ.get("BENCH_CODED_PAIRS", "400000"))
+    parts = 8
+    ctx = DparkContext("local")
+
+    def run():
+        t0 = time.perf_counter()
+        cnt = (ctx.parallelize(range(n), parts)
+               .map(lambda i: (i % 10007, i))
+               .reduceByKey(lambda a, b: a + b, parts).count())
+        assert cnt == min(10007, n), cnt
+        return time.perf_counter() - t0
+
+    coding.configure(None)
+    run()                               # warm imports / page cache
+    t_off = min(run() for _ in range(2))
+    coding.configure("rs(4,2)")
+    coding.reset_counters()
+    try:
+        t_on = min(run() for _ in range(2))
+        stats = coding.stats()
+    finally:
+        coding.configure(None)
+    ctx.stop()
+    print("CODED_RESULT %s" % json.dumps(
+        {"t_off": t_off, "t_on": t_on, "decodes": stats, "pairs": n}),
+        flush=True)
+
+
 def _probe_phase():
     """Child-process entry: just initialize the device backend.  Fast on
     a healthy platform; hangs forever on a wedged axon tunnel — which is
@@ -684,6 +725,9 @@ def main():
         return
     if "--sg-only" in sys.argv:
         _sg_phase()
+        return
+    if "--coded-only" in sys.argv:
+        _coded_phase()
         return
     if "--probe" in sys.argv:
         _probe_phase()
@@ -844,6 +888,24 @@ def main():
             if emulated:
                 gout["emulated_cpu_mesh"] = True
             print(json.dumps(gout))
+    # coded-shuffle overhead A/B (ISSUE 6 acceptance): the same
+    # shuffle-heavy host-path job with the erasure code off vs
+    # rs(4,2), no faults — the premium paid for decode-not-recompute
+    # recovery must stay <= 15% wall
+    if os.environ.get("BENCH_CODED", "1") != "0":
+        got = _run_child("--coded-only", child_timeout,
+                         ok_prefix="CODED_RESULT ")
+        if got is not None:
+            c = json.loads(got)
+            cout = {"metric": "coded_shuffle_overhead",
+                    "value": round(c["t_on"]
+                                   / max(c["t_off"], 1e-9), 3),
+                    "unit": "x (lower is better; <=1.15 passes)",
+                    "t_off_s": round(c["t_off"], 3),
+                    "t_on_s": round(c["t_on"], 3),
+                    "pairs": c["pairs"],
+                    "coding": c["decodes"]}
+            print(json.dumps(cout))
     if not extras:
         return
     # third line: join/cogroup, BASELINE config #2

@@ -199,6 +199,8 @@ def main():
     recovery = getattr(ctx.scheduler, "recovery_summary",
                        lambda: {})() or {}
     out["faults"] = recovery.pop("faults", {})
+    # coded-shuffle decode counters (ISSUE 6), same shape as bench.py
+    out["decodes"] = recovery.pop("decodes", {})
     out["degrades"] = recovery
     ctx.stop()
     print(json.dumps(out), flush=True)

@@ -18,7 +18,10 @@ shuffle anti-patterns that dominate cost at production scale:
   unbounded-recovery     the same uncheckpointed depth while fault
                          injection (DPARK_FAULTS) is active: every
                          injected failure replays the whole chain —
-                         chaos runs need a recovery pin.
+                         chaos runs need a recovery pin.  Quiet when
+                         an erasure code with parity is active
+                         (DPARK_SHUFFLE_CODE, m >= 1): coded fetches
+                         decode instead of replaying lineage.
   plan-join-repartition  a cogroup/join whose inputs already share a
                          partitioner, re-exchanged because the join was
                          given a different partition count.
@@ -311,8 +314,16 @@ def _rule_unbounded_recovery(rdd, report, excess):
     a chaos run against such a plan measures recompute amplification,
     not recovery (ISSUE 5 satellite; the chaos twin of
     plan-wide-depth)."""
-    from dpark_tpu import faults
+    from dpark_tpu import coding, faults
     if excess is None or not faults.active():
+        return
+    # coded shuffle quiets the rule (ISSUE 6 satellite): with m >= 1
+    # parity shards on every bucket/spill payload, a failed or
+    # straggling fetch is DECODED from survivors instead of replayed
+    # through lineage — the chain no longer needs a checkpoint pin to
+    # bound recovery under injection
+    code = coding.active_code()
+    if code is not None and code.m >= 1:
         return
     depth, limit = excess
     report.add(

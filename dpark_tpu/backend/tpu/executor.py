@@ -471,6 +471,16 @@ class JAXExecutor:
         # exports stay lock-free — they touch no device.
         import threading
         self._export_lock = threading.Lock()
+        # coded-shuffle shard serving (ISSUE 6): each hbm bucket is
+        # lazily serialized + erasure-encoded ONCE, then individual
+        # framed shards answer per-shard fetches.  Builds serialize
+        # behind one lock (the n concurrent shard reads of one bucket
+        # must not each export the bucket); the cache is a small
+        # byte-bounded FIFO — shard fetches for one bucket arrive
+        # within one reduce task's fan-out, so entries age out fast.
+        self._shard_cache = {}        # (sid, map, reduce) -> [frames]
+        self._shard_cache_bytes = 0
+        self._shard_build_lock = threading.Lock()
         self._tracing = False
         if conf.TRACE_DIR:
             try:
@@ -2257,12 +2267,23 @@ class JAXExecutor:
         retry/escalation accounting."""
         import pickle
         import struct
-        from dpark_tpu import faults
+        from dpark_tpu import coding, faults
         from dpark_tpu.shuffle import SpillWriteError, spill_crc
         from dpark_tpu.utils import atomic_file, compress
         blob = compress(pickle.dumps(rows, -1))
-        crc = spill_crc(blob)   # over the TRUE bytes, pre-corruption
+        code = coding.active_code()
         try:
+            if code is not None:
+                # coded run (ISSUE 6): a shard container with
+                # per-shard crcs — a corrupted region is decoded
+                # around at read instead of failing the whole run
+                body = coding.encode_container(
+                    blob, code, fault_site="shuffle.spill_write")
+                with atomic_file(path) as f:
+                    f.write(body)
+                return
+            # over the TRUE bytes, pre-corruption
+            crc = spill_crc(blob)
             blob = faults.hit("shuffle.spill_write", blob)
             # tmp+rename: a failed or killed write never leaves a
             # partial file a reader could mistake for a short run
@@ -2277,11 +2298,23 @@ class JAXExecutor:
     def _read_run(path):
         import pickle
         import struct
-        from dpark_tpu import faults
+        from dpark_tpu import coding, faults
         from dpark_tpu.shuffle import SpillCorruption, spill_crc
         from dpark_tpu.utils import decompress
         with open(path, "rb") as f:
             raw = f.read()
+        if coding.is_container(raw):
+            # coded run: per-shard crcs; corruption repairs by decode,
+            # and only a sub-k survivor count escalates to lineage
+            try:
+                blob = coding.decode_container(
+                    raw, fault_site="shuffle.spill_read")
+            except coding.ShardShortfall as e:
+                raise SpillCorruption(
+                    "spill run %s: %d of %d shards survived "
+                    "(%d needed)" % (path, e.found, e.total,
+                                     e.needed)) from e
+            return pickle.loads(decompress(blob))
         (crc,) = struct.unpack("<I", raw[:4])
         blob = faults.hit("shuffle.spill_read", raw[4:])
         if spill_crc(blob) != crc:
@@ -2629,17 +2662,70 @@ class JAXExecutor:
     def has_shuffle(self, sid):
         return sid in self.shuffle_store
 
-    def export_bucket(self, sid, map_id, reduce_id):
+    def export_bucket(self, sid, map_id, reduce_id, shard=None):
         """Device-resident map output -> host (k, combiner) items, for
         host-path reduce stages (shuffle.read_bucket 'hbm://' uris).
-        Wall time accumulates in `export_seconds` (the per-phase bench
-        table's "export" column)."""
+        With `shard` set (coded shuffle, ISSUE 6) returns ONE framed
+        erasure shard of the bucket's serialized payload instead —
+        the fetch side decodes from the fastest k of n.  Wall time
+        accumulates in `export_seconds` (the per-phase bench table's
+        "export" column)."""
         import time as _time
         t0 = _time.perf_counter()
         try:
+            if shard is not None:
+                return self._export_shard(sid, map_id, reduce_id,
+                                          shard)
             return self._export_bucket(sid, map_id, reduce_id)
         finally:
             self.export_seconds += _time.perf_counter() - t0
+
+    # serialized+encoded bucket shards kept for re-fetch; beyond this
+    # the oldest buckets drop (re-encoding is cheap vs re-exporting)
+    _SHARD_CACHE_BYTES = 64 << 20
+
+    def _export_shard(self, sid, map_id, reduce_id, idx):
+        from dpark_tpu import coding
+        from dpark_tpu.utils import compress
+        code = coding.active_code()
+        if code is None:
+            raise ValueError(
+                "shard export requested with no shuffle code active")
+        import pickle
+        key = (sid, map_id, reduce_id)
+        # lock-free fast path: a built bucket's n shard requests must
+        # not queue behind another bucket's export (dict reads are
+        # GIL-atomic; entries are only ever replaced whole)
+        frames = self._shard_cache.get(key)
+        if frames is None:
+            with self._shard_build_lock:
+                frames = self._shard_cache.get(key)
+                if frames is None:
+                    # KeyError (no such hbm shuffle) propagates so the
+                    # fetch side tries the next exporter, same as the
+                    # whole-bucket protocol
+                    rows = self._export_bucket(sid, map_id, reduce_id)
+                    blob = compress(pickle.dumps(rows, -1))
+                    frames = coding.encode_bucket_frames(blob, code)
+                    self._shard_cache[key] = frames
+                    self._shard_cache_bytes += sum(
+                        len(f) for f in frames)
+                    # insertion-ordered (FIFO) eviction: shard fetches
+                    # for one bucket arrive within one reduce task's
+                    # fan-out, so age tracks usefulness closely enough
+                    while (self._shard_cache_bytes
+                           > self._SHARD_CACHE_BYTES
+                           and len(self._shard_cache) > 1):
+                        old_key = next(iter(self._shard_cache))
+                        if old_key == key:
+                            break
+                        dropped = self._shard_cache.pop(old_key)
+                        self._shard_cache_bytes -= sum(
+                            len(f) for f in dropped)
+        if not 0 <= idx < len(frames):
+            raise ValueError("shard index %d out of range (n=%d)"
+                             % (idx, len(frames)))
+        return frames[idx]
 
     def _export_bucket(self, sid, map_id, reduce_id):
         store = self.shuffle_store.get(sid)
@@ -2773,6 +2859,10 @@ class JAXExecutor:
         return [(td.decode(int(r[0])),) + tuple(r[1:]) for r in rows]
 
     def drop_shuffle(self, sid):
+        with self._shard_build_lock:
+            for key in [k for k in self._shard_cache if k[0] == sid]:
+                self._shard_cache_bytes -= sum(
+                    len(f) for f in self._shard_cache.pop(key))
         store = self.shuffle_store.pop(sid, None)
         if store:
             self._store_bytes -= store["nbytes"]

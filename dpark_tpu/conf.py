@@ -63,6 +63,25 @@ DPARK_FAULTS = os.environ.get("DPARK_FAULTS", "")
 # still falls back to the object path, without the halved retry).
 DEGRADE = os.environ.get("DPARK_DEGRADE", "1") != "0"
 
+# erasure-coded shuffle exchange (dpark_tpu/coding.py — ISSUE 6):
+#   off      no parity (default; zero hot-path cost)
+#   xor      4 data shards + 1 XOR parity per bucket/spill payload
+#   xor(k)   same with k data shards
+#   rs(k,m)  k data + m Reed-Solomon GF(2^8) parity shards
+# With coding on, shuffle buckets and spill runs carry parity shards;
+# the fetch side reads all n shards concurrently and DECODES from the
+# fastest k — a failed or straggling fetch costs a decode, not a
+# lineage recompute.  Counters surface as `decodes` in job records,
+# recovery_summary(), and the bench JSON.
+DPARK_SHUFFLE_CODE = os.environ.get("DPARK_SHUFFLE_CODE", "off")
+
+# per-shard fetch attempts before a shard counts as lost (coded mode
+# only; attempts past the first cycle through replica uris).  Retries
+# are cheap relative to a decode failure's lineage fallback, so keep
+# this >= 2 under fault injection.
+SHUFFLE_SHARD_ATTEMPTS = int(os.environ.get(
+    "DPARK_SHUFFLE_SHARD_ATTEMPTS", "3") or 1)
+
 # dcn transient-connect retry: total attempts (1 = no retry) and the
 # base backoff seconds (exponential with full jitter: attempt k sleeps
 # uniform in [base*2^k/2, base*2^k]).  Application-level ServerError

@@ -203,6 +203,33 @@ class BucketServer(FramedServer):
                 except KeyError:
                     continue
             raise FileNotFoundError(path)
+        if kind == "bucket_shard":
+            # coded shuffle (ISSUE 6): ONE framed erasure shard of a
+            # map output bucket.  An empty payload is the MISS
+            # sentinel — the bucket was written uncoded (or this
+            # server's coding is off for its HBM store), and the
+            # fetch side falls back to the plain bucket protocol.
+            _, sid, map_id, reduce_id, idx = req
+            path = os.path.join(self.workdir, "shuffle", str(sid),
+                                str(map_id),
+                                "%d.shards" % reduce_id)
+            if os.path.exists(path):
+                from dpark_tpu import coding
+                with open(path, "rb") as f:
+                    try:
+                        return coding.extract_container_frame(
+                            f.read(), idx)
+                    except KeyError:
+                        return b""      # container holds no such shard
+            from dpark_tpu import shuffle as shuffle_mod
+            for exporter in shuffle_mod.HBM_EXPORTERS.values():
+                try:
+                    return exporter(sid, map_id, reduce_id, shard=idx)
+                except KeyError:
+                    continue        # this exporter owns no such sid
+                except ValueError:
+                    break           # no code active / bad shard index
+            return b""
         if kind == "bcast_meta":
             _, bid = req
             path = os.path.join(self.workdir, "broadcast",

@@ -244,6 +244,15 @@ def active():
     return _PLANE is not None and bool(_PLANE.specs)
 
 
+def site_active(site):
+    """True when the installed plane names `site`.  Fetch paths use
+    this to decide whether per-shard chaos routing is worth racing
+    through a thread pool (an injected delay must be able to LOSE the
+    fastest-k race) or can run inline on the hot path."""
+    plane = _PLANE
+    return plane is not None and site in plane.specs
+
+
 def hit(site, payload=None):
     """Record a hit at `site`.  May raise (raise/enospc/oom kinds),
     sleep (delay), or return a corrupted copy of `payload` (corrupt);

@@ -156,6 +156,7 @@ class DAGScheduler:
                 raise
             finally:
                 record["seconds"] = round(_time.time() - t0, 3)
+                self._finalize_decodes(record)
             return
 
         output_parts = list(partitions)
@@ -258,6 +259,7 @@ class DAGScheduler:
             if record["state"] == "running":
                 record["state"] = "done" if all(finished) else "aborted"
             record["seconds"] = round(_time.time() - job_t0, 3)
+            self._finalize_decodes(record)
 
     def _new_job_record(self, final_rdd, parts, stages=1):
         self._next_job_id += 1
@@ -270,10 +272,48 @@ class DAGScheduler:
                   # per-stage timings
                   "lint": list(getattr(final_rdd, "_lint_findings",
                                        ()) or ())}
+        # coded-shuffle decode accounting (ISSUE 6): counters are
+        # process-global, so each job snapshots a baseline at start
+        # and takes the delta at finish (popped before the record
+        # ships as JSON)
+        from dpark_tpu import coding
+        record["_decode_base"] = coding.counters_snapshot()
         self.history.append(record)
         del self.history[:-100]
         self._current_record = record
         return record
+
+    def _finalize_decodes(self, record):
+        """Attribute coded-shuffle decode activity since the job
+        started to this job record (ISSUE 6): the totals delta rides
+        as ``record["decodes"]`` (repair = parity replaced a FAILED
+        shard, straggler_win = parity merely beat a slow one,
+        decode_failures = fewer than k survived and lineage had to
+        pay), and per-shuffle deltas land on the PARENT stage whose
+        outputs were decoded — the web UI's per-stage decode
+        column."""
+        from dpark_tpu import coding
+        base = record.pop("_decode_base", None)
+        if base is None:
+            return
+        snap = coding.counters_snapshot()
+        base_totals = base.get("totals", {})
+        totals = {k: v - base_totals.get(k, 0)
+                  for k, v in snap["totals"].items()}
+        if any(totals.values()) or coding.active():
+            record["decodes"] = dict(totals, mode=coding.describe())
+        base_per = base.get("per_shuffle", {})
+        for sid, counts in snap.get("per_shuffle", {}).items():
+            prev = base_per.get(sid, {})
+            delta = {k: v - prev.get(k, 0) for k, v in counts.items()}
+            if not any(delta.values()):
+                continue
+            parent = self.shuffle_to_stage.get(sid)
+            if parent is not None:
+                info = self._stage_info(record, parent.id)
+                d = info.setdefault("decodes", {})
+                for k, v in delta.items():
+                    d[k] = d.get(k, 0) + v
 
     def _stage_info(self, record, stage_id):
         """The per-stage observability dict inside a job record
@@ -326,7 +366,7 @@ class DAGScheduler:
         JSON's `faults`/`degrades` sections (ISSUE 5 satellite):
         proves in CI that injected faults actually fired and recovery
         actually ran."""
-        from dpark_tpu import faults
+        from dpark_tpu import coding, faults
         out = {"resubmits": 0, "recomputes": 0, "retries": 0,
                "fetch_failed": 0, "speculated": 0}
         for rec in self.history:
@@ -334,6 +374,12 @@ class DAGScheduler:
                 out[k] += rec.get(k, 0)
         out["reasons"] = self.degrade_reasons()
         out["faults"] = faults.stats()
+        # coded-shuffle view (ISSUE 6): repair / straggler_win /
+        # decode_failures + the active mode.  decode_failures stays
+        # DISTINCT from fetch_failed above — a failed decode names how
+        # close parity came (shards_found/shards_needed ride the
+        # FetchFailed), a plain fetch failure never had parity at all.
+        out["decodes"] = coding.stats()
         return out
 
     def phase_table(self):

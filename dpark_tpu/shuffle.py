@@ -17,9 +17,9 @@ import os
 import pickle
 import struct
 import threading
-from queue import Queue
+from queue import Empty, Queue
 
-from dpark_tpu import conf, faults
+from dpark_tpu import coding, conf, faults
 from dpark_tpu.utils import atomic_file, compress, decompress
 from dpark_tpu.utils.log import get_logger
 
@@ -79,14 +79,30 @@ class LocalFileShuffle:
     def write_buckets(shuffle_id, map_id, buckets):
         """buckets: list (len = n_reduce) of dict or list of (k, combiner).
 
+        With a shuffle code active (DPARK_SHUFFLE_CODE — ISSUE 6) each
+        bucket is written as ONE shard-container file (n = k+m framed
+        erasure shards with per-shard crc32c, `<reduce>.shards`): a
+        local read decodes the container in one I/O, while remote
+        peers fetch individual shard frames concurrently and decode
+        from the fastest k — an injected/real fetch failure costs a
+        decode, not a lineage recompute.
+
         Returns the server URI advertising these outputs."""
+        code = coding.active_code()
         for reduce_id, bucket in enumerate(buckets):
             items = list(bucket.items()) if isinstance(bucket, dict) \
                 else list(bucket)
             path = LocalFileShuffle.get_output_file(
                 shuffle_id, map_id, reduce_id)
-            with atomic_file(path) as f:
-                f.write(compress(pickle.dumps(items, -1)))
+            blob = compress(pickle.dumps(items, -1))
+            # no fsync: bucket files are lineage-recomputable, and the
+            # per-file durability barrier dominates the bucket write
+            if code is None:
+                with atomic_file(path, fsync=False) as f:
+                    f.write(blob)
+                continue
+            with atomic_file(path + ".shards", fsync=False) as f:
+                f.write(coding.encode_container(blob, code))
         return LocalFileShuffle.get_server_uri()
 
 
@@ -119,6 +135,39 @@ def read_bucket(uri, shuffle_id, map_id, reduce_id):
     raise ValueError("unsupported shuffle uri %r" % uri)
 
 
+def read_bucket_shard(uri, shuffle_id, map_id, reduce_id, idx):
+    """Fetch ONE framed shard of a coded map output bucket (the
+    remote fetch unit; local file:// fetches read the whole container
+    instead — see _fetch_coded_local)."""
+    if uri.startswith("hbm://"):
+        for exporter in HBM_EXPORTERS.values():
+            try:
+                return exporter(shuffle_id, map_id, reduce_id,
+                                shard=idx)
+            except KeyError:
+                continue
+        raise ValueError("no exporter for %r" % uri)
+    if uri.startswith("file://"):
+        workdir = uri[len("file://"):]
+        path = os.path.join(workdir, "shuffle", str(shuffle_id),
+                            str(map_id), "%d.shards" % reduce_id)
+        with open(path, "rb") as f:
+            return coding.extract_container_frame(f.read(), idx)
+    if uri.startswith("tcp://"):
+        from dpark_tpu import dcn
+        payload = dcn.fetch(
+            uri, ("bucket_shard", shuffle_id, map_id, reduce_id, idx))
+        if not payload:
+            # the peer's miss sentinel: that bucket has no shard files
+            # (written uncoded) — the caller falls back to the plain
+            # bucket protocol
+            raise FileNotFoundError(
+                "no shard %d for %d/%d/%d at %s"
+                % (idx, shuffle_id, map_id, reduce_id, uri))
+        return payload
+    raise ValueError("unsupported shuffle uri %r" % uri)
+
+
 def uri_host(uri):
     """The host-health key of a shuffle location: the peer hostname for
     tcp:// uris, the uri itself otherwise (file/hbm locations fail for
@@ -128,25 +177,334 @@ def uri_host(uri):
     return uri
 
 
+class _Uncoded(Exception):
+    """Internal: the bucket has no shard files anywhere — it was
+    written without parity.  The caller retries the plain protocol."""
+
+
+class _ShardPool:
+    """Persistent daemon worker pool for shard fetch attempts: a fresh
+    thread per shard (n per bucket, every bucket) costs more than the
+    local file read it performs — workers park on the task queue and
+    are reused across buckets/jobs.  Grows lazily to `size`; daemon
+    threads so a stuck peer read never blocks interpreter exit."""
+
+    def __init__(self, size=32):
+        self.tasks = Queue()
+        self.size = size
+        self.nthreads = 0
+        self.lock = threading.Lock()
+
+    def submit(self, fn, *args):
+        self.tasks.put((fn, args))
+        with self.lock:
+            if self.nthreads < self.size:
+                self.nthreads += 1
+                threading.Thread(target=self._worker, daemon=True,
+                                 name="dpark-shard-fetch").start()
+
+    def _worker(self):
+        while True:
+            fn, args = self.tasks.get()
+            fn(*args)       # attempt() never raises (result queue)
+
+
+_SHARD_POOL = _ShardPool()
+
+
+def _shard_miss(err):
+    """Errors that mean 'this bucket was never coded' (vs a transient
+    fetch failure worth retrying): missing shard file, no HBM store,
+    no exporter owning the shuffle."""
+    return isinstance(err, (FileNotFoundError, KeyError)) or (
+        isinstance(err, ValueError) and "no exporter" in str(err))
+
+
+def _fetch_coded(ordered, shuffle_id, map_id, reduce_id, code, hm):
+    """Fastest-k-of-n shard fetch: issue ALL n shard reads
+    concurrently, decode as soon as any k arrive.  A failed shard
+    attempt retries up to conf.SHUFFLE_SHARD_ATTEMPTS times (cycling
+    through replica uris); a straggling shard simply loses the race.
+    Translates a short count into FetchFailed carrying
+    shards_found/shards_needed only when fewer than k survive."""
+    n, k = code.n, code.k
+    results = Queue()
+    attempts_cap = max(1, conf.SHUFFLE_SHARD_ATTEMPTS)
+
+    def attempt(idx, attempt_no):
+        uri = ordered[(attempt_no - 1) % len(ordered)]
+        try:
+            # chaos site: one hit per shard ATTEMPT — under injection
+            # the decode-instead-of-recompute path is what's exercised
+            faults.hit("shuffle.fetch")
+            raw = read_bucket_shard(uri, shuffle_id, map_id,
+                                    reduce_id, idx)
+            fr = coding.unpack_shard(raw)
+            results.put((idx, None, fr, uri))
+        except BaseException as e:
+            results.put((idx, e, None, uri))
+
+    def spawn(idx, attempt_no):
+        _SHARD_POOL.submit(attempt, idx, attempt_no)
+
+    for idx in range(n):
+        spawn(idx, 1)
+    outstanding = n
+    tries = dict.fromkeys(range(n), 1)
+    got = {}
+    errors = {}
+    misses = 0
+    orig_len = 0
+    had_error = False
+    frame_code = None
+    while len(got) < k and outstanding:
+        idx, err, fr, uri = results.get()
+        outstanding -= 1
+        if err is None:
+            if frame_code is None:
+                # the shards are SELF-DESCRIBING: the writer's
+                # geometry (header algo/k/m) governs the decode, not
+                # the reader's config — a reader whose configured code
+                # drifted from the writer's must not solve the wrong
+                # matrix against the payload bytes.  Extra writer
+                # shards the initial fan-out didn't know about are
+                # requested as soon as the true n is known.
+                frame_code = coding.Code(fr.algo, fr.k, fr.m)
+                for extra in range(n, frame_code.n):
+                    tries[extra] = 1
+                    spawn(extra, 1)
+                    outstanding += 1
+                n, k = frame_code.n, frame_code.k
+            elif (fr.algo, fr.k, fr.m) != (frame_code.algo,
+                                           frame_code.k,
+                                           frame_code.m):
+                # geometry disagreement inside one bucket: the frame
+                # is corrupt or foreign — drop it like a failed shard
+                had_error = True
+                errors.setdefault(idx, coding.ShardCorrupt(
+                    "shard %d: geometry %r != bucket %r"
+                    % (idx, (fr.algo, fr.k, fr.m),
+                       frame_code.describe())))
+                continue
+            if idx not in got:
+                got[idx] = fr.payload
+                orig_len = fr.orig_len
+            if uri.startswith("tcp://"):
+                hm.task_succeed_on(uri_host(uri))
+            continue
+        if _shard_miss(err):
+            # an absent shard never materializes on the SAME replica,
+            # but another replica may still hold it (e.g. the first
+            # host lost its files): try each uri once before the miss
+            # becomes definitive
+            if tries[idx] < len(ordered):
+                tries[idx] += 1
+                spawn(idx, tries[idx])
+                outstanding += 1
+                continue
+            errors.setdefault(idx, err)
+            misses += 1
+            continue
+        had_error = True
+        hm.task_failed_on(uri_host(uri))
+        logger.warning("shard fetch failed %s #%d: %s", uri, idx, err)
+        if tries[idx] < attempts_cap:
+            tries[idx] += 1
+            spawn(idx, tries[idx])
+            outstanding += 1
+        else:
+            errors[idx] = err
+    if len(got) < k:
+        if misses >= n and not had_error:
+            raise _Uncoded()
+        coding.note("decode_failures", shuffle_id)
+        err = FetchFailed(ordered[0] if ordered else None, shuffle_id,
+                          map_id, reduce_id, shards_found=len(got),
+                          shards_needed=k)
+        err.__cause__ = next(iter(errors.values()), None)
+        raise err
+    # scoop up results that landed in the same instant without
+    # blocking: data shards already in the queue beat reconstructing
+    # their chunks from parity via GF arithmetic
+    while outstanding:
+        try:
+            idx, err, fr, uri = results.get_nowait()
+        except Empty:
+            break
+        outstanding -= 1
+        if err is None and idx not in got and frame_code is not None \
+                and (fr.algo, fr.k, fr.m) == (frame_code.algo,
+                                              frame_code.k,
+                                              frame_code.m):
+            got[idx] = fr.payload
+    used_parity = any(j not in got for j in range(k))
+    blob = (frame_code or code).decode(got, orig_len)
+    if used_parity:
+        # parity actually reconstructed data: a failed shard was
+        # REPAIRED, or a merely-slow one lost the race (straggler
+        # win) — either way, zero lineage recompute
+        coding.note("repair" if had_error else "straggler_win",
+                    shuffle_id)
+    return pickle.loads(decompress(blob))
+
+
+def _fetch_coded_local(ordered, shuffle_id, map_id, reduce_id):
+    """Local (file://) coded fetch: ONE read of the bucket's shard
+    container, then per-shard chaos-site routing + crc verification.
+    A shard verifies ONCE per pass — one that raises (or whose
+    injected corruption trips the crc) is an ERASURE the decode works
+    around, exactly like a lost remote shard (repair counter).  Only
+    a SHORTFALL (fewer than k verified) re-verifies the failed shards
+    from the pristine container bytes, up to
+    conf.SHUFFLE_SHARD_ATTEMPTS passes total: transient faults still
+    rescue a multi-loss bucket without masking the decode path.
+
+    With the `shuffle.fetch` chaos site armed the verifications RACE
+    through the shard pool and decode proceeds from the fastest k, so
+    an injected delay loses the race (straggler_win) just as a slow
+    peer would remotely.  Without it they run inline, data shards
+    first — a local read has no real stragglers, and with all k data
+    shards intact the parity crcs need not be touched at all."""
+    attempts_cap = max(1, conf.SHUFFLE_SHARD_ATTEMPTS)
+    raw = None
+    for uri in ordered:
+        if not uri.startswith("file://"):
+            continue
+        path = os.path.join(uri[len("file://"):], "shuffle",
+                            str(shuffle_id), str(map_id),
+                            "%d.shards" % reduce_id)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            break
+        except FileNotFoundError:
+            continue
+    if raw is None:
+        raise _Uncoded()        # no container anywhere: plain path
+    frames = coding.parse_container(raw)
+    k = frames[0].k if frames else 1
+    orig_len = frames[0].orig_len if frames else 0
+    good = {}
+    failed = []
+    had_error = False
+
+    def verify(fr):
+        payload = faults.hit("shuffle.fetch", fr.payload)
+        if coding._crc(payload) != fr.crc:
+            raise coding.ShardCorrupt(
+                "shard %d: crc32c mismatch" % fr.idx)
+        return payload
+
+    if faults.site_active("shuffle.fetch"):
+        results = Queue()
+
+        def attempt(fr):
+            try:
+                results.put((fr, None, verify(fr)))
+            except BaseException as e:
+                results.put((fr, e, None))
+
+        for fr in frames:
+            _SHARD_POOL.submit(attempt, fr)
+        outstanding = len(frames)
+        while len(good) < k and outstanding:
+            fr, err, payload = results.get()
+            outstanding -= 1
+            if err is None:
+                good.setdefault(fr.idx, payload)
+            else:
+                had_error = True
+                failed.append(fr)
+        # scoop up same-instant arrivals without blocking: data
+        # shards already verified beat reconstructing their chunks
+        # from parity via GF arithmetic
+        while outstanding:
+            try:
+                fr, err, payload = results.get_nowait()
+            except Empty:
+                break
+            outstanding -= 1
+            if err is None:
+                good.setdefault(fr.idx, payload)
+    else:
+        data = [fr for fr in frames if fr.idx < k]
+        parity = [fr for fr in frames if fr.idx >= k]
+        for fr in data:
+            try:
+                good[fr.idx] = verify(fr)
+            except Exception:
+                had_error = True
+                failed.append(fr)
+        if len(good) < k:       # real corruption: decode from parity
+            for fr in parity:
+                try:
+                    good[fr.idx] = verify(fr)
+                except Exception:
+                    had_error = True
+                    failed.append(fr)
+    for _ in range(attempts_cap - 1):
+        if len(good) >= k or not failed:
+            break
+        still = []
+        for fr in failed:
+            try:
+                good.setdefault(fr.idx, verify(fr))
+            except Exception:
+                still.append(fr)
+        failed = still
+    if not frames or len(good) < k:
+        coding.note("decode_failures", shuffle_id)
+        raise FetchFailed(ordered[0], shuffle_id, map_id, reduce_id,
+                          shards_found=len(good), shards_needed=k)
+    code = coding.Code(frames[0].algo, frames[0].k, frames[0].m)
+    blob = code.decode(good, orig_len)
+    if any(j not in good for j in range(k)):
+        # parity reconstructed a data shard: a failed one was
+        # REPAIRED, or a merely-slow one lost the race (straggler
+        # win) — either way, zero lineage recompute
+        coding.note("repair" if had_error else "straggler_win",
+                    shuffle_id)
+    return pickle.loads(decompress(blob))
+
+
 def read_bucket_any(uris, shuffle_id, map_id, reduce_id):
     """Fetch one map output from the best of its REPLICA locations.
 
     `uris`: one uri string, or a list/tuple of replicas (a map output
-    re-served from several hosts).  Replicas are tried in
-    hostatus-ranked order — a blacklisted host is skipped while any
-    healthy replica exists, and every attempt's outcome feeds back into
-    the shared health view (SURVEY.md section 5.3: the blacklist must
-    CHANGE where the bytes come from, not just count failures).
-    Raises FetchFailed when every replica fails."""
+    re-served from several hosts).  Replicas are DEDUPLICATED in
+    first-seen order (a duplicated uri would waste an attempt and skew
+    the first-error report), then tried in hostatus-ranked order — a
+    blacklisted host is skipped while any healthy replica exists, and
+    every attempt's outcome feeds back into the shared health view
+    (SURVEY.md section 5.3: the blacklist must CHANGE where the bytes
+    come from, not just count failures).  With a shuffle code active
+    the bucket is fetched shard-wise (fastest k of n, decode instead
+    of FetchFailed).  Raises FetchFailed when every replica fails."""
     from dpark_tpu.env import env
     if isinstance(uris, str):
         uris = (uris,)
     hm = env.host_manager
-    ordered = list(uris)
+    ordered = list(dict.fromkeys(uris))
     if len(ordered) > 1:
         # hostatus ranking by each replica's HOST (two replicas on one
         # host share fate): healthy-first, then by recent failure rate
         ordered = hm.rank_items(ordered, uri_host)
+    code = coding.active_code()
+    if code is not None and ordered:
+        try:
+            # the one-I/O container fast path only when EVERY replica
+            # is local; with any remote replica in the list the
+            # per-shard protocol runs so a short local container (or
+            # a coded bucket that only exists remotely) still decodes
+            # from the other replicas — per-shard attempts cycle
+            # through the full uri list
+            if all(u.startswith("file://") for u in ordered):
+                return _fetch_coded_local(ordered, shuffle_id,
+                                          map_id, reduce_id)
+            return _fetch_coded(ordered, shuffle_id, map_id,
+                                reduce_id, code, hm)
+        except _Uncoded:
+            pass        # bucket predates the code config: plain path
     last_err = None
     for uri in ordered:
         try:
@@ -282,14 +640,29 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
 
 class FetchFailed(Exception):
     """Signals the DAG scheduler to resubmit the parent stage (lineage
-    recovery — SURVEY.md section 5.3)."""
+    recovery — SURVEY.md section 5.3).
 
-    def __init__(self, uri, shuffle_id, map_id, reduce_id):
+    When raised from a FAILED DECODE (coded shuffle, fewer than k
+    shards survived) it carries `shards_found`/`shards_needed` so the
+    error names how close the decode came; `recovery_summary()` counts
+    these separately as `decode_failures` (ISSUE 6 satellite)."""
+
+    def __init__(self, uri, shuffle_id, map_id, reduce_id,
+                 shards_found=None, shards_needed=None):
         super().__init__(uri, shuffle_id, map_id, reduce_id)
         self.uri = uri
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.reduce_id = reduce_id
+        self.shards_found = shards_found
+        self.shards_needed = shards_needed
+
+    def __str__(self):
+        base = super().__str__()
+        if self.shards_needed is not None:
+            base += " [decode failed: %s of %s shards needed]" % (
+                self.shards_found, self.shards_needed)
+        return base
 
 
 # ---------------------------------------------------------------------------
@@ -381,9 +754,20 @@ class DiskSpillMerger(Merger):
                             % (id(self), len(self.spills)))
         items = sorted(self.combined.items(), key=lambda kv: kv[0])
         chunk = conf.SHUFFLE_CHUNK_RECORDS
+        code = coding.active_code()
         with atomic_file(path) as f:
             for i in range(0, len(items), chunk):
                 blob = compress(pickle.dumps(items[i:i + chunk], -1))
+                if code is not None:
+                    # coded chunk: a shard container with per-shard
+                    # crcs — corruption drops one shard, the read
+                    # decodes around it (no recompute); the outer crc
+                    # field is unused on this path
+                    body = coding.encode_container(
+                        blob, code, fault_site="shuffle.spill_write")
+                    f.write(struct.pack("<QI", len(body), 0))
+                    f.write(body)
+                    continue
                 # crc over the TRUE bytes, computed before the chaos
                 # site may corrupt them — exactly what disk rot does
                 crc = spill_crc(blob)
@@ -405,7 +789,33 @@ class DiskSpillMerger(Merger):
                 if not hdr:
                     return
                 n, crc = struct.unpack("<QI", hdr)
-                blob = faults.hit("shuffle.spill_read", f.read(n))
+                raw = f.read(n)
+                if coding.is_container(raw):
+                    # coded chunk (ISSUE 6): per-shard crcs inside the
+                    # container; corruption is decoded around, and only
+                    # a sub-k survivor count escalates to lineage
+                    try:
+                        blob = coding.decode_container(
+                            raw, fault_site="shuffle.spill_read",
+                            shuffle_id=self.shuffle_id)
+                    except coding.ShardShortfall as e:
+                        err = SpillCorruption(
+                            "spill run %s: %d of %d shards survived "
+                            "(%d needed)" % (path, e.found, e.total,
+                                             e.needed))
+                        if self.shuffle_id is not None:
+                            ff = FetchFailed(
+                                None, self.shuffle_id, -1,
+                                self.reduce_id,
+                                shards_found=e.found,
+                                shards_needed=e.needed)
+                            ff.__cause__ = err
+                            raise ff
+                        raise err
+                    for kv in pickle.loads(decompress(blob)):
+                        yield kv
+                    continue
+                blob = faults.hit("shuffle.spill_read", raw)
                 if spill_crc(blob) != crc:
                     err = SpillCorruption(
                         "spill run %s: crc32c mismatch (corrupted "

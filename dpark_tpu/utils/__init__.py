@@ -33,10 +33,15 @@ except ImportError:
 
 
 @contextlib.contextmanager
-def atomic_file(path, mode="wb"):
+def atomic_file(path, mode="wb", fsync=True):
     """Write to a temp file in the same dir, fsync, rename over `path`.
 
     Reference parity: dpark/utils/atomic_file.py.
+
+    `fsync=False` keeps the no-partial-file guarantee (tmp+rename)
+    but skips the durability barrier — for outputs that are
+    recomputable through lineage anyway (shuffle bucket files), where
+    the per-file fsync dominates the bucket write on slow filesystems.
     """
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
@@ -45,7 +50,8 @@ def atomic_file(path, mode="wb"):
     try:
         yield f
         f.flush()
-        os.fsync(f.fileno())
+        if fsync:
+            os.fsync(f.fileno())
         f.close()
         os.rename(tmp, path)
     except BaseException:

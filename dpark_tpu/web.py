@@ -34,12 +34,14 @@ _PAGE = """<!doctype html>
 <h2>dpark_tpu jobs</h2>
 <table id="t"><tr><th>job</th><th>scope</th><th>parts</th>
 <th>finished</th><th>stages</th><th>seconds</th><th>state</th>
-<th>recovery (resubmit/recompute/retry)</th></tr></table>
+<th>recovery (resubmit/recompute/retry)</th>
+<th>decodes (repair/straggler/fail)</th></tr></table>
 <h2>stages <small>(click a row for its tasks; DAG per job below)</small></h2>
 <table id="s"><tr><th>job</th><th>stage</th><th>rdd</th>
 <th>parts</th><th>kind</th><th>seconds</th><th>device run s</th>
 <th>HBM bytes</th><th>wire bytes</th><th>pad eff</th>
 <th>waves</th><th>idle %</th><th>pipeline ms (in/cmp/xchg/spill)</th>
+<th>decodes</th>
 <th>fallback / degrade</th>
 </tr></table>
 <div id="dags"></div>
@@ -83,8 +85,15 @@ async function tick() {
     // resubmits / intact-parent recomputes / task retries per job
     const rec = (j.resubmits || 0) + '/' + (j.recomputes || 0) + '/' +
                 (j.retries || 0);
+    // coded-shuffle decode accounting (ISSUE 6): parity repairs /
+    // straggler wins / failed decodes attributed to this job, with
+    // the active code mode when one is configured
+    const dj = j.decodes || {};
+    const dec = dj.mode
+      ? (dj.repair || 0) + '/' + (dj.straggler_win || 0) + '/' +
+        (dj.decode_failures || 0) + ' [' + dj.mode + ']' : '';
     for (const v of [j.id, j.scope, j.parts, j.finished, j.stages,
-                     j.seconds, j.state, rec])
+                     j.seconds, j.state, rec, dec])
       row.insertCell().textContent = v;
     row.className = j.state === 'done' ? 'done' : 'run';
     const d = document.createElement('div');
@@ -102,10 +111,16 @@ async function tick() {
       // why the stage left (or nearly left) the array path: the
       // analyze-time fallback_reason or the runtime degrade_reason
       const why = st.fallback_reason || st.degrade_reason || '';
+      // per-stage decode deltas: activity against THIS stage's map
+      // outputs (the parent whose buckets were decoded from parity)
+      const ds = st.decodes || {};
+      const sdec = Object.keys(ds).length
+        ? (ds.repair || 0) + '/' + (ds.straggler_win || 0) + '/' +
+          (ds.decode_failures || 0) : '';
       for (const v of [j.id, st.id, st.rdd, st.parts, st.kind,
                        st.seconds, st.run_seconds, st.hbm_bytes,
                        st.wire_bytes, st.pad_efficiency,
-                       p.waves, idle, pms, why])
+                       p.waves, idle, pms, sdec, why])
         sr.insertCell().textContent = v === undefined ? '' : v;
       sr.className = 'stage ' + (st.seconds === null ? 'run' : 'done');
       const key = j.id + ':' + st.id;
@@ -115,7 +130,7 @@ async function tick() {
       };
       if (open.has(key)) {
         const dr = s.insertRow();
-        const c = dr.insertCell(); c.colSpan = 14;
+        const c = dr.insertCell(); c.colSpan = 15;
         c.className = 'tasks'; c.innerHTML = taskRows(st);
       }
     }

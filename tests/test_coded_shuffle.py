@@ -1,0 +1,520 @@
+"""Coded shuffle (ISSUE 6): erasure-coded exchange that survives
+faults and stragglers with ZERO lineage recompute.
+
+Two layers of proof:
+
+* codec property tests — GF(2^8) Reed–Solomon and systematic XOR
+  encode/decode over arbitrary payloads: ANY m erasures recoverable,
+  m+1 not, numpy and pure-Python paths bit-identical;
+* the chaos matrix — {xor, rs(4,2)} x {fetch fault p=0.2, spill
+  corruption, straggler delay} x {host path, device ``hbm://`` path},
+  every cell asserting bit-identical results with
+  ``resubmits == recomputes == 0`` and decode counters > 0 — the same
+  injections that cost PR 5's lineage path a full resubmit round now
+  cost one decode.
+
+Device tests run on a 2-device sliced mesh ("tpu:2") so the suite
+works on small containers."""
+
+import itertools
+import operator
+import os
+
+import numpy as np
+import pytest
+
+from dpark_tpu import coding, conf, faults
+from dpark_tpu.coding import Code, ShardShortfall, parse_code
+from dpark_tpu.shuffle import (FetchFailed, LocalFileShuffle,
+                               SpillCorruption, read_bucket_any)
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    """Every test starts and ends with no chaos plane, no shuffle
+    code, and fresh decode counters."""
+    faults.configure(None)
+    coding.configure(None)
+    coding.reset_counters()
+    yield
+    faults.configure(None)
+    coding.configure(None)
+    coding.reset_counters()
+
+
+@pytest.fixture()
+def tctx2():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu:2")
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def tiny_waves():
+    old = conf.STREAM_CHUNK_ROWS
+    conf.STREAM_CHUNK_ROWS = 500
+    yield
+    conf.STREAM_CHUNK_ROWS = old
+
+
+# ---------------------------------------------------------------------------
+# codec: grammar + GF(2^8) properties
+# ---------------------------------------------------------------------------
+
+def test_parse_code_grammar():
+    assert parse_code("off") is None
+    assert parse_code("") is None
+    assert parse_code(None) is None
+    assert parse_code("xor").describe() == "xor(4)"
+    assert parse_code("xor(8)").describe() == "xor(8)"
+    assert parse_code("rs(4,2)").describe() == "rs(4,2)"
+    assert parse_code("RS(10, 4)").describe() == "rs(10,4)"
+    for bad in ("xr", "rs(4)", "rs(4,2,1)", "xor(a)", "rs"):
+        with pytest.raises(ValueError):
+            parse_code(bad)
+
+
+def test_code_rejects_bad_geometry():
+    with pytest.raises(ValueError):
+        Code(coding.ALGO_RS, 0, 1)
+    with pytest.raises(ValueError):
+        Code(coding.ALGO_RS, 4, 0)
+    with pytest.raises(ValueError):
+        Code(coding.ALGO_XOR, 4, 2)         # xor is single-loss only
+    with pytest.raises(ValueError):
+        Code(coding.ALGO_RS, 200, 60)       # k+m > 255 over GF(2^8)
+
+
+def test_gf_field_axioms():
+    from dpark_tpu.coding import gf_inv, gf_mul
+    assert gf_mul(0, 77) == 0 and gf_mul(77, 1) == 77
+    for a in (1, 2, 37, 129, 255):
+        assert gf_mul(a, gf_inv(a)) == 1
+    # commutativity + a distributivity spot check (xor is addition)
+    assert gf_mul(23, 99) == gf_mul(99, 23)
+    assert gf_mul(7, 12 ^ 200) == gf_mul(7, 12) ^ gf_mul(7, 200)
+
+
+PAYLOADS = [b"", b"x", b"abcdef", bytes(range(256)) * 3 + b"tail",
+            os.urandom(1031)]
+
+
+@pytest.mark.parametrize("spec", ["xor", "xor(3)", "rs(4,2)",
+                                  "rs(5,3)"])
+def test_any_m_erasures_recoverable(spec):
+    """The MDS property: EVERY k-subset of the n shards reconstructs
+    the payload exactly (so any m erasures are survivable)."""
+    code = parse_code(spec)
+    for blob in PAYLOADS:
+        shards = code.encode(blob)
+        assert len(shards) == code.n
+        for keep in itertools.combinations(range(code.n), code.k):
+            have = {i: shards[i] for i in keep}
+            assert code.decode(have, len(blob)) == blob, (spec, keep)
+
+
+@pytest.mark.parametrize("spec", ["xor", "rs(4,2)"])
+def test_m_plus_one_erasures_unrecoverable(spec):
+    code = parse_code(spec)
+    blob = bytes(range(200))
+    shards = code.encode(blob)
+    have = {i: shards[i] for i in range(code.k - 1)}    # k-1 survive
+    with pytest.raises(ShardShortfall) as e:
+        code.decode(have, len(blob))
+    assert e.value.found == code.k - 1
+    assert e.value.needed == code.k
+
+
+def test_pure_python_fallback_matches_numpy():
+    """The numpy-vectorized GF path and the table-driven pure-Python
+    path produce IDENTICAL shards and decodes."""
+    code = parse_code("rs(4,2)")
+    blob = os.urandom(513)
+    fast = code.encode(blob)
+    coding._FORCE_PURE = True
+    try:
+        slow = code.encode(blob)
+        assert fast == slow
+        have = {i: slow[i] for i in (1, 2, 4, 5)}       # 2 data lost
+        assert code.decode(have, len(blob)) == blob
+    finally:
+        coding._FORCE_PURE = False
+
+
+def test_shard_frame_crc_detects_corruption():
+    from dpark_tpu.coding import ShardCorrupt, pack_shard, unpack_shard
+    code = parse_code("rs(4,2)")
+    frame = pack_shard(code, 3, 100, b"payload-bytes")
+    fr = unpack_shard(frame)
+    assert (fr.idx, fr.orig_len, fr.payload) == (3, 100,
+                                                 b"payload-bytes")
+    bad = bytearray(frame)
+    bad[-4] ^= 0xFF                         # flip a payload byte
+    with pytest.raises(ShardCorrupt):
+        unpack_shard(bytes(bad))
+
+
+def test_container_decodes_around_corruption():
+    """A shard container with one corrupted region loses exactly the
+    shards the corruption touched and decodes from the rest — counted
+    as a repair; past m corrupted shards only ShardShortfall is
+    left."""
+    code = parse_code("rs(4,2)")
+    blob = os.urandom(4096)
+    raw = coding.encode_container(blob, code)
+    assert coding.is_container(raw)
+    assert coding.decode_container(raw) == blob
+    # corrupt one shard's payload (inside the body, past both headers)
+    bad = bytearray(raw)
+    bad[len(bad) // 2] ^= 0xFF
+    coding.reset_counters()
+    assert coding.decode_container(bytes(bad)) == blob
+    assert coding.counters_snapshot()["totals"]["repair"] == 1
+    # corrupt every shard region: information-theoretically gone
+    faults.configure("shuffle.spill_read:p=1,kind=corrupt")
+    with pytest.raises(ShardShortfall):
+        coding.decode_container(raw, fault_site="shuffle.spill_read")
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: host path
+# ---------------------------------------------------------------------------
+
+def _reduce_job(ctx):
+    return sorted(ctx.parallelize([(i % 7, i) for i in range(210)], 4)
+                  .reduceByKey(operator.add, 3).collect())
+
+
+def _group_job(ctx):
+    return sorted(
+        ctx.parallelize([(i % 150, i % 5) for i in range(600)], 4)
+        .groupByKey(3).mapValue(lambda vs: tuple(sorted(vs)))
+        .collect())
+
+
+def _assert_zero_recompute(rec):
+    assert rec["state"] == "done"
+    assert rec.get("resubmits", 0) == 0, rec
+    assert rec.get("recomputes", 0) == 0, rec
+
+
+@pytest.mark.parametrize("mode", ["xor", "rs(4,2)"])
+def test_host_fetch_fault_decodes_not_recomputes(ctx, mode):
+    """The ISSUE 6 chaos proof, host path: the same seeded fetch
+    injection that costs the uncoded path a parent-stage resubmit
+    round completes with ZERO resubmits/recomputes — the failed shard
+    is decoded from parity (repair counter > 0)."""
+    clean = _reduce_job(ctx)
+    coding.configure(mode)
+    coding.reset_counters()
+    faults.configure("shuffle.fetch:p=0.2,seed=7")
+    assert _reduce_job(ctx) == clean
+    rec = ctx.scheduler.history[-1]
+    _assert_zero_recompute(rec)
+    assert rec["decodes"]["repair"] > 0, rec["decodes"]
+    assert rec["decodes"]["mode"] == coding.describe()
+    assert faults.stats()["shuffle.fetch"]["fired"] > 0
+    # per-stage attribution: the decoded shuffle's PARENT stage row
+    assert any((st.get("decodes") or {}).get("repair", 0) > 0
+               for st in rec["stage_info"]), rec["stage_info"]
+
+
+@pytest.mark.parametrize("mode", ["xor", "rs(4,2)"])
+def test_host_spill_corruption_decodes_not_recomputes(ctx, mode):
+    """A corrupted host spill chunk (DiskSpillMerger) loses one shard
+    INSIDE the coded container and is decoded around — where the
+    uncoded path pays an intact-parent task recompute."""
+    old = conf.SHUFFLE_CHUNK_RECORDS
+    conf.SHUFFLE_CHUNK_RECORDS = 8          # max_items 32: force spills
+    try:
+        clean = _group_job(ctx)
+        coding.configure(mode)
+        coding.reset_counters()
+        faults.configure("shuffle.spill_write:nth=1,kind=corrupt")
+        assert _group_job(ctx) == clean
+        rec = ctx.scheduler.history[-1]
+        _assert_zero_recompute(rec)
+        assert faults.stats()["shuffle.spill_write"]["fired"] == 1
+        assert rec["decodes"]["repair"] > 0, rec["decodes"]
+    finally:
+        conf.SHUFFLE_CHUNK_RECORDS = old
+
+
+@pytest.mark.parametrize("mode", ["xor", "rs(4,2)"])
+def test_host_straggler_delay_fastest_k_wins(ctx, mode):
+    """kind=delay slows a random subset of shard fetches; the decode
+    proceeds from the fastest k (straggler_win counter) with zero
+    recovery events — the case speculation only partially covers."""
+    clean = _reduce_job(ctx)
+    coding.configure(mode)
+    coding.reset_counters()
+    faults.configure("shuffle.fetch:p=0.3,seed=3,kind=delay,ms=150")
+    assert _reduce_job(ctx) == clean
+    rec = ctx.scheduler.history[-1]
+    _assert_zero_recompute(rec)
+    d = rec["decodes"]
+    assert d["straggler_win"] > 0, d
+    assert d["decode_failures"] == 0, d
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: device hbm:// path
+# ---------------------------------------------------------------------------
+
+def _device_group_job(tctx2):
+    """Map side on the device (hbm:// shuffle store), consume through
+    the host fetch path — every bucket crosses the export bridge as
+    framed erasure shards.  Needs the `tiny_waves` fixture: at stock
+    wave budgets this groupByKey declines the array path entirely and
+    the test would silently duplicate the host matrix."""
+    from dpark_tpu import Columns
+    keys = np.arange(15000, dtype=np.int64) % 97
+    vals = np.arange(15000, dtype=np.int64) % 13
+    return {k: sorted(v) for k, v in
+            tctx2.parallelize(Columns(keys, vals), 2)
+            .groupByKey(8).collect()}
+
+
+def _assert_device_parent(rec):
+    """The map stage must actually have ridden the array path (hbm://
+    outputs) — otherwise the 'device' chaos cell proves nothing the
+    host cell didn't."""
+    kinds = [st.get("kind") or "" for st in rec["stage_info"]]
+    assert any(k.startswith("array") for k in kinds), kinds
+
+
+def _join_premergers(ex):
+    """Wait out background premerge walkers from PREVIOUS runs so a
+    freshly configured chaos plane cannot be consumed by a stale
+    store's merged-run writes."""
+    for s in list(ex.shuffle_store.values()):
+        pm = s.get("premerge")
+        if pm is not None and pm._thread is not None:
+            pm._thread.join(timeout=10)
+
+
+@pytest.mark.parametrize("mode", ["xor", "rs(4,2)"])
+def test_device_fetch_fault_decodes_not_recomputes(tctx2, tiny_waves,
+                                                   mode):
+    """The ISSUE 6 chaos proof, device path: under PR 5's rules a
+    failed hbm:// fetch invalidated ALL of the device parent's outputs
+    (one fault = a full stage resubmit).  With coding on, the lost
+    shard decodes from parity and the parent never re-runs."""
+    clean = _device_group_job(tctx2)
+    _join_premergers(tctx2.scheduler.executor)
+    coding.configure(mode)
+    coding.reset_counters()
+    faults.configure("shuffle.fetch:p=0.2,seed=7")
+    assert _device_group_job(tctx2) == clean
+    rec = tctx2.scheduler.history[-1]
+    _assert_device_parent(rec)
+    _assert_zero_recompute(rec)
+    assert rec["decodes"]["repair"] > 0, rec["decodes"]
+    assert faults.stats()["shuffle.fetch"]["fired"] > 0
+
+
+@pytest.mark.parametrize("mode", ["xor", "rs(4,2)"])
+def test_device_spill_corruption_decodes_not_recomputes(
+        tctx2, tiny_waves, mode):
+    """A corrupted device spill RUN (the streamed no-combine path)
+    previously invalidated the whole parent device stage; the coded
+    container decodes around the corrupted shard instead."""
+    clean = _device_group_job(tctx2)
+    _join_premergers(tctx2.scheduler.executor)
+    coding.configure(mode)
+    coding.reset_counters()
+    faults.configure("shuffle.spill_write:nth=3,kind=corrupt")
+    assert _device_group_job(tctx2) == clean
+    rec = tctx2.scheduler.history[-1]
+    _assert_device_parent(rec)
+    _assert_zero_recompute(rec)
+    assert faults.stats()["shuffle.spill_write"]["fired"] == 1
+    # spill-run decodes aren't shuffle-attributed; totals carry them
+    assert coding.counters_snapshot()["totals"]["repair"] > 0
+
+
+@pytest.mark.parametrize("mode", ["xor", "rs(4,2)"])
+def test_device_straggler_delay_fastest_k_wins(tctx2, tiny_waves,
+                                               mode):
+    clean = _device_group_job(tctx2)
+    _join_premergers(tctx2.scheduler.executor)
+    coding.configure(mode)
+    coding.reset_counters()
+    faults.configure("shuffle.fetch:p=0.3,seed=3,kind=delay,ms=150")
+    assert _device_group_job(tctx2) == clean
+    rec = tctx2.scheduler.history[-1]
+    _assert_device_parent(rec)
+    _assert_zero_recompute(rec)
+    assert rec["decodes"]["straggler_win"] > 0, rec["decodes"]
+
+
+# ---------------------------------------------------------------------------
+# executor spill runs: coded container round trip
+# ---------------------------------------------------------------------------
+
+def test_executor_run_container_round_trip(tmp_path):
+    from dpark_tpu.backend.tpu.executor import JAXExecutor
+    coding.configure("rs(4,2)")
+    p = str(tmp_path / "run")
+    cols = [np.arange(100, dtype=np.int64), np.ones(100)]
+    JAXExecutor._write_run(p, cols)
+    with open(p, "rb") as f:
+        assert coding.is_container(f.read())
+    # a corrupted write decodes around the lost shard at read
+    faults.configure("shuffle.spill_write:nth=1,kind=corrupt")
+    JAXExecutor._write_run(p, cols)
+    back = JAXExecutor._read_run(p)
+    assert np.array_equal(back[0], cols[0])
+    assert coding.counters_snapshot()["totals"]["repair"] >= 1
+    # every shard corrupted: SpillCorruption (lineage), not garbage
+    faults.configure("shuffle.spill_write:p=1,kind=corrupt")
+    JAXExecutor._write_run(p, cols)
+    faults.configure(None)
+    with pytest.raises(SpillCorruption, match="shards survived"):
+        JAXExecutor._read_run(p)
+
+
+# ---------------------------------------------------------------------------
+# satellites: dedup, FetchFailed fields, decode_failures accounting
+# ---------------------------------------------------------------------------
+
+def test_read_bucket_any_dedups_replica_uris(ctx):
+    """A duplicated replica uri costs ONE attempt, not two — the chaos
+    site's hit counter is the per-attempt ground truth."""
+    faults.configure("shuffle.fetch:nth=999")       # count, never fire
+    missing = "file:///no-such-dpark-workdir"
+    with pytest.raises(FetchFailed):
+        read_bucket_any([missing, missing, missing], 1234, 0, 0)
+    assert faults.stats()["shuffle.fetch"]["hits"] == 1
+
+
+def test_failed_decode_carries_shard_counts(ctx):
+    """Fewer than k surviving shards: FetchFailed names how close the
+    decode came (shards_found/shards_needed) and recovery_summary()
+    counts it under decodes.decode_failures, distinct from the plain
+    fetch_failed counter."""
+    ctx.start()                     # scheduler owns recovery_summary
+    coding.configure("rs(4,2)")
+    uri = LocalFileShuffle.write_buckets(777, 0, [[(1, 2)]])
+    path = LocalFileShuffle.get_output_file(777, 0, 0) + ".shards"
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    for fr in coding.parse_container(bytes(raw)):
+        if fr.idx in (0, 2, 4):             # 3 of 6 lost: k=4 short
+            raw[fr.end - 1] ^= 0xFF         # flip a payload byte
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(FetchFailed) as e:
+        read_bucket_any(uri, 777, 0, 0)
+    assert e.value.shards_found == 3
+    assert e.value.shards_needed == 4
+    assert "decode failed: 3 of 4 shards" in str(e.value)
+    summary = ctx.scheduler.recovery_summary()
+    assert summary["decodes"]["decode_failures"] == 1
+    assert summary["decodes"]["mode"] == "rs(4,2)"
+
+
+def test_uncoded_bucket_read_back_after_enabling_code(ctx):
+    """A bucket written BEFORE the code was configured still reads:
+    the k-of-n probe reports a clean miss everywhere and the fetch
+    falls back to the plain bucket protocol."""
+    uri = LocalFileShuffle.write_buckets(778, 0, [[(5, 9)]])
+    coding.configure("rs(4,2)")
+    assert read_bucket_any(uri, 778, 0, 0) == [(5, 9)]
+    assert coding.counters_snapshot()["totals"]["decode_failures"] == 0
+
+
+def test_coded_bucket_fetch_over_tcp(ctx):
+    """The bucket_shard dcn protocol: framed shards served over
+    tcp://, with the empty-payload miss sentinel for uncoded
+    buckets."""
+    from dpark_tpu.dcn import BucketServer
+    from dpark_tpu.env import env
+    from dpark_tpu.shuffle import read_bucket_shard
+    coding.configure("rs(4,2)")
+    LocalFileShuffle.write_buckets(779, 0, [[(3, 4)]])
+    LocalFileShuffle.write_buckets(781, 0, [[(6, 7)]])
+    srv = BucketServer(env.workdir, host="127.0.0.1").start()
+    try:
+        uri = "tcp://%s:%d" % srv.bind_address
+        assert read_bucket_any(uri, 779, 0, 0) == [(3, 4)]
+        # a shard request for an uncoded bucket = miss sentinel
+        coding.configure(None)
+        LocalFileShuffle.write_buckets(780, 0, [[(9, 1)]])
+        with pytest.raises(FileNotFoundError):
+            read_bucket_shard(uri, 780, 0, 0, 0)
+        # ... and the coded fetch of it falls back to the plain path
+        coding.configure("rs(4,2)")
+        assert read_bucket_any(uri, 780, 0, 0) == [(9, 1)]
+        # dedup satellite, coded flavor: duplicated tcp replicas of a
+        # CODED bucket decode normally
+        assert read_bucket_any([uri, uri], 781, 0, 0) == [(6, 7)]
+    finally:
+        srv.stop()
+
+
+def test_reader_config_drift_decodes_with_writer_geometry(ctx):
+    """The shard frames are SELF-DESCRIBING: a reader whose configured
+    code drifted from the writer's (cross-host config skew, mid-run
+    reconfigure) must decode with the WRITER's geometry, in both
+    directions — never solve the wrong matrix against the payload
+    bytes."""
+    from dpark_tpu.dcn import BucketServer
+    from dpark_tpu.env import env
+    coding.configure("xor")                     # writer: n=5
+    LocalFileShuffle.write_buckets(782, 0, [[(1, 2), (3, 4)]])
+    coding.configure("rs(4,2)")                 # writer: n=6
+    LocalFileShuffle.write_buckets(783, 0, [[(5, 6)]])
+    srv = BucketServer(env.workdir, host="127.0.0.1").start()
+    try:
+        uri = "tcp://%s:%d" % srv.bind_address
+        # reader rs(4,2) fans out 6 indices at an xor(4) bucket
+        assert read_bucket_any(uri, 782, 0, 0) == [(1, 2), (3, 4)]
+        # reader xor(4) fans out only 5 indices at an rs(4,2) bucket
+        coding.configure("xor")
+        assert read_bucket_any(uri, 783, 0, 0) == [(5, 6)]
+    finally:
+        srv.stop()
+
+
+def test_job_record_decodes_baseline_is_per_job(ctx):
+    """Decode counters are process-global; each job record reports
+    only ITS OWN delta (and no decodes key at all with coding off)."""
+    coding.configure("rs(4,2)")
+    _reduce_job(ctx)
+    first = ctx.scheduler.history[-1]["decodes"]
+    _reduce_job(ctx)
+    second = ctx.scheduler.history[-1]["decodes"]
+    assert second["decode_failures"] == 0
+    assert first["mode"] == second["mode"] == "rs(4,2)"
+    coding.configure(None)
+    _reduce_job(ctx)
+    assert "decodes" not in ctx.scheduler.history[-1]
+
+
+# ---------------------------------------------------------------------------
+# plan lint: unbounded-recovery quiets under coding
+# ---------------------------------------------------------------------------
+
+def test_unbounded_recovery_quiet_when_coded(ctx):
+    from dpark_tpu.analysis import lint_plan
+    old = conf.LINT_WIDE_DEPTH
+    conf.LINT_WIDE_DEPTH = 1
+    try:
+        r = ctx.parallelize([(i % 5, 1) for i in range(50)], 2) \
+               .reduceByKey(operator.add, 2) \
+               .map(lambda kv: (kv[1], kv[0])) \
+               .reduceByKey(operator.add, 2)
+        faults.configure("shuffle.fetch:p=0.1,seed=1")
+        assert "unbounded-recovery" in {f.rule for f in lint_plan(r)}
+        # coding with parity active: failed fetches decode, the chain
+        # no longer needs a checkpoint pin under injection
+        coding.configure("rs(4,2)")
+        rules = {f.rule for f in lint_plan(r)}
+        assert "unbounded-recovery" not in rules
+        # plain wide-depth advice is unchanged by coding
+        assert "plan-wide-depth" in rules
+    finally:
+        conf.LINT_WIDE_DEPTH = old
