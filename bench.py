@@ -207,6 +207,11 @@ def _ooc_phase():
     # decode_failures + the active mode, schema-gated like faults
     payload["decodes"] = recovery.pop("decodes", {})
     payload["degrades"] = recovery
+    # adaptive-execution accounting (ISSUE 7): mode, store hit/steer
+    # counters, and the decisions taken (predicted-vs-observed ms) —
+    # schema-gated like faults/decodes
+    from dpark_tpu import adapt
+    payload["adapt"] = adapt.summary()
     ctx.stop()
     print("OOC_RESULT %s" % json.dumps(payload), flush=True)
 
@@ -608,6 +613,80 @@ def _coded_phase():
         flush=True)
 
 
+def _adapt_phase():
+    """Child-process entry: adaptive-execution warm-vs-cold A/B
+    (ISSUE 7 acceptance) — the streamed sortgroup config run twice
+    with DPARK_ADAPT=on against a deterministic emulated HBM ceiling
+    (conf.EMULATED_WAVE_OOM_ROWS).  The COLD run's auto wave budget
+    exceeds the ceiling, so it walks the real OOM degradation ladder
+    (fail, halve, retry) and persists the outcome; the WARM run seeds
+    its budget from the store and streams first try.  The JSON reports
+    wall seconds, OOM-ladder retries, and store hits per run — warm
+    must show fewer ladder retries (and typically less wall).  A
+    pre-warmed DPARK_ADAPT_DIR (the CI two-pass smoke) makes even the
+    "cold" run seed from the store: cold ladder_retries == 0 with
+    store_hits >= 1 is the cross-process persistence proof."""
+    import tempfile
+
+    import numpy as np
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import Columns, DparkContext, adapt, conf
+    store = os.environ.get("DPARK_ADAPT_DIR") \
+        or tempfile.mkdtemp(prefix="dpark-adapt-ab-")
+    adapt.configure(mode="on", store_dir=store)
+    # the A/B grades the ladder+store loop, not real HBM sizing: pin
+    # the auto derivation to a known base (no device memory limit) so
+    # base > ceiling > base/2 holds on every backend, and the ladder's
+    # single halving lands under the ceiling deterministically
+    base = int(os.environ.get("BENCH_ADAPT_BASE_ROWS", 1 << 18))
+    conf._hbm_bytes_limit = lambda: 0
+    conf._STREAM_CHUNK_ROWS_FALLBACK = base
+    conf.EMULATED_WAVE_OOM_ROWS = int(os.environ.get(
+        "BENCH_ADAPT_CEIL_ROWS", base * 3 // 4))
+    conf.STREAM_CHUNK_ROWS = "auto"
+    ctx = DparkContext("tpu")
+    ctx.start()
+    ndev = ctx.scheduler.executor.ndev
+    # each device's slice must exceed the base wave budget or the
+    # in-core path runs and nothing streams (no ladder to grade)
+    n = int(os.environ.get("BENCH_ADAPT_PAIRS",
+                           str(base * 3 // 2 * ndev)))
+    i = np.arange(n, dtype=np.int64)
+    data = Columns((i * 2654435761) % 100_000, i & 0xFFFF)
+
+    def run():
+        hits0 = adapt.summary()["store_hits"]
+        # count ladder walks from the per-stage job records, NOT from
+        # degrade_reasons() — that helper de-duplicates identical
+        # reason strings across the whole history, so a warm run
+        # re-walking the ladder with the same budgets would be
+        # invisible and the A/B could false-pass
+        jobs0 = len(ctx.scheduler.history)
+        t0 = time.perf_counter()
+        r = ctx.parallelize(data, ndev)
+        ns = r.sortByKey(numSplits=ndev).count()
+        ng = r.groupByKey(ndev).count()
+        wall = time.perf_counter() - t0
+        assert ns == n and ng == min(100_000, n), (ns, ng)
+        s = adapt.summary()
+        ladder = sum(
+            1 for rec in ctx.scheduler.history[jobs0:]
+            for st in rec.get("stage_info", ())
+            if "wave budget" in (st.get("degrade_reason") or ""))
+        return {"wall_s": round(wall, 3),
+                "ladder_retries": ladder,
+                "store_hits": s["store_hits"] - hits0}
+
+    cold = run()
+    warm = run()
+    out = {"cold": cold, "warm": warm, "pairs": n, "ndev": ndev,
+           "adapt": adapt.summary()}
+    ctx.stop()
+    print("ADAPT_RESULT %s" % json.dumps(out), flush=True)
+
+
 def _probe_phase():
     """Child-process entry: just initialize the device backend.  Fast on
     a healthy platform; hangs forever on a wedged axon tunnel — which is
@@ -728,6 +807,9 @@ def main():
         return
     if "--coded-only" in sys.argv:
         _coded_phase()
+        return
+    if "--adapt-only" in sys.argv:
+        _adapt_phase()
         return
     if "--probe" in sys.argv:
         _probe_phase()
@@ -906,6 +988,27 @@ def main():
                     "pairs": c["pairs"],
                     "coding": c["decodes"]}
             print(json.dumps(cout))
+    # adaptive-execution warm-vs-cold A/B (ISSUE 7 acceptance): the
+    # streamed sortgroup/groupmap config run twice with DPARK_ADAPT=on
+    # against a deterministic emulated HBM ceiling — the warm run must
+    # seed its wave budget from the store (fewer OOM-ladder retries,
+    # typically less wall) instead of re-walking the halving ladder
+    if os.environ.get("BENCH_ADAPT", "1") != "0":
+        got = _run_child("--adapt-only", child_timeout,
+                         env=extra_env, ok_prefix="ADAPT_RESULT ")
+        if got is not None:
+            a = json.loads(got)
+            aout = {"metric": _suffix("adapt_warm_vs_cold"),
+                    "value": round(a["warm"]["wall_s"]
+                                   / max(a["cold"]["wall_s"], 1e-9), 3),
+                    "unit": ("x wall (lower is better; warm must also "
+                             "drop ladder retries)"),
+                    "cold": a["cold"], "warm": a["warm"],
+                    "pairs": a["pairs"], "chips": a["ndev"],
+                    "adapt": a["adapt"]}
+            if emulated:
+                aout["emulated_cpu_mesh"] = True
+            print(json.dumps(aout))
     if not extras:
         return
     # third line: join/cogroup, BASELINE config #2

@@ -202,6 +202,11 @@ def main():
     # coded-shuffle decode counters (ISSUE 6), same shape as bench.py
     out["decodes"] = recovery.pop("decodes", {})
     out["degrades"] = recovery
+    # adaptive-execution accounting (ISSUE 7): mode, store hit/steer
+    # counters, and the decisions taken — same shape as the bench.py
+    # OOC line, schema-gated by tools/bench_smoke_check.py
+    from dpark_tpu import adapt
+    out["adapt"] = adapt.summary()
     ctx.stop()
     print(json.dumps(out), flush=True)
 

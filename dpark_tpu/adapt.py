@@ -1,0 +1,689 @@
+"""Adaptive execution (ISSUE 7): a persistent feedback store + cost
+model that closes the loop from recorded stats to plan choices.
+
+PRs 1-6 built rich telemetry — per-stage phase tables, pipeline idle
+fractions, fallback/degrade reasons, decode counters — but every plan
+decision was static at trace time, so each job re-discovered the same
+budgets and re-paid the same mispredictions.  This module persists
+per-(program, shape class) observations ACROSS jobs and feeds four
+decision points ("Partial Partial Aggregates" is the theory anchor for
+pricing aggregation choices by observed cost):
+
+  1. wave budget     conf.stream_chunk_rows seeds from the last-known
+                     -good budget of the (row-width) class — recorded
+                     by the OOM degradation ladder — instead of
+                     re-deriving HBM/16 and re-walking the halving
+                     ladder every job.
+  2. device vs host  the tpu scheduler prices the array path against
+                     the object path from OBSERVED per-program ms and
+                     declines the device when the host is recorded
+                     cheaper (`adapt_reason` per stage, the cost-model
+                     sibling of fallback_reason/degrade_reason).
+  3. partition count a dominant key group (from the bucket histograms
+                     SegMapOp already computes) widens the reduce side
+                     of the next run of that program.
+  4. map-side combine the groupByKey aggregate rewrite is priced from
+                     the observed combine ratio (distinct keys /
+                     rows): a ratio near 1 means pre-aggregation buys
+                     nothing, so the rewrite is declined and the
+                     device SegAggOp serves the chain — the PR-1
+                     linter's `group-agg` advisory as an actual
+                     optimizer choice.
+
+Modes (conf.DPARK_ADAPT):
+  off      no reads, no writes, zero hot-path cost beyond a flag check
+  observe  record observations (and log would-be choices, applied:
+           false) but NEVER steer — bit-identical to off; the CI-safe
+           default
+  on       record AND steer
+
+Store: JSON-lines under conf.DPARK_ADAPT_DIR (one ``stats.jsonl``).
+Each line is framed ``<crc32 hex> <json>`` with the same checksum the
+spill runs use (shuffle.spill_crc), appended with a single O_APPEND
+write so concurrent processes interleave whole lines; corrupt or
+truncated lines are skipped at load (never an error).  Reset by
+deleting the directory (``rm -rf $DPARK_ADAPT_DIR``) or via
+``adapt.configure(...)`` / ``adapt.reset_store()``.
+
+Every public entry point is guarded: adaptation must never break a
+job, so failures log at debug and fall back to the static behavior.
+"""
+
+import json
+import os
+import threading
+
+from dpark_tpu import conf
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("adapt")
+
+MODES = ("off", "observe", "on")
+
+STORE_FILE = "stats.jsonl"
+
+# decisions kept in the process-global log (older entries age out; the
+# absolute position survives trimming so per-job deltas stay correct)
+_LOG_CAP = 512
+# exponential-moving-average weight for ms / ratio observations
+_EMA = 0.5
+
+_lock = threading.RLock()
+_mode = None                  # resolved mode, or None = read conf lazily
+_dir = None                   # resolved store dir, or None = read conf
+_loaded = False
+_agg = {"wave_budget": {}, "stage": {}, "skew": {}, "combine": {}}
+_counters = {"store_hits": 0, "store_misses": 0, "steered": 0,
+             "recorded": 0, "skipped_lines": 0}
+_decisions = []
+_decisions_base = 0           # absolute position of _decisions[0]
+_logged = set()               # (point, key, choice) de-dup for the log
+_pending = {}                 # stage key -> decision awaiting observed ms
+
+
+# ---------------------------------------------------------------------------
+# configuration / lifecycle
+# ---------------------------------------------------------------------------
+
+def mode():
+    """The resolved mode (validates conf.DPARK_ADAPT on first read)."""
+    global _mode
+    if _mode is None:
+        m = str(getattr(conf, "DPARK_ADAPT", "observe")).lower()
+        if m not in MODES:
+            raise ValueError(
+                "DPARK_ADAPT=%r (expected off|observe|on)" % m)
+        _mode = m
+    return _mode
+
+
+def enabled():
+    """True when observations should be recorded (observe or on)."""
+    return mode() != "off"
+
+
+def steering():
+    """True only when recorded stats may CHANGE plan choices."""
+    return mode() == "on"
+
+
+def store_dir():
+    global _dir
+    if _dir is None:
+        _dir = getattr(conf, "DPARK_ADAPT_DIR", None) or os.path.join(
+            conf.DPARK_WORK_DIR, "adapt")
+    return _dir
+
+
+def configure(mode=None, store_dir=None):
+    """Re-point the adaptive plane (tests/benchmarks): resets ALL
+    in-memory state (aggregates, counters, decision log) and resolves
+    mode/dir from the arguments, falling back to conf for whichever is
+    None.  The on-disk store is untouched — use reset_store() to wipe
+    it."""
+    global _mode, _dir, _loaded, _decisions_base
+    with _lock:
+        _mode = None
+        _dir = None
+        _loaded = False
+        for d in _agg.values():
+            d.clear()
+        for k in _counters:
+            _counters[k] = 0
+        _decisions.clear()
+        _decisions_base = 0
+        _logged.clear()
+        _pending.clear()
+        if mode is not None:
+            if str(mode).lower() not in MODES:
+                raise ValueError(
+                    "adapt mode %r (expected off|observe|on)" % mode)
+            _mode = str(mode).lower()
+        if store_dir is not None:
+            _dir = str(store_dir)
+
+
+def reset_store():
+    """Delete the on-disk store (the documented reset) and the
+    in-memory aggregates, keeping the configured mode/dir."""
+    with _lock:
+        path = _store_path()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        global _loaded
+        _loaded = False
+        for d in _agg.values():
+            d.clear()
+
+
+# ---------------------------------------------------------------------------
+# the store: crc-framed JSON lines, process-safe append
+# ---------------------------------------------------------------------------
+
+def _crc(blob):
+    from dpark_tpu.shuffle import spill_crc
+    return spill_crc(blob)
+
+
+def _store_path():
+    return os.path.join(store_dir(), STORE_FILE)
+
+
+def _ensure_loaded():
+    """Load the store file into the in-memory aggregates once per
+    process (records apply in file order = chronological order)."""
+    global _loaded
+    if _loaded:
+        return
+    with _lock:
+        if _loaded:
+            return
+        _loaded = True               # even when the file is absent
+        path = _store_path()
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            head, _, payload = line.partition(b" ")
+            try:
+                if int(head, 16) != _crc(payload):
+                    raise ValueError("crc mismatch")
+                rec = json.loads(payload.decode("utf-8"))
+                _apply(rec)
+            except Exception:
+                # corrupt / truncated / foreign line: skip, never fail
+                _counters["skipped_lines"] += 1
+        cap = int(getattr(conf, "ADAPT_STORE_MAX_BYTES", 0) or 0)
+        if cap and len(raw) > cap:
+            _compact_locked(path)
+
+
+def _compact_locked(path):
+    """Rewrite the store as its folded aggregates — one line per key —
+    so the append-only file stays bounded (conf.ADAPT_STORE_MAX_BYTES).
+    Best-effort tmp+rename: lines another process appends during the
+    rewrite are lost, which is acceptable for advisory statistics (the
+    EMA sample counts also reset to the compacted snapshot)."""
+    recs = []
+    for key, ent in _agg["wave_budget"].items():
+        for slot, ok in (("good", True), ("bad", False)):
+            if ent.get(slot):
+                recs.append({"k": "wb", "key": key,
+                             "budget": int(ent[slot]), "ok": ok,
+                             "src": "compact"})
+    for key, ent in _agg["stage"].items():
+        for p in ("device", "host"):
+            if ent.get(p + "_ms") is not None:
+                recs.append({"k": "stage", "key": key, "path": p,
+                             "ms": round(ent[p + "_ms"], 2)})
+        for _ in range(min(int(ent.get("device_errors", 0)), 3)):
+            recs.append({"k": "stage", "key": key, "path": "device",
+                         "error": True})
+    for key, ent in _agg["skew"].items():
+        recs.append(dict(ent, k="skew", key=key))
+    for key, ent in _agg["combine"].items():
+        if ent.get("ratio") is not None:
+            recs.append({"k": "combine", "key": key,
+                         "rows_in": 1000000,
+                         "rows_out": int(ent["ratio"] * 1000000)})
+    try:
+        lines = []
+        for rec in recs:
+            payload = json.dumps(rec, sort_keys=True,
+                                 separators=(",", ":")).encode("utf-8")
+            lines.append(b"%08x %s" % (_crc(payload), payload))
+        tmp = path + ".compact.%d" % os.getpid()
+        with open(tmp, "wb") as f:
+            f.write(b"\n".join(lines) + b"\n" if lines else b"")
+        os.replace(tmp, path)
+        logger.debug("adapt store compacted to %d records", len(recs))
+    except Exception as e:
+        logger.debug("adapt store compaction failed: %s", e)
+
+
+def _append(rec):
+    """Persist one observation: update the in-memory aggregates and
+    append one crc-framed line with a single O_APPEND write (whole
+    lines interleave safely across processes)."""
+    _ensure_loaded()
+    with _lock:
+        _apply(rec)
+        _counters["recorded"] += 1
+        try:
+            payload = json.dumps(rec, sort_keys=True,
+                                 separators=(",", ":")).encode("utf-8")
+            line = b"%08x %s\n" % (_crc(payload), payload)
+            os.makedirs(store_dir(), exist_ok=True)
+            fd = os.open(_store_path(),
+                         os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+        except Exception as e:
+            logger.debug("adapt store append failed: %s", e)
+
+
+def _apply(rec):
+    """Fold one record into the in-memory aggregates."""
+    kind = rec.get("k")
+    key = rec.get("key")
+    if not key:
+        return
+    if kind == "wb":
+        ent = _agg["wave_budget"].setdefault(
+            key, {"good": None, "bad": None})
+        budget = int(rec.get("budget", 0))
+        if budget > 0:
+            ent["good" if rec.get("ok") else "bad"] = budget
+    elif kind == "stage":
+        ent = _agg["stage"].setdefault(
+            key, {"device_ms": None, "host_ms": None,
+                  "device_n": 0, "host_n": 0, "device_errors": 0})
+        path = rec.get("path")
+        if rec.get("error"):
+            ent["device_errors"] += 1
+        elif path in ("device", "host"):
+            ms = float(rec.get("ms", 0.0))
+            cur = ent[path + "_ms"]
+            ent[path + "_ms"] = ms if cur is None \
+                else cur * (1 - _EMA) + ms * _EMA
+            ent[path + "_n"] += 1
+    elif kind == "skew":
+        _agg["skew"][key] = {
+            "rows": int(rec.get("rows", 0)),
+            "groups": int(rec.get("groups", 0)),
+            "max_group": int(rec.get("max_group", 0)),
+            "parts": int(rec.get("parts", 0))}
+    elif kind == "combine":
+        rows_in = max(1, int(rec.get("rows_in", 1)))
+        ratio = min(1.0, int(rec.get("rows_out", 0)) / rows_in)
+        ent = _agg["combine"].setdefault(key, {"ratio": None, "n": 0})
+        cur = ent["ratio"]
+        ent["ratio"] = ratio if cur is None \
+            else cur * (1 - _EMA) + ratio * _EMA
+        ent["n"] += 1
+
+
+# ---------------------------------------------------------------------------
+# decision log (rides job records as record["adapt"] and the bench JSON)
+# ---------------------------------------------------------------------------
+
+def _decide(point, key, choice, reason, predicted_ms=None,
+            applied=True):
+    """Log one (de-duplicated) decision; returns the dict so callers
+    can later attach the observed outcome."""
+    with _lock:
+        dedup = (point, str(key), str(choice), bool(applied))
+        if dedup in _logged:
+            for d in reversed(_decisions):
+                if (d["point"], str(d["key"]), str(d["choice"]),
+                        d["applied"]) == dedup:
+                    return d
+            # aged out of the log: fall through and re-log
+        _logged.add(dedup)
+        d = {"point": point, "key": str(key), "choice": choice,
+             "reason": reason, "applied": bool(applied)}
+        if predicted_ms is not None:
+            d["predicted_ms"] = round(float(predicted_ms), 2)
+        _decisions.append(d)
+        if applied:
+            _counters["steered"] += 1
+        global _decisions_base
+        if len(_decisions) > _LOG_CAP:
+            drop = len(_decisions) - _LOG_CAP
+            del _decisions[:drop]
+            _decisions_base += drop
+        return d
+
+
+def log_position():
+    with _lock:
+        return _decisions_base + len(_decisions)
+
+
+def begin_job():
+    """Mark a job boundary: returns the current log position AND
+    resets the decision de-dup epoch, so a job that takes the same
+    steered choice as its predecessor still logs it (its
+    record["adapt"] delta and the `steered` counter would otherwise
+    silently undercount repeat steering).  Within one job the de-dup
+    stands — a streamed stage consulting the store once per wave logs
+    one decision, not hundreds."""
+    with _lock:
+        _logged.clear()
+        return _decisions_base + len(_decisions)
+
+
+def decisions_since(pos):
+    with _lock:
+        start = max(0, int(pos) - _decisions_base)
+        return [dict(d) for d in _decisions[start:]]
+
+
+def summary():
+    """The `adapt` section for bench artifacts / job records: mode,
+    store location, hit/steer counters, recent decisions with
+    predicted-vs-observed ms."""
+    with _lock:
+        return {"mode": mode(), "store": _store_path(),
+                "store_hits": _counters["store_hits"],
+                "store_misses": _counters["store_misses"],
+                "steered": _counters["steered"],
+                "recorded": _counters["recorded"],
+                "decisions": [dict(d) for d in _decisions[-32:]]}
+
+
+# ---------------------------------------------------------------------------
+# stable cross-process identity for plan program keys
+# ---------------------------------------------------------------------------
+
+def stable_key(obj):
+    """Hash an arbitrary program-key structure to a short id that is
+    STABLE ACROSS PROCESSES: code objects hash by bytecode + consts
+    (fuse.fn_key carries live code objects whose repr embeds a memory
+    address), functions by their code, bytes by digest; the generic
+    fallback strips ``at 0x...`` addresses from reprs."""
+    import hashlib
+    return hashlib.sha1(
+        _stable_repr(obj).encode("utf-8", "replace")).hexdigest()[:16]
+
+
+def _stable_repr(o, depth=0):
+    import hashlib
+    import re
+    import types
+    if depth > 12:
+        return "..."
+    if isinstance(o, types.CodeType):
+        return "code(%s,%s,%s)" % (
+            o.co_name, hashlib.sha1(o.co_code).hexdigest()[:12],
+            _stable_repr(o.co_consts, depth + 1))
+    if isinstance(o, types.FunctionType):
+        return "fn(%s)" % _stable_repr(o.__code__, depth + 1)
+    if isinstance(o, (bytes, bytearray)):
+        return "b(%s)" % hashlib.sha1(bytes(o)).hexdigest()[:12]
+    if isinstance(o, (tuple, list)):
+        return "(%s)" % ",".join(_stable_repr(x, depth + 1) for x in o)
+    if isinstance(o, dict):
+        return "{%s}" % ",".join(
+            "%s:%s" % (_stable_repr(k, depth + 1),
+                       _stable_repr(v, depth + 1))
+            for k, v in sorted(o.items(), key=lambda kv: repr(kv[0])))
+    if isinstance(o, (str, int, float, bool)) or o is None:
+        return repr(o)
+    return re.sub(r" at 0x[0-9a-f]+", "", repr(o))
+
+
+# ---------------------------------------------------------------------------
+# decision point 1: wave budget (conf.stream_chunk_rows)
+# ---------------------------------------------------------------------------
+
+def _wb_key(row_bytes):
+    return "rb%d" % int(row_bytes)
+
+
+def record_wave_budget(row_bytes, budget, ok, source="stream"):
+    """Persist the outcome of running (or failing) a wave budget for a
+    row-width class.  Known-good budgets seed the next run; a failing
+    budget makes the next run start BELOW the rung that OOM'd.
+    Identical consecutive outcomes are not re-appended."""
+    try:
+        if not enabled() or not budget:
+            return
+        _ensure_loaded()
+        key = _wb_key(row_bytes)
+        with _lock:
+            ent = _agg["wave_budget"].get(key)
+            slot = "good" if ok else "bad"
+            if ent is not None and ent.get(slot) == int(budget):
+                return
+        _append({"k": "wb", "key": key, "budget": int(budget),
+                 "ok": bool(ok), "src": source})
+    except Exception as e:
+        logger.debug("record_wave_budget failed: %s", e)
+
+
+def steer_wave_budget(base, row_bytes):
+    """The effective auto wave budget: the store's last-known-good
+    budget for this row-width class when it is SMALLER than the
+    freshly derived base (a learned budget larger than base never
+    applies — base is already the memory-derived ceiling).  With only
+    a failing budget on record, start at half that rung.  Never
+    steers outside DPARK_ADAPT=on."""
+    try:
+        if not steering():
+            return base
+        _ensure_loaded()
+        key = _wb_key(row_bytes)
+        with _lock:
+            ent = _agg["wave_budget"].get(key)
+        if ent is None:
+            _counters["store_misses"] += 1
+            return base
+        _counters["store_hits"] += 1
+        good, bad = ent.get("good"), ent.get("bad")
+        cand = good if good else (max(64, bad // 2) if bad else None)
+        if cand is None or cand >= base:
+            return base
+        _decide("wave_budget", key, cand,
+                "seeded wave budget %d rows/device from the store "
+                "(last known good for %s; derived base %d)"
+                % (cand, key, base))
+        return int(cand)
+    except Exception as e:
+        logger.debug("steer_wave_budget failed: %s", e)
+        return base
+
+
+def wave_budget_row_widths():
+    """Row-width classes (ints, bytes/row) with stored budgets — the
+    adapt-stale-hint lint rule compares these against the plan's
+    actual columnar row width."""
+    try:
+        if not enabled():
+            return set()
+        _ensure_loaded()
+        with _lock:
+            return {int(k[2:]) for k in _agg["wave_budget"]
+                    if k.startswith("rb")}
+    except Exception:
+        return set()
+
+
+# ---------------------------------------------------------------------------
+# decision point 2: device vs object path by predicted cost
+# ---------------------------------------------------------------------------
+
+def _stage_key(sig):
+    return "%s|%s" % (sig[0], sig[1])
+
+
+def choose_path(sig):
+    """Cost-model path choice for an analyzable stage: given the plan
+    signature (program id, shape class) from fuse.plan_adapt_signature,
+    return a decision dict ({"choice": "object"|"device", "reason",
+    "predicted_ms"}) when BOTH paths have recorded ms for this program
+    class, else None (no history -> static behavior: the array path).
+    The host must beat the device by conf.ADAPT_PATH_MARGIN to win —
+    ties keep the device (its compile cost amortizes).  Observe mode
+    logs the would-be choice (applied: false) and returns None."""
+    try:
+        if sig is None or not enabled():
+            return None
+        _ensure_loaded()
+        key = _stage_key(sig)
+        with _lock:
+            ent = _agg["stage"].get(key)
+        if ent is None:
+            _counters["store_misses"] += 1
+            return None
+        d_ms, h_ms = ent.get("device_ms"), ent.get("host_ms")
+        if d_ms is None or h_ms is None:
+            _counters["store_misses"] += 1
+            return None
+        _counters["store_hits"] += 1
+        margin = float(getattr(conf, "ADAPT_PATH_MARGIN", 0.8))
+        if h_ms < d_ms * margin:
+            choice, predicted = "object", h_ms
+            reason = ("cost model: object path predicted cheaper "
+                      "(host ~%.1fms vs device ~%.1fms observed for "
+                      "this program class)" % (h_ms, d_ms))
+        else:
+            choice, predicted = "device", d_ms
+            reason = ("cost model: array path confirmed (device "
+                      "~%.1fms vs host ~%.1fms observed)"
+                      % (d_ms, h_ms))
+        if not steering():
+            _decide("path", key, choice, reason, predicted_ms=predicted,
+                    applied=False)
+            return None
+        d = _decide("path", key, choice, reason, predicted_ms=predicted)
+        with _lock:
+            _pending[key] = d
+        return dict(d)
+    except Exception as e:
+        logger.debug("choose_path failed: %s", e)
+        return None
+
+
+def observe_path(sig, path, ms=None, error=False):
+    """Record an observed stage run (path = "device" | "host", wall
+    ms) for the plan signature, and complete any pending path decision
+    with the observed outcome."""
+    try:
+        if sig is None or not enabled():
+            return
+        key = _stage_key(sig)
+        rec = {"k": "stage", "key": key, "path": path}
+        if error:
+            rec["error"] = True
+        else:
+            rec["ms"] = round(float(ms), 2)
+        _append(rec)
+        with _lock:
+            d = _pending.pop(key, None)
+            if d is not None and not error:
+                d["observed_ms"] = round(float(ms), 2)
+    except Exception as e:
+        logger.debug("observe_path failed: %s", e)
+
+
+def stage_history():
+    """Copy of the per-program stage aggregates (tests / debugging)."""
+    _ensure_loaded()
+    with _lock:
+        return {k: dict(v) for k, v in _agg["stage"].items()}
+
+
+# ---------------------------------------------------------------------------
+# decision point 3: partition count re-planned on observed skew
+# ---------------------------------------------------------------------------
+
+def record_skew(site, rows, groups, max_group, parts):
+    """Persist a bucket-histogram observation for a grouping site (the
+    segment layout SegMapOp computes anyway): total rows, group count,
+    the largest group's approximate size, and the reduce width it ran
+    at."""
+    try:
+        if not enabled() or not site or not rows:
+            return
+        _append({"k": "skew", "key": str(site), "rows": int(rows),
+                 "groups": int(groups), "max_group": int(max_group),
+                 "parts": int(parts)})
+    except Exception as e:
+        logger.debug("record_skew failed: %s", e)
+
+
+def suggest_partitions(site, default_n):
+    """Reduce-side width for a combineByKey/groupByKey whose caller
+    took the DEFAULT parallelism: when the last recorded histogram for
+    this call site shows one dominant key group (max_group/rows >=
+    conf.ADAPT_SKEW_FRAC), widen by conf.ADAPT_SKEW_WIDEN so the
+    non-dominant keys spread thinner around the hot partition.
+    Explicit user numSplits are never overridden (callers only consult
+    this on the default path)."""
+    try:
+        if not enabled() or not site:
+            return default_n
+        _ensure_loaded()
+        with _lock:
+            ent = _agg["skew"].get(str(site))
+        if ent is None or not ent.get("rows"):
+            return default_n
+        frac = ent["max_group"] / max(1, ent["rows"])
+        if frac < float(getattr(conf, "ADAPT_SKEW_FRAC", 0.5)):
+            return default_n
+        _counters["store_hits"] += 1
+        widened = max(default_n + 1, default_n * int(
+            getattr(conf, "ADAPT_SKEW_WIDEN", 2)))
+        reason = ("observed skew at %s: dominant group ~%d of %d rows "
+                  "(%.0f%%) — widening the reduce side %d -> %d"
+                  % (site, ent["max_group"], ent["rows"], frac * 100,
+                     default_n, widened))
+        if not steering():
+            _decide("partitions", site, widened, reason, applied=False)
+            return default_n
+        _decide("partitions", site, widened, reason)
+        return widened
+    except Exception as e:
+        logger.debug("suggest_partitions failed: %s", e)
+        return default_n
+
+
+# ---------------------------------------------------------------------------
+# decision point 4: map-side combine priced from the combine ratio
+# ---------------------------------------------------------------------------
+
+def record_combine_ratio(site, rows_in, rows_out):
+    """Persist an observed combine ratio (rows after map-side combine,
+    or distinct groups, over input rows) for a grouping/combining call
+    site."""
+    try:
+        if not enabled() or not site or not rows_in:
+            return
+        _append({"k": "combine", "key": str(site),
+                 "rows_in": int(rows_in), "rows_out": int(rows_out)})
+    except Exception as e:
+        logger.debug("record_combine_ratio failed: %s", e)
+
+
+def map_side_combine(site, kind):
+    """Should the groupByKey aggregate rewrite apply map-side combine
+    for this site?  True (the static default) without history; False
+    when the OBSERVED combine ratio says pre-aggregation barely
+    shrinks the exchange (ratio > conf.ADAPT_COMBINE_MAX_RATIO —
+    nearly every key is distinct, so the combine pass costs a sort and
+    saves no wire bytes).  Observe mode logs the would-be choice and
+    keeps the static default."""
+    try:
+        if not enabled() or not site:
+            return True
+        _ensure_loaded()
+        with _lock:
+            ent = _agg["combine"].get(str(site))
+        if ent is None or ent.get("ratio") is None:
+            return True
+        ratio = ent["ratio"]
+        limit = float(getattr(conf, "ADAPT_COMBINE_MAX_RATIO", 0.6))
+        if ratio <= limit:
+            return True
+        _counters["store_hits"] += 1
+        reason = ("observed combine ratio %.2f > %.2f at %s: map-side "
+                  "combine for %s priced off (exchange the raw rows; "
+                  "the device segment path serves the aggregate)"
+                  % (ratio, limit, site, kind))
+        if not steering():
+            _decide("map_combine", site, "off", reason, applied=False)
+            return True
+        _decide("map_combine", site, "off", reason)
+        return False
+    except Exception as e:
+        logger.debug("map_side_combine failed: %s", e)
+        return True

@@ -40,6 +40,12 @@ shuffle anti-patterns that dominate cost at production scale:
                          mixes leaves from different records; add/mul
                          over sequences are legitimate concat/repeat
                          and stay unflagged).
+  adapt-stale-hint       the adaptive store's learned wave budgets
+                         (dpark_tpu/adapt.py) are keyed by row-width
+                         class and NONE matches this plan's columnar
+                         source: schema drift left the store's hints
+                         stale, so the first run re-walks the OOM
+                         ladder instead of seeding.
 
 The walk reads graph structure only (dependencies / partitioner /
 cache flags) — it never touches RDD.splits (which can promote lazy
@@ -594,6 +600,59 @@ def _rule_host_fallback_group(r, report):
         "device-path support matrix")
 
 
+def _columnar_source_row_bytes(r):
+    """Bytes per record of a columnar parallelize source, jax-free
+    (the linter must not pay a jax import): same arithmetic as the tpu
+    backend's fuse._columnar_row_bytes, over numpy columns only.
+    None for non-columnar / empty sources."""
+    from dpark_tpu import rdd as _rdd
+    if not isinstance(r, _rdd.ParallelCollection):
+        return None
+    for s in r._slices or ():
+        cols = getattr(s, "columns", None)
+        if cols is not None and len(s):
+            import numpy as np
+            return sum(np.asarray(c).dtype.itemsize
+                       * int(np.prod(np.asarray(c).shape[1:] or (1,)))
+                       for c in cols)
+    return None
+
+
+def _rule_adapt_stale_hint(r, report):
+    """The adaptive-execution store (dpark_tpu/adapt.py, ISSUE 7)
+    keys its learned wave budgets by row-width class; when NONE of the
+    stored classes matches this plan's columnar source, the learned
+    budgets silently fail to apply — the store was warmed by a
+    different data shape (schema drift), and the first run of this
+    shape re-derives the memory bound and re-walks the OOM ladder.
+    Quiet with DPARK_ADAPT=off, with an empty store, and whenever any
+    stored class matches (mixed-width workloads are legitimate)."""
+    try:
+        from dpark_tpu import adapt
+        if not adapt.enabled():
+            return
+        row_bytes = _columnar_source_row_bytes(r)
+        if row_bytes is None:
+            return
+        widths = adapt.wave_budget_row_widths()
+        if not widths or row_bytes in widths:
+            return
+    except Exception:
+        return
+    report.add(
+        "adapt-stale-hint", "warn", r.scope_name,
+        "the adaptive store's learned wave budgets cover row widths "
+        "%s bytes, but this plan's columnar source is %d bytes/row — "
+        "stored budgets will not apply (stale shape class)"
+        % (sorted(widths), row_bytes),
+        "expected after a schema change: the first run re-learns its "
+        "budget; delete the DPARK_ADAPT_DIR store (or call "
+        "adapt.reset_store()) to drop stale entries"
+        + ("" if adapt.steering() else
+           " (note: DPARK_ADAPT=%s only records — budgets would "
+           "steer under DPARK_ADAPT=on)" % adapt.mode()))
+
+
 # ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
@@ -615,6 +674,7 @@ def lint_plan(rdd, master="local", report=None, lineage=None):
         _rule_monoid_multileaf(r, report)
         _rule_host_fallback_key(r, report)
         _rule_host_fallback_group(r, report)
+        _rule_adapt_stale_hint(r, report)
     _rule_uncached_reshuffle(lineage, report)
     excess = _excess_wide_depth(rdd)
     _rule_wide_depth(rdd, report, excess)

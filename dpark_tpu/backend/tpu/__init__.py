@@ -71,8 +71,12 @@ class TPUScheduler(DAGScheduler):
 
     def submit_tasks(self, stage, tasks, report):
         self.start()
+        import time as _time
+
+        from dpark_tpu import adapt
         from dpark_tpu.backend.tpu import fuse
         plan = None
+        adapt_sig = None
         if len(tasks) >= stage.num_partitions:
             # single-task retries skip the array path: run_stage always
             # processes all partitions, so replaying it for one failed
@@ -91,12 +95,31 @@ class TPUScheduler(DAGScheduler):
                     # host-fallback-key lint rule reports the same
                     # answer pre-flight
                     self.note_stage(stage.id, fallback_reason=reason)
+            elif adapt.enabled():
+                # off mode pays nothing past this flag check — the
+                # signature (sha1 over the stable program-key repr)
+                # is only worth computing when observations record
+                try:
+                    adapt_sig = fuse.plan_adapt_signature(plan)
+                except Exception:
+                    adapt_sig = None
+                # cost model (ISSUE 7 decision point 2): with recorded
+                # ms for BOTH paths of this program class, the cheaper
+                # one wins — predicted, not assumed, admission.  The
+                # choice is per stage and recorded as `adapt_reason`
+                # (the cost-model sibling of fallback/degrade_reason).
+                choice = adapt.choose_path(adapt_sig)
+                if choice is not None and choice["choice"] == "object":
+                    self.note_stage(stage.id,
+                                    adapt_reason=choice["reason"])
+                    plan = None
         if plan is not None:
             if self._run_degradable(stage, tasks, plan, report):
                 return
         # object path: run tasks inline on the driver (golden semantics);
         # cogroup stages first pre-materialize their CoGroupedRDD via the
         # device exchange so only the group-merge runs in Python
+        t0 = _time.time()
         precomputed = None
         try:
             precomputed = self._precompute_join(stage)
@@ -107,10 +130,14 @@ class TPUScheduler(DAGScheduler):
                 precomputed = self._precompute_cogroup(stage)
             except Exception as e:
                 logger.debug("cogroup precompute skipped: %s", e)
+        all_ok = False
         try:
+            statuses = []
             for task in tasks:
                 status, payload = _run_task_inline(task)
+                statuses.append(status)
                 report(task, status, payload)
+            all_ok = all(s == "success" for s in statuses)
         finally:
             if precomputed is not None:
                 # free the seeded partitions (unless the USER cached this
@@ -121,6 +148,15 @@ class TPUScheduler(DAGScheduler):
                     from dpark_tpu.env import env
                     env.cache.drop(cg.id, nparts)
                     cg.should_cache = False
+        # an analyzable stage that ran the object path CLEANLY
+        # (cost-model choice, analysis-time fallback with a plan, or
+        # runtime degrade) feeds the cost model its observed host ms —
+        # a failed/fetch-failed attempt must NOT record its short wall
+        # as a valid host cost (it would wrongly cheapen the object
+        # path and steer future runs off the device)
+        if adapt_sig is not None and all_ok:
+            adapt.observe_path(adapt_sig, "host",
+                               (_time.time() - t0) * 1e3)
 
     def _spill_write_failed(self, stage, tasks, report, e):
         """ENOSPC & co mid-spill: NOT a device fault, and the object
@@ -155,6 +191,7 @@ class TPUScheduler(DAGScheduler):
         from dpark_tpu.shuffle import SpillWriteError
         try:
             self._run_array_stage(stage, tasks, plan, report)
+            self._adapt_note_stream_budget()
             return True
         except SpillWriteError as e:
             self._spill_write_failed(stage, tasks, report, e)
@@ -167,6 +204,7 @@ class TPUScheduler(DAGScheduler):
                 self.note_stage(stage.id, degrade_reason=(
                     "array path error (%s: %s); object path"
                     % (type(e).__name__, str(e)[:160])))
+                self._adapt_observe_device_error(plan)
                 return False
             first = "%s: %s" % (type(e).__name__, str(e)[:160])
         # degrade step 1: halve the wave budget and retry the stage.
@@ -180,6 +218,7 @@ class TPUScheduler(DAGScheduler):
         # the event-loop thread (restored in the finally); a future
         # parallel-stage scheduler must thread it through the plan.
         old = conf.STREAM_CHUNK_ROWS
+        row_bytes = 16
         if isinstance(old, int):
             eff = old
         else:
@@ -187,7 +226,6 @@ class TPUScheduler(DAGScheduler):
             # the executor actually used, not the 16-byte-row default
             # (for wide rows that default is a LARGER wave than the
             # one that just OOM'd)
-            row_bytes = 16
             try:
                 from dpark_tpu.backend.tpu import fuse
                 if plan.source[0] == "ingest":
@@ -197,6 +235,17 @@ class TPUScheduler(DAGScheduler):
                 pass
             eff = conf.stream_chunk_rows(row_bytes)
         halved = max(64, int(eff) // 2)
+        # the ladder's outcomes feed the adaptive store (ISSUE 7): the
+        # budget that OOM'd is recorded as failing NOW — even if the
+        # job ultimately falls back to the object path, the next run
+        # of this row-width class starts below the failed rung instead
+        # of re-OOMing.  A user-pinned budget records nothing (pins
+        # bypass the auto derivation entirely).
+        from dpark_tpu import adapt
+        auto_budget = not isinstance(old, int)
+        if auto_budget:
+            adapt.record_wave_budget(row_bytes, int(eff), ok=False,
+                                     source="oom")
         conf.STREAM_CHUNK_ROWS = halved
         logger.warning("device error on %s (%s); retrying with halved "
                        "wave budget (%d rows/device)", stage, first,
@@ -206,6 +255,9 @@ class TPUScheduler(DAGScheduler):
             self.note_stage(stage.id, degrade_reason=(
                 "%s; stage retried with halved wave budget "
                 "(%d rows/device)" % (first, halved)))
+            if auto_budget:
+                adapt.record_wave_budget(row_bytes, halved, ok=True,
+                                         source="oom_ladder")
             return True
         except SpillWriteError as e:
             self._spill_write_failed(stage, tasks, report, e)
@@ -219,9 +271,47 @@ class TPUScheduler(DAGScheduler):
                 "%s; halved-wave retry failed (%s: %s); object path "
                 "for this stage" % (first, type(e2).__name__,
                                     str(e2)[:120])))
+            if auto_budget:
+                # a halved rung that failed for a NON-memory reason
+                # still did not OOM — it is the ladder's final working
+                # budget and the next run seeds from it; a rung that
+                # OOM'd again records as failing, so the next run
+                # starts below it
+                adapt.record_wave_budget(row_bytes, halved,
+                                         ok=not _device_error(e2),
+                                         source="oom_ladder")
+            self._adapt_observe_device_error(plan)
             return False
         finally:
             conf.STREAM_CHUNK_ROWS = old
+
+    def _adapt_observe_device_error(self, plan):
+        """Count a device-path failure for this program class in the
+        adaptive store (observability; path pricing needs observed ms
+        on both sides and never decides on errors alone)."""
+        try:
+            from dpark_tpu import adapt
+            from dpark_tpu.backend.tpu import fuse
+            if adapt.enabled():
+                adapt.observe_path(fuse.plan_adapt_signature(plan),
+                                   "device", error=True)
+        except Exception:
+            pass
+
+    def _adapt_note_stream_budget(self):
+        """Persist the wave budget a successful auto-sized streamed
+        stage ran with as known-good (ISSUE 7): the next run of this
+        row-width class seeds from it instead of re-deriving.  Pinned
+        budgets (tests, the ladder's halved retry) record via the
+        ladder paths, not here."""
+        from dpark_tpu import adapt, conf
+        ex = self.executor
+        if (ex.last_stream_stats is not None
+                and ex.last_wave_budget is not None
+                and conf.STREAM_CHUNK_ROWS == "auto"):
+            budget, row_bytes = ex.last_wave_budget
+            adapt.record_wave_budget(row_bytes, budget, ok=True,
+                                     source="stream")
 
     def _resident_nocombine_deps(self, cg):
         """All of a CoGroupedRDD's inputs as HBM-resident no-combine
@@ -446,4 +536,13 @@ class TPUScheduler(DAGScheduler):
                 value = task.func(iter(rows_per_part[task.partition]))
                 report(task, "success", (value, {}, {}))
         self.note_stage(stage.id, **note)
+        # feed the cost model (ISSUE 7): observed device ms for this
+        # program class — the other half of the device-vs-object price
+        try:
+            from dpark_tpu import adapt
+            if adapt.enabled():
+                adapt.observe_path(fuse.plan_adapt_signature(plan),
+                                   "device", note["run_seconds"] * 1e3)
+        except Exception:
+            pass
         logger.debug("array path ran %s (%d tasks)", stage, len(tasks))

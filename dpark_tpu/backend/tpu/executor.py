@@ -451,6 +451,10 @@ class JAXExecutor:
         # ingest/compute/exchange/spill ms + device-idle fraction
         # (reset by run_stage; the scheduler attaches it to stage_info)
         self.last_stream_stats = None
+        # (rows/device, row bytes) the last streamed stage budgeted its
+        # waves at — the OOM degradation ladder persists this into the
+        # adaptive store (ISSUE 7) so the next run seeds from it
+        self.last_wave_budget = None
         # live per-wave stage_info callback, set by the scheduler around
         # run_stage so a long stream's progress shows in the web UI
         self._stage_note = None
@@ -846,6 +850,7 @@ class JAXExecutor:
 
         Returns ("result", list_of_row_lists) or ("shuffle", sid)."""
         self.last_stream_stats = None       # set by streamed runs only
+        self.last_wave_budget = None
         mode = self._stream_mode(plan)
         if mode is not None:
             kind, waves = mode
@@ -1469,7 +1474,32 @@ class JAXExecutor:
         self.shuffle_store[sid] = store
         self._store_bytes += store["nbytes"]
         self._evict_hbm(keep_sid=sid)
+        self._observe_combine_ratio(dep, plan, store)
         return ("shuffle", sid)
+
+    def _observe_combine_ratio(self, dep, plan, store):
+        """Adaptive-store observation (ISSUE 7 decision point 4): a
+        COMBINING shuffle write over a columnar ingest source knows
+        both its input rows and its post-combine stored rows — the
+        observed combine ratio prices the map-side-combine rewrite for
+        this call site on the next run.  Never raises; no-op with
+        DPARK_ADAPT=off."""
+        from dpark_tpu import adapt
+        try:
+            if not adapt.enabled() or fuse.is_list_agg(dep.aggregator):
+                return
+            site = (getattr(dep, "adapt_combine_site", None)
+                    or getattr(dep, "adapt_site", None))
+            counts = store.get("counts")
+            if not site or counts is None \
+                    or plan.source[0] != "ingest":
+                return
+            rows_in = sum(len(s) for s in plan.source[1]._slices or ())
+            rows_out = int(layout.host_read(counts).sum())
+            if rows_in:
+                adapt.record_combine_ratio(site, rows_in, rows_out)
+        except Exception as e:
+            logger.debug("combine-ratio observation failed: %s", e)
 
     def _run_exchange_and_reduce(self, plan):
         dep = plan.source[1]
@@ -1508,16 +1538,48 @@ class JAXExecutor:
             else getattr(plan, "src_nk", 1) or 1
         if "host_runs" in store:
             batch = self._seg_batch_from_runs(store)
-            hist = None
+            hist_np = None
         else:
             counts, hist, leaves = self._seg_exchange_sorted(store, nk)
             batch = layout.Batch(store["out_treedef"], leaves, counts)
+            hist_np = layout.host_read(hist)
+            self._observe_seg_skew(dep, batch, hist_np)
         op = plan.ops[0]
         extra = ()
         if isinstance(op, fuse.SegMapOp):
-            op.layout = self._seg_bucket_layout(op.nk, batch, hist)
+            op.layout = self._seg_bucket_layout(op.nk, batch, hist_np)
             extra = (op.layout,)
         return self._run_narrow(plan, batch, extra_key=extra)
+
+    def _observe_seg_skew(self, dep, batch, hist_np):
+        """Adaptive-store observation (ISSUE 7 decision point 3): the
+        segment path's bucket histogram — computed anyway for the
+        apply layout — gives per-key-group sizes for free.  Record
+        total rows, group count, the largest group's approximate size
+        (size classes are powers of two), and the reduce width, keyed
+        by the grouping call site: a dominant group widens the next
+        run's default reduce side.  The same (rows, groups) pair
+        doubles as the combine-ratio signal that can re-enable the
+        map-side rewrite once the ratio drops.  Never raises."""
+        from dpark_tpu import adapt
+        try:
+            if not adapt.enabled():
+                return
+            site = getattr(dep, "adapt_site", None)
+            if not site:
+                return
+            rows = int(layout.host_read(batch.counts).sum())
+            per_bucket = np.asarray(hist_np).max(axis=0)
+            nonzero = np.nonzero(per_bucket)[0]
+            if not rows or not len(nonzero):
+                return
+            groups = int(np.asarray(hist_np).sum())
+            max_group = 1 << int(nonzero[-1])
+            adapt.record_skew(site, rows, groups, max_group,
+                              dep.partitioner.num_partitions)
+            adapt.record_combine_ratio(site, rows, groups)
+        except Exception as e:
+            logger.debug("seg-skew observation failed: %s", e)
 
     def _seg_exchange_sorted(self, store, nk):
         """The seg path's gather: exchange + key sort, with the bucket
@@ -1560,10 +1622,10 @@ class JAXExecutor:
     def _seg_bucket_layout(self, nk, batch, hist=None):
         """((bucket, width, group_capacity), ...) for the batch's
         power-of-two group-size classes: read from the gather program's
-        fused histogram when available, else one tiny histogram program
-        (the spilled-run ingest path).  Group capacities round to
-        power-of-two classes so data drift between runs (DStream ticks)
-        reuses compiled apply programs."""
+        fused histogram (already on host) when available, else one tiny
+        histogram program (the spilled-run ingest path).  Group
+        capacities round to power-of-two classes so data drift between
+        runs (DStream ticks) reuses compiled apply programs."""
         if hist is None:
             cap = batch.cap
             key = ("seghist", cap, nk,
@@ -1729,7 +1791,11 @@ class JAXExecutor:
         if plan.source[0] == "ingest":
             if not fuse._big_columnar(plan.source[1]):
                 return None
-            waves = self._wave_iter_columnar(plan)
+            row_bytes = fuse._columnar_row_bytes(plan.source[1]._slices)
+            chunk = conf.stream_chunk_rows(row_bytes)
+            self.last_wave_budget = (int(chunk), row_bytes)
+            self._check_wave_oom(chunk)
+            waves = self._wave_iter_columnar(plan, chunk)
         elif plan.source[0] == "text":
             if not fuse._big_text(plan.stage):
                 return None
@@ -1765,6 +1831,22 @@ class JAXExecutor:
         # reference's external merger; VERDICT r2 ask #7)
         return ("nocombine", _prefetch_iter(waves, depth=tok_depth))
 
+    @staticmethod
+    def _check_wave_oom(chunk_rows):
+        """Deterministic stand-in for a device HBM ceiling
+        (conf.EMULATED_WAVE_OOM_ROWS, bench/test aid): a wave budget
+        over the ceiling raises the RESOURCE_EXHAUSTED class the
+        degradation ladder halves on, so the OOM ladder — and the
+        adaptive store's learned budgets (ISSUE 7) — can be exercised
+        on backends that report no memory limit (XLA:CPU)."""
+        limit = getattr(conf, "EMULATED_WAVE_OOM_ROWS", 0)
+        if limit and chunk_rows > limit:
+            raise MemoryError(
+                "RESOURCE_EXHAUSTED: emulated HBM ceiling: wave "
+                "budget %d rows/device exceeds "
+                "DPARK_EMULATED_WAVE_OOM_ROWS=%d"
+                % (chunk_rows, limit))
+
     def _merge_probe(self, plan):
         """Memoized (merge_fn, monoid) for the plan's shuffle write —
         the same probe _epilogue_merge runs at compile time."""
@@ -1772,10 +1854,13 @@ class JAXExecutor:
             plan._merge_probe_result = self._epilogue_merge(plan)
         return plan._merge_probe_result
 
-    def _wave_iter_columnar(self, plan):
+    def _wave_iter_columnar(self, plan, chunk=None):
         from dpark_tpu.rdd import _ColumnarSlice
         slices = plan.source[1]._slices
-        chunk = conf.stream_chunk_rows(fuse._columnar_row_bytes(slices))
+        if chunk is None:      # caller usually passes the budget it
+            # already derived (one store consult per stage, not two)
+            chunk = conf.stream_chunk_rows(
+                fuse._columnar_row_bytes(slices))
         nchunks = (max(len(s) for s in slices) + chunk - 1) // chunk
         for c in range(nchunks):
             yield [

@@ -919,6 +919,32 @@ class StagePlan:
         return (src_key, spec_key, op_keys, epi_key)
 
 
+def plan_adapt_signature(plan):
+    """(stable program id, shape class) — the cross-process identity
+    the adaptive-execution store (dpark_tpu/adapt.py, ISSUE 7) keys
+    cost records by.  The program id hashes plan.program_key with
+    code-object-aware stable hashing (fn_key carries live code objects
+    whose default repr embeds a memory address); the shape class
+    buckets the source row count by power of two and carries the row
+    width, so observations generalize across small data drift but not
+    across scale jumps.  Memoized on the plan."""
+    sig = getattr(plan, "_adapt_sig", None)
+    if sig is None:
+        from dpark_tpu import adapt
+        rows = 0
+        row_bytes = 16
+        if plan.source[0] == "ingest":
+            slices = plan.source[1]._slices or ()
+            rows = sum(len(s) for s in slices)
+            row_bytes = _columnar_row_bytes(slices)
+        cls = "r%d" % row_bytes
+        if rows:
+            cls += "x%d" % (1 << max(0, int(rows - 1).bit_length()))
+        sig = (adapt.stable_key(plan.program_key), cls)
+        plan._adapt_sig = sig
+    return sig
+
+
 def _mapvalue_as_record_fn(f):
     def fn(rec):
         return (rec[0], f(rec[1]))

@@ -82,6 +82,59 @@ DPARK_SHUFFLE_CODE = os.environ.get("DPARK_SHUFFLE_CODE", "off")
 SHUFFLE_SHARD_ATTEMPTS = int(os.environ.get(
     "DPARK_SHUFFLE_SHARD_ATTEMPTS", "3") or 1)
 
+# ---------------------------------------------------------------------------
+# adaptive execution (dpark_tpu/adapt.py — ISSUE 7)
+# ---------------------------------------------------------------------------
+
+# off | observe | on.  "observe" (the CI-safe default) records
+# per-(program, shape class) compute/exchange/spill ms, OOM-ladder
+# outcomes, combine ratios, and skew histograms into a persistent
+# store but NEVER changes a plan — bit-identical to "off".  "on"
+# additionally steers four decision points (wave budget seeding,
+# device-vs-object path by predicted cost, skew-widened reduce sides,
+# map-side-combine pricing); every steered choice is recorded as an
+# `adapt` decision in the job record and bench JSON.
+DPARK_ADAPT = os.environ.get("DPARK_ADAPT", "observe")
+
+# where the stats store lives (crc-framed JSON lines, process-safe
+# append; delete the directory to reset all learned budgets/costs)
+DPARK_ADAPT_DIR = os.environ.get(
+    "DPARK_ADAPT_DIR", os.path.join(DPARK_WORK_DIR, "adapt"))
+
+# the append-only store compacts down to its in-memory aggregates
+# (one line per key) when the file exceeds this many bytes at load —
+# unbounded growth would otherwise make every process re-read and
+# crc-check an ever-longer history.  0 disables compaction.
+ADAPT_STORE_MAX_BYTES = int(os.environ.get(
+    "DPARK_ADAPT_STORE_MAX_BYTES", str(1 << 22)) or 0)
+
+# the object path must beat the device path by this factor of observed
+# ms before the cost model declines the array path (ties keep the
+# device: its compile cost amortizes across runs)
+ADAPT_PATH_MARGIN = float(os.environ.get("DPARK_ADAPT_PATH_MARGIN",
+                                         "0.8"))
+
+# dominant-group fraction (max group rows / total rows) above which an
+# observed histogram counts as skewed, and the widening factor applied
+# to the DEFAULT reduce width on the next run of that program
+ADAPT_SKEW_FRAC = float(os.environ.get("DPARK_ADAPT_SKEW_FRAC", "0.5"))
+ADAPT_SKEW_WIDEN = int(os.environ.get("DPARK_ADAPT_SKEW_WIDEN",
+                                      "2") or 2)
+
+# observed combine ratio (distinct keys / rows) above which map-side
+# pre-aggregation is priced OFF (nearly every key distinct: the
+# combine pass costs a sort and saves no exchange bytes)
+ADAPT_COMBINE_MAX_RATIO = float(os.environ.get(
+    "DPARK_ADAPT_COMBINE_MAX_RATIO", "0.6"))
+
+# deterministic stand-in for a device HBM ceiling (bench/test aid): a
+# streamed wave budget above this many rows/device raises the same
+# RESOURCE_EXHAUSTED class the degradation ladder halves on, so the
+# OOM ladder and the adaptive store's learned budgets can be exercised
+# on backends that report no memory limit (XLA:CPU).  0 = off.
+EMULATED_WAVE_OOM_ROWS = int(os.environ.get(
+    "DPARK_EMULATED_WAVE_OOM_ROWS", "0") or 0)
+
 # dcn transient-connect retry: total attempts (1 = no retry) and the
 # base backoff seconds (exponential with full jitter: attempt k sleeps
 # uniform in [base*2^k/2, base*2^k]).  Application-level ServerError
@@ -152,15 +205,25 @@ def stream_chunk_rows(row_bytes=16):
     (ingest -> bucketized, received -> merged), dropping the multiplier
     by roughly two copies; the budget rises to HBM/12 — but the
     pipeline also holds up to STREAM_PIPELINE_DEPTH extra ingested
-    waves in flight, which is why the divisor does not drop further."""
+    waves in flight, which is why the divisor does not drop further.
+
+    With DPARK_ADAPT=on the persistent stats store can SEED the
+    budget below the derived value: the last-known-good budget
+    recorded for this row-width class (e.g. by a previous run's OOM
+    degradation ladder) wins over re-deriving the memory bound and
+    re-walking the halving ladder (ISSUE 7).  An explicitly assigned
+    STREAM_CHUNK_ROWS always bypasses both."""
     if STREAM_CHUNK_ROWS != "auto":
         return STREAM_CHUNK_ROWS
     limit = _hbm_bytes_limit()
     if not limit:
-        return _STREAM_CHUNK_ROWS_FALLBACK
-    divisor = 12 if DONATE_BUFFERS else 16
-    return max(_STREAM_CHUNK_ROWS_FALLBACK,
-               limit // (divisor * max(1, row_bytes)))
+        base = _STREAM_CHUNK_ROWS_FALLBACK
+    else:
+        divisor = 12 if DONATE_BUFFERS else 16
+        base = max(_STREAM_CHUNK_ROWS_FALLBACK,
+                   limit // (divisor * max(1, row_bytes)))
+    from dpark_tpu import adapt
+    return adapt.steer_wave_budget(base, row_bytes)
 
 # text-source stages bigger than this stream in waves of splits instead
 # of materializing the whole encoded dataset (same out-of-core pipeline)

@@ -260,6 +260,7 @@ class DAGScheduler:
                 record["state"] = "done" if all(finished) else "aborted"
             record["seconds"] = round(_time.time() - job_t0, 3)
             self._finalize_decodes(record)
+            self._finalize_adapt(record)
 
     def _new_job_record(self, final_rdd, parts, stages=1):
         self._next_job_id += 1
@@ -278,6 +279,16 @@ class DAGScheduler:
         # ships as JSON)
         from dpark_tpu import coding
         record["_decode_base"] = coding.counters_snapshot()
+        # adaptive-execution accounting (ISSUE 7): the decision log is
+        # process-global too — snapshot its position (and reset the
+        # per-job de-dup epoch) so the decisions taken DURING this job
+        # (steered or observe-mode would-be) ride this record as
+        # record["adapt"], including choices repeated from a prior job
+        from dpark_tpu import adapt
+        try:
+            record["_adapt_base"] = adapt.begin_job()
+        except Exception:
+            pass
         self.history.append(record)
         del self.history[:-100]
         self._current_record = record
@@ -314,6 +325,27 @@ class DAGScheduler:
                 d = info.setdefault("decodes", {})
                 for k, v in delta.items():
                     d[k] = d.get(k, 0) + v
+
+    def _finalize_adapt(self, record):
+        """Attribute adaptive-execution decisions taken during this job
+        to its record (ISSUE 7): ``record["adapt"]`` carries the mode
+        plus the decision-log delta — steered choices (applied: true)
+        and observe-mode would-be choices (applied: false), each with
+        predicted (and, once measured, observed) ms.  Absent entirely
+        with DPARK_ADAPT=off, so off-mode records stay bit-identical
+        to the pre-PR shape."""
+        base = record.pop("_adapt_base", None)
+        if base is None:
+            return
+        try:
+            from dpark_tpu import adapt
+            if not adapt.enabled():
+                return
+            decisions = adapt.decisions_since(base)
+            record["adapt"] = {"mode": adapt.mode(),
+                               "decisions": decisions}
+        except Exception:
+            pass
 
     def _stage_info(self, record, stage_id):
         """The per-stage observability dict inside a job record

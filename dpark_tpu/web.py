@@ -35,7 +35,8 @@ _PAGE = """<!doctype html>
 <table id="t"><tr><th>job</th><th>scope</th><th>parts</th>
 <th>finished</th><th>stages</th><th>seconds</th><th>state</th>
 <th>recovery (resubmit/recompute/retry)</th>
-<th>decodes (repair/straggler/fail)</th></tr></table>
+<th>decodes (repair/straggler/fail)</th>
+<th>adapt (steered/logged)</th></tr></table>
 <h2>stages <small>(click a row for its tasks; DAG per job below)</small></h2>
 <table id="s"><tr><th>job</th><th>stage</th><th>rdd</th>
 <th>parts</th><th>kind</th><th>seconds</th><th>device run s</th>
@@ -92,8 +93,16 @@ async function tick() {
     const dec = dj.mode
       ? (dj.repair || 0) + '/' + (dj.straggler_win || 0) + '/' +
         (dj.decode_failures || 0) + ' [' + dj.mode + ']' : '';
+    // adaptive-execution decisions (ISSUE 7): cost-model choices taken
+    // during this job — applied steers vs observe-mode would-bes, with
+    // the mode; hover a stage's why column for the per-stage reason
+    const aj = j.adapt || {};
+    const ads = aj.decisions || [];
+    const adp = aj.mode
+      ? ads.filter(d => d.applied).length + '/' + ads.length +
+        ' [' + aj.mode + ']' : '';
     for (const v of [j.id, j.scope, j.parts, j.finished, j.stages,
-                     j.seconds, j.state, rec, dec])
+                     j.seconds, j.state, rec, dec, adp])
       row.insertCell().textContent = v;
     row.className = j.state === 'done' ? 'done' : 'run';
     const d = document.createElement('div');
@@ -109,8 +118,11 @@ async function tick() {
                              p.exchange_ms + '/' + p.spill_ms) : '';
       const idle = p.waves ? (100 * p.device_idle_frac).toFixed(1) : '';
       // why the stage left (or nearly left) the array path: the
-      // analyze-time fallback_reason or the runtime degrade_reason
-      const why = st.fallback_reason || st.degrade_reason || '';
+      // analyze-time fallback_reason, the runtime degrade_reason, or
+      // the cost model's adapt_reason (ISSUE 7: predicted, not
+      // assumed, admission)
+      const why = st.fallback_reason || st.degrade_reason ||
+        st.adapt_reason || '';
       // per-stage decode deltas: activity against THIS stage's map
       // outputs (the parent whose buckets were decoded from parity)
       const ds = st.decodes || {};

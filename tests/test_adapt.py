@@ -1,0 +1,623 @@
+"""Adaptive execution (ISSUE 7): persistent stats store + cost-model
+planner.
+
+The suite proves the four decision points close their loops — wave
+budget seeding, device-vs-object path pricing, skew-widened reduce
+sides, map-side-combine pricing — and that the CI-safe default
+(DPARK_ADAPT=observe) is BIT-IDENTICAL to off: observations are
+recorded but no plan ever changes.  Device tests run on a 2-device
+sliced mesh ("tpu:2") so the suite works on small containers (see the
+`mesh` marker note in conftest)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dpark_tpu import Columns, adapt, conf
+
+
+@pytest.fixture(autouse=True)
+def _fresh_adapt(tmp_path):
+    """Every test gets its own store dir and a reset in-memory plane;
+    conf knobs the tests touch are restored."""
+    old = (conf.STREAM_CHUNK_ROWS, conf.EMULATED_WAVE_OOM_ROWS,
+           conf._hbm_bytes_limit, conf._STREAM_CHUNK_ROWS_FALLBACK,
+           conf.GROUP_AGG_REWRITE)
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "adapt"))
+    yield
+    (conf.STREAM_CHUNK_ROWS, conf.EMULATED_WAVE_OOM_ROWS,
+     conf._hbm_bytes_limit, conf._STREAM_CHUNK_ROWS_FALLBACK,
+     conf.GROUP_AGG_REWRITE) = old
+    adapt.configure()          # back to conf-driven mode/dir
+
+
+@pytest.fixture()
+def tctx2():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu:2")
+    c.start()
+    yield c
+    c.stop()
+
+
+# ---------------------------------------------------------------------------
+# the store: framing, round-trip, corruption, concurrency format
+# ---------------------------------------------------------------------------
+
+def test_mode_grammar():
+    adapt.configure(mode="on")
+    assert adapt.mode() == "on" and adapt.steering()
+    adapt.configure(mode="observe")
+    assert adapt.enabled() and not adapt.steering()
+    adapt.configure(mode="off")
+    assert not adapt.enabled()
+    with pytest.raises(ValueError):
+        adapt.configure(mode="sometimes")
+
+
+def test_store_round_trip(tmp_path):
+    store = str(tmp_path / "s1")
+    adapt.configure(mode="observe", store_dir=store)
+    adapt.record_wave_budget(16, 4096, ok=True)
+    adapt.record_wave_budget(16, 8192, ok=False)
+    adapt.observe_path(("prog", "r16"), "device", 120.0)
+    adapt.observe_path(("prog", "r16"), "host", 80.0)
+    adapt.record_skew("site:1", rows=1000, groups=10, max_group=800,
+                      parts=2)
+    adapt.record_combine_ratio("site:1", rows_in=1000, rows_out=950)
+    path = adapt._store_path()
+    assert os.path.exists(path)
+    # a fresh process (simulated by configure) reloads the same state
+    adapt.configure(mode="observe", store_dir=store)
+    hist = adapt.stage_history()
+    assert hist["prog|r16"]["device_ms"] == 120.0
+    assert hist["prog|r16"]["host_ms"] == 80.0
+    with adapt._lock:
+        wb = dict(adapt._agg["wave_budget"]["rb16"])
+        skew = dict(adapt._agg["skew"]["site:1"])
+        ratio = adapt._agg["combine"]["site:1"]["ratio"]
+    assert wb == {"good": 4096, "bad": 8192}
+    assert skew["max_group"] == 800 and skew["rows"] == 1000
+    assert ratio == pytest.approx(0.95)
+
+
+def test_store_lines_are_crc_framed(tmp_path):
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "s2"))
+    adapt.record_wave_budget(16, 4096, ok=True)
+    line = open(adapt._store_path(), "rb").read().splitlines()[0]
+    head, _, payload = line.partition(b" ")
+    assert int(head, 16) == adapt._crc(payload)
+    json.loads(payload)        # the payload itself is plain JSON
+
+
+def test_corrupt_and_truncated_lines_skipped(tmp_path):
+    store = str(tmp_path / "s3")
+    adapt.configure(mode="observe", store_dir=store)
+    adapt.record_wave_budget(16, 4096, ok=True)
+    adapt.observe_path(("prog", "r16"), "device", 50.0)
+    raw = open(adapt._store_path(), "rb").read()
+    lines = raw.splitlines()
+    # corrupt line 0's payload (crc now mismatches), truncate line 1,
+    # and add plain garbage — the good line we append after must
+    # still load, and nothing raises
+    garbled = lines[0][:-3] + b"zzz"
+    with open(adapt._store_path(), "wb") as f:
+        f.write(garbled + b"\n" + lines[1][:10] + b"\nnot a line\n")
+    adapt.record_skew("site:x", rows=10, groups=2, max_group=8, parts=2)
+    adapt.configure(mode="observe", store_dir=store)
+    adapt._ensure_loaded()
+    with adapt._lock:
+        skipped = adapt._counters["skipped_lines"]
+        assert "rb16" not in adapt._agg["wave_budget"]
+        assert adapt._agg["skew"]["site:x"]["rows"] == 10
+    assert skipped == 3
+    assert adapt.stage_history() == {}
+
+
+def test_reset_store(tmp_path):
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "s4"))
+    adapt.record_wave_budget(16, 4096, ok=True)
+    assert os.path.exists(adapt._store_path())
+    adapt.reset_store()
+    assert not os.path.exists(adapt._store_path())
+    with adapt._lock:
+        assert not adapt._agg["wave_budget"]
+
+
+def test_off_mode_never_touches_disk(tmp_path):
+    store = str(tmp_path / "s5")
+    adapt.configure(mode="off", store_dir=store)
+    adapt.record_wave_budget(16, 4096, ok=True)
+    adapt.observe_path(("prog", "r16"), "device", 50.0)
+    adapt.record_skew("s", rows=10, groups=2, max_group=8, parts=2)
+    adapt.record_combine_ratio("s", rows_in=10, rows_out=10)
+    assert not os.path.exists(store)
+
+
+def test_identical_wave_budget_outcomes_deduplicate(tmp_path):
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "s6"))
+    for _ in range(5):
+        adapt.record_wave_budget(16, 4096, ok=True)
+    assert len(open(adapt._store_path(), "rb").read().splitlines()) == 1
+
+
+def test_store_compacts_past_size_cap(tmp_path):
+    """An over-cap store rewrites as its folded aggregates at load —
+    the append-only file stays bounded and the state survives."""
+    store = str(tmp_path / "s7")
+    adapt.configure(mode="observe", store_dir=store)
+    adapt.record_wave_budget(16, 4096, ok=True)
+    for i in range(200):
+        adapt.observe_path(("prog", "r16"), "device", 100.0 + i)
+    big = os.path.getsize(adapt._store_path())
+    old_cap = conf.ADAPT_STORE_MAX_BYTES
+    conf.ADAPT_STORE_MAX_BYTES = big // 2
+    try:
+        adapt.configure(mode="observe", store_dir=store)  # reload
+        adapt._ensure_loaded()
+    finally:
+        conf.ADAPT_STORE_MAX_BYTES = old_cap
+    assert os.path.getsize(adapt._store_path()) < big // 4
+    # the compacted store round-trips the folded state
+    adapt.configure(mode="observe", store_dir=store)
+    assert adapt.steer_wave_budget(8192, 16) == 8192  # observe: inert
+    hist = adapt.stage_history()
+    assert hist["prog|r16"]["device_ms"] == pytest.approx(299.0, abs=2)
+    with adapt._lock:
+        assert adapt._agg["wave_budget"]["rb16"]["good"] == 4096
+
+
+def test_repeat_steer_logged_per_job(tmp_path, ctx):
+    """A job that takes the same steered choice as its predecessor
+    still logs it: record["adapt"] deltas must not silently undercount
+    repeat steering (begin_job resets the de-dup epoch)."""
+    adapt.configure(mode="on", store_dir=str(tmp_path / "s8"))
+    adapt.record_skew("site:r", rows=1000, groups=10, max_group=900,
+                      parts=2)
+    for _ in range(2):
+        base = adapt.begin_job()          # what _new_job_record calls
+        assert adapt.suggest_partitions("site:r", 2) == 4
+        ds = adapt.decisions_since(base)
+        assert len(ds) == 1 and ds[0]["applied"], ds
+
+
+def test_stable_key_strips_addresses():
+    f1 = lambda x: x + 1          # noqa: E731
+    f2 = lambda x: x + 1          # noqa: E731
+    f3 = lambda x: x + 2          # noqa: E731
+    assert adapt.stable_key(("k", f1)) == adapt.stable_key(("k", f2))
+    assert adapt.stable_key(("k", f1)) != adapt.stable_key(("k", f3))
+    class Opaque:                  # repr embeds "at 0x..."
+        pass
+    a, b = Opaque(), Opaque()
+    assert adapt.stable_key(a) == adapt.stable_key(b)
+
+
+# ---------------------------------------------------------------------------
+# decision point 1: wave budget seeding
+# ---------------------------------------------------------------------------
+
+def test_steer_wave_budget_prefers_known_good(tmp_path):
+    adapt.configure(mode="on", store_dir=str(tmp_path / "w1"))
+    adapt.record_wave_budget(16, 2048, ok=True)
+    assert adapt.steer_wave_budget(8192, 16) == 2048
+    # a learned budget LARGER than the derived base never applies
+    assert adapt.steer_wave_budget(1024, 16) == 1024
+    # a different row-width class has no history
+    assert adapt.steer_wave_budget(8192, 32) == 8192
+
+
+def test_steer_wave_budget_halves_below_failed_rung(tmp_path):
+    adapt.configure(mode="on", store_dir=str(tmp_path / "w2"))
+    adapt.record_wave_budget(16, 4096, ok=False)
+    assert adapt.steer_wave_budget(8192, 16) == 2048
+
+
+def test_steer_wave_budget_inert_outside_on(tmp_path):
+    for m in ("off", "observe"):
+        adapt.configure(mode=m, store_dir=str(tmp_path / ("w3" + m)))
+        if m == "observe":
+            adapt.record_wave_budget(16, 2048, ok=True)
+        assert adapt.steer_wave_budget(8192, 16) == 8192
+
+
+def test_stream_chunk_rows_consults_store(tmp_path):
+    adapt.configure(mode="on", store_dir=str(tmp_path / "w4"))
+    conf.STREAM_CHUNK_ROWS = "auto"
+    conf._hbm_bytes_limit = lambda: 0
+    conf._STREAM_CHUNK_ROWS_FALLBACK = 8192
+    assert conf.stream_chunk_rows(16) == 8192
+    adapt.record_wave_budget(16, 1024, ok=True)
+    assert conf.stream_chunk_rows(16) == 1024
+    # a user-pinned budget always bypasses the store
+    conf.STREAM_CHUNK_ROWS = 555
+    assert conf.stream_chunk_rows(16) == 555
+
+
+# ---------------------------------------------------------------------------
+# decision point 2: device vs object path by predicted cost
+# ---------------------------------------------------------------------------
+
+def _seed_stage(sig, device_ms, host_ms):
+    adapt.observe_path(sig, "device", device_ms)
+    adapt.observe_path(sig, "host", host_ms)
+
+
+def test_choose_path_needs_both_sides(tmp_path):
+    adapt.configure(mode="on", store_dir=str(tmp_path / "p1"))
+    sig = ("prog", "r16")
+    assert adapt.choose_path(sig) is None          # no history
+    adapt.observe_path(sig, "device", 100.0)
+    assert adapt.choose_path(sig) is None          # device only
+
+
+def test_choose_path_picks_cheaper_recorded_path(tmp_path):
+    adapt.configure(mode="on", store_dir=str(tmp_path / "p2"))
+    _seed_stage(("prog", "r16"), device_ms=100.0, host_ms=10.0)
+    d = adapt.choose_path(("prog", "r16"))
+    assert d["choice"] == "object" and d["applied"]
+    assert "cheaper" in d["reason"]
+    # ties (and anything inside the margin) keep the device
+    _seed_stage(("prog2", "r16"), device_ms=100.0, host_ms=95.0)
+    d2 = adapt.choose_path(("prog2", "r16"))
+    assert d2["choice"] == "device"
+
+
+def test_choose_path_observe_logs_but_returns_none(tmp_path):
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "p3"))
+    _seed_stage(("prog", "r16"), device_ms=100.0, host_ms=10.0)
+    assert adapt.choose_path(("prog", "r16")) is None
+    ds = adapt.summary()["decisions"]
+    assert ds and ds[-1]["point"] == "path" \
+        and ds[-1]["choice"] == "object" and not ds[-1]["applied"]
+
+
+def test_steered_object_path_end_to_end(tmp_path, tctx2):
+    """Seed a synthetic history where the host is recorded far cheaper
+    for this exact program class: the next run of the same job must
+    take the object path with an adapt_reason, bit-identical."""
+    adapt.configure(mode="on", store_dir=str(tmp_path / "p4"))
+    i = np.arange(4000, dtype=np.int64)
+    data = Columns(i % 97, i % 11)
+
+    def job():
+        return sorted(tctx2.parallelize(data, 2)
+                      .reduceByKey(lambda a, b: a + b, 2).collect())
+
+    want = job()                               # runs the device path
+    hist = adapt.stage_history()
+    assert hist, "device run recorded no stage observations"
+    kinds1 = {s["id"]: s.get("kind")
+              for s in tctx2.scheduler.history[-1]["stage_info"]}
+    assert "array" in kinds1.values()
+    for key in hist:
+        sig = tuple(key.split("|", 1))
+        for _ in range(3):                     # EMA-converge the price
+            adapt.observe_path(sig, "host", 0.01)
+    got = job()
+    assert got == want
+    rec = tctx2.scheduler.history[-1]
+    reasons = [s.get("adapt_reason") for s in rec["stage_info"]]
+    assert any(r and "object path predicted cheaper" in r
+               for r in reasons), rec["stage_info"]
+    assert all(s.get("kind") != "array" for s in rec["stage_info"])
+    # the job record carries the applied decisions
+    assert any(d["applied"] and d["point"] == "path"
+               for d in rec["adapt"]["decisions"])
+
+
+# ---------------------------------------------------------------------------
+# decision point 3: partition count re-planned on observed skew
+# ---------------------------------------------------------------------------
+
+def test_suggest_partitions_widens_on_dominant_group(tmp_path):
+    adapt.configure(mode="on", store_dir=str(tmp_path / "k1"))
+    adapt.record_skew("site:1", rows=1000, groups=10, max_group=800,
+                      parts=2)
+    assert adapt.suggest_partitions("site:1", 2) == 4
+    # balanced histogram: the default stands
+    adapt.record_skew("site:2", rows=1000, groups=10, max_group=120,
+                      parts=2)
+    assert adapt.suggest_partitions("site:2", 2) == 2
+
+
+def test_suggest_partitions_observe_never_widens(tmp_path):
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "k2"))
+    adapt.record_skew("site:1", rows=1000, groups=10, max_group=900,
+                      parts=2)
+    assert adapt.suggest_partitions("site:1", 2) == 2
+    ds = adapt.summary()["decisions"]
+    assert ds and ds[-1]["point"] == "partitions" \
+        and not ds[-1]["applied"]
+
+
+def test_seg_path_records_skew_histogram(tmp_path, tctx2):
+    """The device segment path's bucket histogram — computed anyway
+    for the apply layout — lands in the store keyed by the grouping
+    call site."""
+    conf.GROUP_AGG_REWRITE = False
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "k3"))
+    rows = [(i % 7, i % 13) for i in range(4000)]
+    f = lambda vs: sum(v * v for v in vs)           # noqa: E731
+    got = dict(tctx2.parallelize(rows, 2).groupByKey(2)
+               .mapValue(f).collect())
+    want = {}
+    for k, v in rows:
+        want[k] = want.get(k, 0) + v * v
+    assert got == want
+    rec = tctx2.scheduler.history[-1]
+    assert any(s.get("kind") == "array" for s in rec["stage_info"])
+    with adapt._lock:
+        skews = dict(adapt._agg["skew"])
+    assert skews, "seg path recorded no skew observation"
+    (site, ent), = list(skews.items())[:1]
+    assert "test_adapt.py" in site
+    assert ent["rows"] == 4000 and ent["groups"] == 7
+
+
+# ---------------------------------------------------------------------------
+# decision point 4: map-side combine priced from the combine ratio
+# ---------------------------------------------------------------------------
+
+def test_map_side_combine_priced_off_at_high_ratio(tmp_path):
+    adapt.configure(mode="on", store_dir=str(tmp_path / "c1"))
+    assert adapt.map_side_combine("site:1", "sum")     # no history
+    adapt.record_combine_ratio("site:1", rows_in=1000, rows_out=950)
+    assert not adapt.map_side_combine("site:1", "sum")
+    adapt.record_combine_ratio("site:2", rows_in=1000, rows_out=20)
+    assert adapt.map_side_combine("site:2", "sum")
+
+
+def test_map_side_combine_observe_keeps_static_default(tmp_path):
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "c2"))
+    adapt.record_combine_ratio("site:1", rows_in=1000, rows_out=990)
+    assert adapt.map_side_combine("site:1", "sum")
+    ds = adapt.summary()["decisions"]
+    assert ds and ds[-1]["point"] == "map_combine" \
+        and not ds[-1]["applied"]
+
+
+def test_combining_shuffle_records_ratio(tmp_path, tctx2):
+    """A device combining shuffle write knows rows in (the columnar
+    source) and rows out (the stored per-partition counts): the ratio
+    lands in the store keyed by the combineByKey call site."""
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "c3"))
+    i = np.arange(6000, dtype=np.int64)
+    data = Columns(i % 50, i % 7)
+    n = tctx2.parallelize(data, 2) \
+             .reduceByKey(lambda a, b: a + b, 2).count()
+    assert n == 50
+    with adapt._lock:
+        ratios = {k: v["ratio"] for k, v in adapt._agg["combine"].items()}
+    assert ratios, "combining shuffle recorded no ratio"
+    (site, ratio), = list(ratios.items())[:1]
+    assert "test_adapt.py" in site
+    # 50 distinct keys; the combined rows may count per device slice
+    # (each device pre-aggregates its own slice before the exchange)
+    assert 50 / 6000 <= ratio <= 2 * 50 / 6000 + 1e-9, ratio
+
+
+def test_group_agg_rewrite_declined_by_price(tmp_path, ctx):
+    """The PR-1 linter's `group-agg` advisory as an optimizer choice:
+    with a recorded all-distinct combine ratio the rewrite is declined
+    (the grouped chain runs raw), and the answer does not change."""
+    adapt.configure(mode="on", store_dir=str(tmp_path / "c4"))
+    rows = [(i % 5, i) for i in range(100)]
+
+    def job():
+        return dict(ctx.parallelize(rows, 4).groupByKey(4)
+                    .mapValue(sum).collect())
+
+    grouped = ctx.parallelize(rows, 4).groupByKey(4)
+    site = grouped.dep.adapt_site
+    assert site and "test_adapt.py" in site
+    assert grouped._group_agg_rewrite(sum) is not None
+    want = job()
+    adapt.record_combine_ratio(site, rows_in=100, rows_out=98)
+    grouped2 = ctx.parallelize(rows, 4).groupByKey(4)
+    # same call line -> same site key
+    assert grouped2.dep.adapt_site != site or \
+        grouped2._group_agg_rewrite(sum) is None
+    assert job() == want
+
+
+# ---------------------------------------------------------------------------
+# observe-mode bit-parity with off (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+def _parity_jobs(c):
+    rows = [(i % 13, (i * 7) % 29) for i in range(2000)]
+    r = c.parallelize(rows, 4)
+    out = [sorted(r.reduceByKey(lambda a, b: a + b, 3).collect()),
+           sorted((k, sorted(v)) for k, v in
+                  r.groupByKey(3).collect()),
+           r.map(lambda kv: kv[1]).reduce(lambda a, b: a + b)]
+    j = sorted(r.join(c.parallelize(rows[::7], 2), 3).collect())
+    return out + [j]
+
+
+@pytest.mark.parametrize("master", ["local", "tpu:2"])
+def test_observe_bit_parity_with_off(tmp_path, master):
+    from dpark_tpu import DparkContext
+    results = {}
+    for m in ("off", "observe"):
+        adapt.configure(mode=m, store_dir=str(tmp_path / ("par" + m)))
+        c = DparkContext(master)
+        c.start()
+        try:
+            results[m] = _parity_jobs(c)
+            rec = c.scheduler.history[-1]
+        finally:
+            c.stop()
+        if m == "off":
+            assert "adapt" not in rec
+    assert results["off"] == results["observe"]
+
+
+def test_observe_bit_parity_under_faults(tmp_path):
+    """Observe mode is bit-identical to off ACROSS THE CHAOS MATRIX:
+    an injected fetch fault recovers identically either way."""
+    from dpark_tpu import DparkContext, faults
+    results = {}
+    for m in ("off", "observe"):
+        adapt.configure(mode=m, store_dir=str(tmp_path / ("f" + m)))
+        # bounded injection (times=) like the chaos suite's
+        # probabilistic tests: unbounded p= on the cogroup's
+        # multi-parent fetches can exceed the recovery caps
+        faults.configure("shuffle.fetch:p=0.2,seed=7,times=4")
+        try:
+            c = DparkContext("local")
+            c.start()
+            try:
+                results[m] = _parity_jobs(c)
+                rec = c.scheduler.history[-1]
+                assert rec.get("state") == "done"
+            finally:
+                c.stop()
+        finally:
+            faults.configure(None)
+    assert results["off"] == results["observe"]
+
+
+# ---------------------------------------------------------------------------
+# the OOM ladder feeds the store; run 2 skips the ladder
+# ---------------------------------------------------------------------------
+
+def _streamed_setup(base):
+    conf._hbm_bytes_limit = lambda: 0
+    conf._STREAM_CHUNK_ROWS_FALLBACK = base
+    conf.STREAM_CHUNK_ROWS = "auto"
+
+
+def _ladder_retries(sched, jobs0):
+    """Ladder walks counted from the per-stage job records since
+    history index jobs0 — degrade_reasons() de-duplicates identical
+    strings across history, which would hide a warm run re-walking
+    the ladder with the same budget numbers."""
+    return [st["degrade_reason"]
+            for rec in sched.history[jobs0:]
+            for st in rec.get("stage_info", ())
+            if "wave budget" in (st.get("degrade_reason") or "")]
+
+
+def test_second_run_skips_oom_ladder(tmp_path, tctx2):
+    """Run 1 OOMs at the derived budget, halves, succeeds, and
+    persists the working rung; run 2 seeds from the store and streams
+    first try — the ISSUE 7 acceptance loop."""
+    adapt.configure(mode="on", store_dir=str(tmp_path / "oom1"))
+    base = 1 << 13
+    _streamed_setup(base)
+    conf.EMULATED_WAVE_OOM_ROWS = base * 3 // 4
+    ndev = tctx2.scheduler.executor.ndev
+    n = base * 3 // 2 * ndev
+    i = np.arange(n, dtype=np.int64)
+    data = Columns((i * 2654435761) % 1000, i & 0xFFFF)
+
+    def run():
+        jobs0 = len(tctx2.scheduler.history)
+        ns = tctx2.parallelize(data, ndev) \
+                  .sortByKey(numSplits=ndev).count()
+        assert ns == n
+        return _ladder_retries(tctx2.scheduler, jobs0)
+
+    assert len(run()) >= 1                    # cold: walked the ladder
+    assert run() == []                        # warm: seeded, no ladder
+    ds = [d for d in adapt.summary()["decisions"]
+          if d["point"] == "wave_budget" and d["applied"]]
+    assert ds and ds[-1]["choice"] == base // 2
+
+
+def test_ladder_records_even_on_object_fallback(tmp_path, tctx2):
+    """Satellite: a ceiling below HALF the derived budget fails both
+    ladder rungs and the stage falls back to the object path — but the
+    failing rungs are persisted, so run 2 starts BELOW them and
+    streams instead of re-OOMing."""
+    adapt.configure(mode="on", store_dir=str(tmp_path / "oom2"))
+    base = 1 << 13
+    _streamed_setup(base)
+    conf.EMULATED_WAVE_OOM_ROWS = base // 4       # halved rung OOMs too
+    ndev = tctx2.scheduler.executor.ndev
+    n = base * 3 // 2 * ndev
+    i = np.arange(n, dtype=np.int64)
+    data = Columns((i * 2654435761) % 1000, i & 0xFFFF)
+
+    def run():
+        jobs0 = len(tctx2.scheduler.history)
+        ns = tctx2.parallelize(data, ndev) \
+                  .sortByKey(numSplits=ndev).count()
+        assert ns == n
+        return (_ladder_retries(tctx2.scheduler, jobs0),
+                tctx2.scheduler.history[-1])
+
+    ladder1, rec1 = run()
+    assert ladder1, "cold run never hit the ladder"
+    assert any("object path" in (s.get("degrade_reason") or "")
+               for s in rec1["stage_info"])
+    with adapt._lock:
+        ent = dict(adapt._agg["wave_budget"]["rb16"])
+    assert ent["bad"] == base // 2 and ent["good"] is None
+    ladder2, rec2 = run()
+    assert ladder2 == [], ladder2             # seeded at bad//2: fits
+    assert all("object path" not in (s.get("degrade_reason") or "")
+               for s in rec2["stage_info"])
+
+
+def test_store_persists_across_processes(tmp_path, tctx2):
+    """The cross-process half of the two-run proof: a store warmed in
+    THIS process seeds a context whose adapt plane reloads from disk
+    (configure() drops all in-memory state first)."""
+    store = str(tmp_path / "xproc")
+    adapt.configure(mode="on", store_dir=store)
+    adapt.record_wave_budget(16, 1234, ok=True)
+    adapt.configure(mode="on", store_dir=store)   # fresh plane
+    with adapt._lock:
+        assert not adapt._agg["wave_budget"]      # really dropped
+    assert adapt.steer_wave_budget(100000, 16) == 1234
+
+
+# ---------------------------------------------------------------------------
+# job records, summary schema, lint rule
+# ---------------------------------------------------------------------------
+
+def test_job_record_carries_adapt_section(tmp_path, ctx):
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "r1"))
+    ctx.parallelize([(1, 2), (2, 3)], 2).collect()
+    rec = ctx.scheduler.history[-1]
+    assert rec["adapt"]["mode"] == "observe"
+    assert isinstance(rec["adapt"]["decisions"], list)
+
+
+def test_summary_schema(tmp_path):
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "r2"))
+    s = adapt.summary()
+    for field in ("mode", "store", "store_hits", "store_misses",
+                  "steered", "recorded", "decisions"):
+        assert field in s, field
+
+
+def test_adapt_stale_hint_lint_rule(tmp_path, ctx):
+    from dpark_tpu.analysis import lint_plan
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "l1"))
+    i = np.arange(100, dtype=np.int64)
+    r = ctx.parallelize(Columns(i, i), 2) \
+           .reduceByKey(lambda a, b: a + b)
+
+    def rules(rep):
+        return {f.rule for f in rep}
+
+    # empty store: quiet
+    assert "adapt-stale-hint" not in rules(lint_plan(r))
+    # a stored budget for a DIFFERENT row-width class: stale, warn
+    adapt.record_wave_budget(8, 2048, ok=True)
+    rep = lint_plan(r)
+    assert "adapt-stale-hint" in rules(rep)
+    [f] = [f for f in rep if f.rule == "adapt-stale-hint"]
+    assert "16 bytes/row" in f.message
+    # a matching class present: quiet again (mixed widths are fine)
+    adapt.record_wave_budget(16, 2048, ok=True)
+    assert "adapt-stale-hint" not in rules(lint_plan(r))
+    # off mode: always quiet
+    adapt.configure(mode="off", store_dir=str(tmp_path / "l1"))
+    assert "adapt-stale-hint" not in rules(lint_plan(r))
