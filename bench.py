@@ -212,6 +212,13 @@ def _ooc_phase():
     # schema-gated like faults/decodes
     from dpark_tpu import adapt
     payload["adapt"] = adapt.summary()
+    # trace plane (ISSUE 8): mode + span counts + the critical-path
+    # summary of the longest traced job (which stage/phase chain bound
+    # wall time) — so the perf trajectory records WHERE time went, not
+    # just how much.  {"mode": "off", "spans": 0, ...} when untraced;
+    # schema-gated like faults/decodes/adapt.
+    from dpark_tpu import trace
+    payload["trace"] = trace.summary()
     ctx.stop()
     print("OOC_RESULT %s" % json.dumps(payload), flush=True)
 

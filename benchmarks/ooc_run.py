@@ -207,6 +207,10 @@ def main():
     # OOC line, schema-gated by tools/bench_smoke_check.py
     from dpark_tpu import adapt
     out["adapt"] = adapt.summary()
+    # trace plane (ISSUE 8): span counts + critical-path summary of
+    # the longest traced job, same shape as the bench.py OOC line
+    from dpark_tpu import trace
+    out["trace"] = trace.summary()
     ctx.stop()
     print(json.dumps(out), flush=True)
 

@@ -187,17 +187,14 @@ def _ensure_loaded():
                 raw = f.read()
         except OSError:
             return
-        for line in raw.split(b"\n"):
-            if not line.strip():
-                continue
-            head, _, payload = line.partition(b" ")
+        from dpark_tpu.utils import unframe_jsonl
+        recs, skipped = unframe_jsonl(raw)
+        _counters["skipped_lines"] += skipped
+        for rec in recs:
             try:
-                if int(head, 16) != _crc(payload):
-                    raise ValueError("crc mismatch")
-                rec = json.loads(payload.decode("utf-8"))
                 _apply(rec)
             except Exception:
-                # corrupt / truncated / foreign line: skip, never fail
+                # foreign / malformed record: skip, never fail
                 _counters["skipped_lines"] += 1
         cap = int(getattr(conf, "ADAPT_STORE_MAX_BYTES", 0) or 0)
         if cap and len(raw) > cap:
@@ -233,14 +230,10 @@ def _compact_locked(path):
                          "rows_in": 1000000,
                          "rows_out": int(ent["ratio"] * 1000000)})
     try:
-        lines = []
-        for rec in recs:
-            payload = json.dumps(rec, sort_keys=True,
-                                 separators=(",", ":")).encode("utf-8")
-            lines.append(b"%08x %s" % (_crc(payload), payload))
+        from dpark_tpu.utils import frame_jsonl
         tmp = path + ".compact.%d" % os.getpid()
         with open(tmp, "wb") as f:
-            f.write(b"\n".join(lines) + b"\n" if lines else b"")
+            f.write(b"".join(frame_jsonl(rec) for rec in recs))
         os.replace(tmp, path)
         logger.debug("adapt store compacted to %d records", len(recs))
     except Exception as e:
@@ -256,9 +249,8 @@ def _append(rec):
         _apply(rec)
         _counters["recorded"] += 1
         try:
-            payload = json.dumps(rec, sort_keys=True,
-                                 separators=(",", ":")).encode("utf-8")
-            line = b"%08x %s\n" % (_crc(payload), payload)
+            from dpark_tpu.utils import frame_jsonl
+            line = frame_jsonl(rec)
             os.makedirs(store_dir(), exist_ok=True)
             fd = os.open(_store_path(),
                          os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
@@ -332,6 +324,12 @@ def _decide(point, key, choice, reason, predicted_ms=None,
              "reason": reason, "applied": bool(applied)}
         if predicted_ms is not None:
             d["predicted_ms"] = round(float(predicted_ms), 2)
+        from dpark_tpu import trace
+        if trace._PLANE is not None:
+            # trace-plane twin (ISSUE 8): cost-model choices land on
+            # the timeline next to the stages they steered
+            trace.event("adapt.decision", "adapt", point=point,
+                        choice=str(choice), applied=bool(applied))
         _decisions.append(d)
         if applied:
             _counters["steered"] += 1

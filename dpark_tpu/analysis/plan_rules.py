@@ -46,6 +46,13 @@ shuffle anti-patterns that dominate cost at production scale:
                          source: schema drift left the store's hints
                          stale, so the first run re-walks the OOM
                          ladder instead of seeding.
+  trace-overhead-hint    DPARK_TRACE=spool with a reduce side whose
+                         estimated spool writes per task (one fetch
+                         span per parent map bucket) exceed
+                         conf.TRACE_SPAN_WRITES_PER_TASK — on
+                         tiny-task jobs the span spooling can rival
+                         the work being traced; coalesce, raise the
+                         threshold, or trace with ring mode.
 
 The walk reads graph structure only (dependencies / partitioner /
 cache flags) — it never touches RDD.splits (which can promote lazy
@@ -653,6 +660,74 @@ def _rule_adapt_stale_hint(r, report):
            "steer under DPARK_ADAPT=on)" % adapt.mode()))
 
 
+def _width_hint(r, depth=0):
+    """Best-effort partition count WITHOUT touching RDD.splits (the
+    property can promote lazy checkpoints, see the module header):
+    already-materialized splits, parallelize slices, a shuffle
+    output's own partitioner width, or a single narrow parent's hint.
+    None when the width isn't structurally knowable."""
+    from dpark_tpu.dependency import OneToOneDependency, \
+        ShuffleDependency
+    while r is not None and depth < 64:
+        depth += 1
+        splits = getattr(r, "_splits", None)
+        if splits is not None:
+            return len(splits)
+        slices = getattr(r, "_slices", None)     # ParallelCollection
+        if slices is not None:
+            return len(slices)
+        deps = getattr(r, "dependencies", ())
+        if len(deps) == 1 and isinstance(deps[0], ShuffleDependency):
+            part = getattr(r, "partitioner", None)
+            n = getattr(part, "num_partitions", None)
+            if n:
+                return int(n)
+        if len(deps) == 1 and isinstance(deps[0],
+                                         OneToOneDependency):
+            r = getattr(deps[0], "rdd", None)    # width-preserving
+            continue
+        return None
+    return None
+
+
+def _rule_trace_overhead_hint(r, report):
+    """With DPARK_TRACE=spool every reduce task appends roughly one
+    fetch span PER PARENT MAP BUCKET plus its own task spans to the
+    spool — an O_APPEND write each.  On a tiny-task job (many map
+    partitions feeding many short reduce tasks) the spool traffic can
+    rival the compute the trace is meant to explain.  Warn when the
+    estimated spool writes per reduce task exceed
+    conf.TRACE_SPAN_WRITES_PER_TASK.  Quiet in off/ring modes (no disk
+    writes at all)."""
+    try:
+        from dpark_tpu import conf as _conf, trace
+        if trace.mode() != "spool":
+            return
+        from dpark_tpu.dependency import ShuffleDependency
+        widest = 0
+        for dep in getattr(r, "dependencies", ()):
+            if isinstance(dep, ShuffleDependency):
+                widest = max(widest, _width_hint(dep.rdd) or 0)
+        if not widest:
+            return
+        est = 2 + widest          # task span + task.run + fetch/bucket
+        cap = int(getattr(_conf, "TRACE_SPAN_WRITES_PER_TASK", 64))
+        if est <= cap:
+            return
+    except Exception:
+        return
+    report.add(
+        "trace-overhead-hint", "warn", r.scope_name,
+        "DPARK_TRACE=spool will append ~%d spans per reduce task here "
+        "(%d parent map buckets each fetch-spanned) — above the "
+        "TRACE_SPAN_WRITES_PER_TASK=%d hint threshold, spooling can "
+        "dominate tiny tasks" % (est, widest, cap),
+        "coalesce the map side (fewer, larger partitions), raise "
+        "DPARK_TRACE_SPAN_WRITES_PER_TASK if the tasks are long "
+        "enough to amortize it, or trace with DPARK_TRACE=ring "
+        "(in-memory, no spool writes)")
+
+
 # ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
@@ -675,6 +750,7 @@ def lint_plan(rdd, master="local", report=None, lineage=None):
         _rule_host_fallback_key(r, report)
         _rule_host_fallback_group(r, report)
         _rule_adapt_stale_hint(r, report)
+        _rule_trace_overhead_hint(r, report)
     _rule_uncached_reshuffle(lineage, report)
     excess = _excess_wide_depth(rdd)
     _rule_wide_depth(rdd, report, excess)

@@ -13,6 +13,7 @@ through the ordinary ShuffleFetcher protocol.
 """
 
 import os
+import time
 
 import numpy as np
 
@@ -21,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from dpark_tpu import conf, faults
+from dpark_tpu import conf, faults, trace
 from dpark_tpu.backend.tpu import collectives, fuse, layout
 from dpark_tpu.utils.log import get_logger
 
@@ -147,6 +148,7 @@ class _StreamStats:
         import time
         self._clock = time.perf_counter
         self.t0 = self._clock()
+        self.wall_t0 = time.time()   # epoch twin of t0 (trace spans)
         self.depth = depth
         self.donated = donated
         self.waves = 0
@@ -486,11 +488,11 @@ class JAXExecutor:
         self._shard_cache_bytes = 0
         self._shard_build_lock = threading.Lock()
         self._tracing = False
-        if conf.TRACE_DIR:
+        if conf.XPROF_DIR:
             try:
-                jax.profiler.start_trace(conf.TRACE_DIR)
+                jax.profiler.start_trace(conf.XPROF_DIR)
                 self._tracing = True
-                logger.info("jax profiler trace -> %s", conf.TRACE_DIR)
+                logger.info("jax profiler trace -> %s", conf.XPROF_DIR)
             except Exception as e:
                 logger.warning("profiler trace unavailable: %s", e)
 
@@ -636,6 +638,8 @@ class JAXExecutor:
         if key in self._compiled:
             return self._compiled[key]
         faults.hit("executor.compile")     # chaos site: per cache miss
+        if trace._PLANE is not None:
+            trace.event("compile", "exec", program="narrow", cap=cap)
         ops = plan.ops
         epilogue = plan.epilogue
         n_dst = self.ndev
@@ -849,6 +853,10 @@ class JAXExecutor:
         """Execute the whole stage for all partitions at once.
 
         Returns ("result", list_of_row_lists) or ("shuffle", sid)."""
+        with trace.span("stage.exec", "exec", source=plan.source[0]):
+            return self._run_stage(plan)
+
+    def _run_stage(self, plan):
         self.last_stream_stats = None       # set by streamed runs only
         self.last_wave_budget = None
         mode = self._stream_mode(plan)
@@ -924,6 +932,8 @@ class JAXExecutor:
         `donate` is for streamed waves only: the batch's leaves are
         dead after this call and XLA may reuse them in place."""
         faults.hit("executor.dispatch")    # chaos site: per dispatch
+        if trace._PLANE is not None:
+            trace.event("dispatch", "exec", program="narrow")
         jitted = self._compile_narrow(
             plan, batch.cap, len(batch.cols),
             tuple(str(c.dtype) for c in batch.cols), donate=donate,
@@ -1929,6 +1939,25 @@ class JAXExecutor:
             except Exception:
                 pass
 
+    def _trace_stream_phases(self, stats):
+        """Per-stage phase spans (trace plane, ISSUE 8) from the SAME
+        snapshot scheduler.phase_table() reads, laid back-to-back from
+        the stream's wall start — tools/dtrace's critical-path phase
+        totals therefore reconcile with the phase table by
+        construction."""
+        if trace._PLANE is None or self.last_stream_stats is None:
+            return
+        snap = self.last_stream_stats
+        ts = stats.wall_t0
+        for phase, key in (("ingest_tokenize", "ingest_ms"),
+                           ("narrow", "compute_ms"),
+                           ("exchange", "exchange_ms"),
+                           ("spill", "spill_ms")):
+            dur = float(snap.get(key, 0.0) or 0.0) / 1e3
+            trace.emit("phase." + phase, "phase", ts, dur,
+                       waves=snap.get("waves"))
+            ts += dur
+
     def _run_streamed_shuffle(self, plan, waves):
         dep = plan.epilogue[1]
         # classified monoids combine through segment scatters; any
@@ -1945,6 +1974,8 @@ class JAXExecutor:
         batches = self._stream_batches(plan, waves, stats)
         try:
             for c, (batch, ingest_s) in enumerate(batches):
+                t_wall = time.time() if trace._PLANE is not None \
+                    else 0.0
                 t_disp = stats.now()
                 outs = self._run_narrow(plan, batch, bounds=bounds,
                                         donate=donate)
@@ -1969,6 +2000,9 @@ class JAXExecutor:
                                 (stats.now() - t_disp) - exchange_s,
                                 exchange_s)
                 self._note_pipeline(stats)
+                if trace._PLANE is not None:
+                    trace.emit("wave", "exec", t_wall,
+                               time.time() - t_wall, wave=c)
                 logger.debug("streamed wave %d", c + 1)
         finally:
             close = getattr(batches, "close", None)
@@ -1977,6 +2011,7 @@ class JAXExecutor:
         leaves, counts = self._shrink_state(state)
         stats.add_busy(busy_start, stats.now())
         self._note_pipeline(stats)
+        self._trace_stream_phases(stats)
         return self._register_shuffle(dep, plan, {
             "leaves": leaves, "counts": counts,
             "pre_reduced": True,        # device d holds reduce part d
@@ -1997,6 +2032,8 @@ class JAXExecutor:
         if key in self._compiled:
             return self._compiled[key]
         faults.hit("executor.compile")     # chaos site: per cache miss
+        if trace._PLANE is not None:
+            trace.event("compile", "exec", program="snc", cap=cap)
         ops = plan.ops
         ndev = self.ndev
         has_bounds = plan.epi_bounds is not None
@@ -2159,6 +2196,8 @@ class JAXExecutor:
         ok = False
         try:
             for c, (batch, ingest_s) in enumerate(batches):
+                t_wall = time.time() if trace._PLANE is not None \
+                    else 0.0
                 t_disp = stats.now()
                 faults.hit("executor.dispatch")   # chaos site: per wave
                 jitted = self._compile_stream_nocombine(
@@ -2207,6 +2246,9 @@ class JAXExecutor:
                         stats.add_busy(pd, read_done)
                     pending = (c, sorted_batch, t_disp)
                 self._note_pipeline(stats)
+                if trace._PLANE is not None:
+                    trace.emit("wave", "exec", t_wall,
+                               time.time() - t_wall, wave=c)
                 logger.debug("streamed no-combine wave %d", c + 1)
             if pending is not None:
                 pw, pb, pd = pending
@@ -2230,6 +2272,7 @@ class JAXExecutor:
                 import shutil
                 shutil.rmtree(spool, ignore_errors=True)
         self._note_pipeline(stats)
+        self._trace_stream_phases(stats)
         host_combine = not fuse.is_list_agg(dep.aggregator)
         premerge = _RunPremerger(runs, self._read_run, self._write_run,
                                  spool,
@@ -2356,6 +2399,8 @@ class JAXExecutor:
         from dpark_tpu.shuffle import SpillWriteError, spill_crc
         from dpark_tpu.utils import atomic_file, compress
         blob = compress(pickle.dumps(rows, -1))
+        if trace._PLANE is not None:
+            trace.event("spill.write", "shuffle", bytes=len(blob))
         code = coding.active_code()
         try:
             if code is not None:
@@ -2388,6 +2433,8 @@ class JAXExecutor:
         from dpark_tpu.utils import decompress
         with open(path, "rb") as f:
             raw = f.read()
+        if trace._PLANE is not None:
+            trace.event("spill.read", "shuffle", bytes=len(raw))
         if coding.is_container(raw):
             # coded run: per-shard crcs; corruption repairs by decode,
             # and only a sub-k survivor count escalates to lineage
@@ -2757,6 +2804,7 @@ class JAXExecutor:
         "export" column)."""
         import time as _time
         t0 = _time.perf_counter()
+        t_wall = _time.time() if trace._PLANE is not None else 0.0
         try:
             if shard is not None:
                 return self._export_shard(sid, map_id, reduce_id,
@@ -2764,6 +2812,12 @@ class JAXExecutor:
             return self._export_bucket(sid, map_id, reduce_id)
         finally:
             self.export_seconds += _time.perf_counter() - t0
+            if trace._PLANE is not None:
+                # named phase.export so the critical-path analyzer's
+                # export total matches phase_table()'s export column
+                trace.emit("phase.export", "phase", t_wall,
+                           _time.time() - t_wall, shuffle=sid,
+                           map=map_id, reduce=reduce_id)
 
     # serialized+encoded bucket shards kept for re-fetch; beyond this
     # the oldest buckets drop (re-encoding is cheap vs re-exporting)

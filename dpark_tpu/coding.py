@@ -521,6 +521,11 @@ def note(kind, shuffle_id=None):
             per = _PER_SHUFFLE.setdefault(
                 shuffle_id, {k: 0 for k in _KINDS})
             per[kind] += 1
+    from dpark_tpu import trace
+    if trace._PLANE is not None:
+        # timeline twin of the counter (ISSUE 8): each decode outcome
+        # is an instant event on the fetching task's span context
+        trace.event("decode." + kind, "coding", shuffle=shuffle_id)
 
 
 def counters_snapshot():

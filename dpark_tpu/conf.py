@@ -350,8 +350,45 @@ EGEST_NARROW_MIN_BYTES = 8 << 20
 EGEST_WARN_BYTES = 256 << 20
 
 # when set, the tpu executor writes a jax.profiler trace here for the
-# whole session (view with tensorboard / xprof)
-TRACE_DIR = os.environ.get("DPARK_TRACE_DIR")
+# whole session (view with tensorboard / xprof).  NOTE: this knob was
+# DPARK_TRACE_DIR before ISSUE 8; that name now belongs to the span
+# trace plane's spool directory below.
+XPROF_DIR = os.environ.get("DPARK_XPROF_DIR")
+
+# ---------------------------------------------------------------------------
+# trace plane (dpark_tpu/trace.py — ISSUE 8)
+# ---------------------------------------------------------------------------
+
+# off | ring | spool.  "off" (the default) costs one `is None` check
+# per site and is bit-identical to any traced run; "ring" keeps spans
+# in a bounded in-memory ring (served live by the web UI's
+# /api/trace); "spool" additionally appends crc-framed JSON lines to
+# per-process files under DPARK_TRACE_DIR — worker-process spans and
+# fault/decode counters then merge back into the driver's job records,
+# and tools/dtrace exports the merged Chrome trace / critical path.
+DPARK_TRACE = os.environ.get("DPARK_TRACE", "off")
+
+# where spool files live (one trace-<host>-<pid>.jsonl per process;
+# delete the directory to reset)
+DPARK_TRACE_DIR = os.environ.get(
+    "DPARK_TRACE_DIR", os.path.join(DPARK_WORK_DIR, "trace"))
+
+# bounded in-memory span ring per process (ring AND spool modes)
+TRACE_RING_SPANS = int(os.environ.get("DPARK_TRACE_RING", "4096")
+                       or 4096)
+
+# per-process spool byte cap: span writes stop past this (counted as
+# dropped); counter events always land (they are the worker-counter
+# merge substrate).  0 = unbounded.
+TRACE_SPOOL_MAX_BYTES = int(os.environ.get(
+    "DPARK_TRACE_SPOOL_MAX_BYTES", str(32 << 20)) or 0)
+
+# trace-overhead-hint lint rule: warn when DPARK_TRACE=spool and a
+# reduce task's estimated spool writes (one fetch span per parent map
+# bucket + the task spans) exceed this — tiny-task jobs then spend
+# comparable time spooling and computing
+TRACE_SPAN_WRITES_PER_TASK = int(os.environ.get(
+    "DPARK_TRACE_SPAN_WRITES_PER_TASK", "64") or 64)
 
 # ---------------------------------------------------------------------------
 # pre-flight plan linter (dpark_tpu/analysis/)

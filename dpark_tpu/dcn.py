@@ -257,6 +257,16 @@ class ServerError(IOError):
 
 
 def _request(sock, req):
+    from dpark_tpu import trace
+    if trace._PLANE is None:
+        return _request_impl(sock, req)
+    with trace.span("dcn.transfer", "dcn", kind=str(req[0])) as sp:
+        payload = _request_impl(sock, req)
+        sp.args["bytes"] = len(payload)
+        return payload
+
+
+def _request_impl(sock, req):
     blob = _encode_req(req)
     sock.sendall(struct.pack("!I", len(blob)) + blob)
     status, n = struct.unpack("!BQ", _recv_exact(sock, 9))
@@ -302,7 +312,7 @@ def _connect(uri, timeout, attempts=None, sleep=time.sleep, rand=None):
     originates in _request, never here, and callers like FetchPool
     continue to let it through untouched."""
     assert uri.startswith("tcp://"), uri
-    from dpark_tpu import conf, faults
+    from dpark_tpu import conf, faults, trace
     host, _, port = uri[len("tcp://"):].partition(":")
     attempts = max(1, conf.DCN_CONNECT_ATTEMPTS
                    if attempts is None else attempts)
@@ -311,8 +321,10 @@ def _connect(uri, timeout, attempts=None, sleep=time.sleep, rand=None):
     for k in range(attempts):
         try:
             faults.hit("dcn.connect")
-            return socket.create_connection((host, int(port)),
-                                            timeout=timeout)
+            with trace.span("dcn.connect", "dcn", uri=uri,
+                            attempt=k + 1):
+                return socket.create_connection((host, int(port)),
+                                                timeout=timeout)
         except (ConnectionError, OSError) as e:
             last_err = e
             d = next(delays, None)

@@ -74,6 +74,28 @@ class DparkEnv:
         self.workdir = environ.get("DPARK_WORKDIR") or self._pick_workdir()
         os.makedirs(self.workdir, exist_ok=True)
 
+        # trace plane (ISSUE 8): a worker process inherits the
+        # driver's mode/dir through the shipped environ (covers
+        # programmatic trace.configure() on the driver, which env vars
+        # alone would miss).  Re-configuring also re-stamps the plane
+        # with THIS process's pid — a plane inherited by fork (the
+        # forkserver imported trace with DPARK_TRACE set) carries the
+        # parent's pid, which would corrupt the latest-counter-per-pid
+        # merge.  Spool files are per-pid, so workers never contend.
+        if not is_master:
+            from dpark_tpu import trace
+            tmode = environ.get("DPARK_TRACE")
+            try:
+                if tmode:
+                    trace.configure(tmode,
+                                    environ.get("DPARK_TRACE_DIR"),
+                                    run=environ.get("DPARK_TRACE_RUN"))
+                elif trace.active():
+                    trace.configure(trace.mode(), trace.trace_dir(),
+                                    run=trace.run_id())
+            except Exception:
+                pass
+
         from dpark_tpu.shuffle import ParallelShuffleFetcher
         from dpark_tpu.cache import Cache
         self.shuffle_fetcher = ParallelShuffleFetcher()
@@ -118,6 +140,11 @@ class DparkEnv:
             out["DPARK_MEM_LIMIT"] = str(self.mem_limit)
         if getattr(self, "profile", False):
             out["DPARK_PROFILE"] = "1"
+        from dpark_tpu import trace
+        if trace.active():
+            out["DPARK_TRACE"] = trace.mode()
+            out["DPARK_TRACE_DIR"] = trace.trace_dir()
+            out["DPARK_TRACE_RUN"] = trace.run_id()
         return out
 
     def stop(self):

@@ -12,11 +12,12 @@ dispatch belongs to the DCN layer (see backend/).
 import multiprocessing
 import pickle
 import queue
+import threading
 import traceback
 
 import sys
 
-from dpark_tpu import conf, serialize
+from dpark_tpu import conf, serialize, trace
 
 
 def _submodule(name):
@@ -34,6 +35,10 @@ from dpark_tpu.task import ResultTask, ShuffleMapTask
 from dpark_tpu.utils.log import Progress, get_logger
 
 logger = get_logger("schedule")
+
+# /metrics phase-seconds histogram bucket edges (seconds); the web
+# renderer cumulates these into Prometheus le= buckets
+PHASE_BUCKETS = (0.05, 0.25, 1.0, 5.0, 30.0, 120.0)
 
 
 class Stage:
@@ -88,6 +93,14 @@ class DAGScheduler:
         self.host_manager = env.host_manager
         self.history = []              # job records for the web UI
         self._next_job_id = 0
+        # guards history-list mutation vs the web server's /metrics
+        # snapshot (ISSUE 8 satellite: a scrape mid-job must never
+        # throw); per-record field mutation stays lock-free — the
+        # snapshot copies defensively.  The archive keeps aggregates
+        # of records trimmed out of the 100-job window so /metrics
+        # counters never decrease.
+        self._metrics_lock = threading.RLock()
+        self._metrics_archive = self._new_metrics()
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -157,6 +170,7 @@ class DAGScheduler:
             finally:
                 record["seconds"] = round(_time.time() - t0, 3)
                 self._finalize_decodes(record)
+                self._trace_job_span(record, t0)
             return
 
         output_parts = list(partitions)
@@ -228,7 +242,13 @@ class DAGScheduler:
                          "started": now})
             logger.debug("submit stage %s with %d tasks", stage, len(tasks))
             in_flight[0] += len(tasks)
-            self.submit_tasks(stage, tasks, report)
+            if trace._PLANE is not None:
+                # tasks carry the job id so worker-process task.run
+                # spans parent correctly after the serialize trip
+                for t in tasks:
+                    t._trace_job = record["id"]
+            with trace.ctx(job=record["id"], stage=stage.id):
+                self.submit_tasks(stage, tasks, report)
 
         def spawn_duplicate(stage, p):
             """Speculative copy of a straggling task (first result wins)."""
@@ -240,7 +260,10 @@ class DAGScheduler:
             in_flight[0] += 1
             record["speculated"] = record.get("speculated", 0) + 1
             logger.info("speculatively re-launching %r", t)
-            self.submit_tasks(stage, [t], report)
+            if trace._PLANE is not None:
+                t._trace_job = record["id"]
+            with trace.ctx(job=record["id"], stage=stage.id):
+                self.submit_tasks(stage, [t], report)
 
         submit_stage(final_stage)
         record["stages"] = len(stage_of)
@@ -261,6 +284,7 @@ class DAGScheduler:
             record["seconds"] = round(_time.time() - job_t0, 3)
             self._finalize_decodes(record)
             self._finalize_adapt(record)
+            self._trace_job_span(record, job_t0)
 
     def _new_job_record(self, final_rdd, parts, stages=1):
         self._next_job_id += 1
@@ -289,10 +313,34 @@ class DAGScheduler:
             record["_adapt_base"] = adapt.begin_job()
         except Exception:
             pass
-        self.history.append(record)
-        del self.history[:-100]
+        # worker-counter merge (ISSUE 8 satellite): with spool tracing
+        # on, worker processes append cumulative fault/decode counters
+        # to the trace spool — snapshot the merged view now so this
+        # job's delta attributes only ITS decode activity
+        if trace.mode() == "spool":
+            try:
+                record["_trace_decode_base"] = \
+                    trace.merged_worker_counters()
+            except Exception:
+                pass
+        with self._metrics_lock:
+            self.history.append(record)
+            dropped = self.history[:-100]
+            if dropped:
+                self._archive_metrics(dropped)
+            del self.history[:-100]
         self._current_record = record
         return record
+
+    def _trace_job_span(self, record, t0):
+        """Emit the job's span (trace plane, ISSUE 8) — the root of
+        the per-job timeline tools/dtrace analyzes."""
+        if trace._PLANE is None:
+            return
+        trace.emit("job", "sched", t0, record.get("seconds", 0.0),
+                   job=record["id"], scope=record.get("scope"),
+                   state=record.get("state"),
+                   stages=record.get("stages"))
 
     def _finalize_decodes(self, record):
         """Attribute coded-shuffle decode activity since the job
@@ -315,6 +363,44 @@ class DAGScheduler:
             record["decodes"] = dict(totals, mode=coding.describe())
         base_per = base.get("per_shuffle", {})
         for sid, counts in snap.get("per_shuffle", {}).items():
+            prev = base_per.get(sid, {})
+            delta = {k: v - prev.get(k, 0) for k, v in counts.items()}
+            if not any(delta.values()):
+                continue
+            parent = self.shuffle_to_stage.get(sid)
+            if parent is not None:
+                info = self._stage_info(record, parent.id)
+                d = info.setdefault("decodes", {})
+                for k, v in delta.items():
+                    d[k] = d.get(k, 0) + v
+        self._merge_worker_decodes(record)
+
+    def _merge_worker_decodes(self, record):
+        """Fold WORKER-PROCESS decode deltas (spooled counter events,
+        ISSUE 8 satellite) into this job's record: the multiprocess
+        master's workers decode in their own processes, and before the
+        trace spool their counters never reached the driver (the
+        documented per-process caveat of PRs 6-7)."""
+        from dpark_tpu import coding
+        wbase = record.pop("_trace_decode_base", None)
+        if wbase is None:
+            return
+        try:
+            snap = trace.merged_worker_counters()
+        except Exception:
+            return
+        base_tot = wbase.get("decodes", {})
+        totals = {k: v - base_tot.get(k, 0)
+                  for k, v in snap.get("decodes", {}).items()}
+        if any(totals.values()):
+            d = record.setdefault("decodes",
+                                  {"mode": coding.describe()})
+            for k, v in totals.items():
+                d[k] = d.get(k, 0) + v
+            d["worker_processes"] = snap.get("processes", 0)
+        base_per = wbase.get("decodes_per_shuffle", {})
+        for sid, counts in snap.get("decodes_per_shuffle",
+                                    {}).items():
             prev = base_per.get(sid, {})
             delta = {k: v - prev.get(k, 0) for k, v in counts.items()}
             if not any(delta.values()):
@@ -412,6 +498,128 @@ class DAGScheduler:
         # close parity came (shards_found/shards_needed ride the
         # FetchFailed), a plain fetch failure never had parity at all.
         out["decodes"] = coding.stats()
+        # worker-counter merge (ISSUE 8 satellite): with spool tracing
+        # on, worker processes append cumulative fault/decode counters
+        # to the trace spool; fold them in so the multiprocess master's
+        # summary finally covers what its workers observed
+        if trace.mode() == "spool":
+            try:
+                workers = trace.merged_worker_counters()
+            except Exception:
+                workers = None
+            if workers and workers.get("processes"):
+                for site, st in workers["faults"].items():
+                    ent = out["faults"].setdefault(
+                        site, {"hits": 0, "fired": 0, "kind": "?"})
+                    ent["hits"] = ent.get("hits", 0) + st["hits"]
+                    ent["fired"] = ent.get("fired", 0) + st["fired"]
+                for kind, v in workers["decodes"].items():
+                    out["decodes"][kind] = \
+                        out["decodes"].get(kind, 0) + v
+                out["worker_processes"] = workers["processes"]
+        return out
+
+    @staticmethod
+    def _new_metrics():
+        return {"jobs": {}, "stages": {},
+                "tasks": {"ok": 0, "fail": 0},
+                "counters": {"retries": 0, "resubmits": 0,
+                             "recomputes": 0, "fetch_failed": 0,
+                             "speculated": 0},
+                "adapt_decisions": {"applied": 0, "logged": 0},
+                "phases": {}}
+
+    @staticmethod
+    def _observe_phase(hists, phase, seconds):
+        h = hists.get(phase)
+        if h is None:
+            h = hists[phase] = {
+                "buckets": [0] * (len(PHASE_BUCKETS) + 1),
+                "sum": 0.0, "count": 0}
+        for i, le in enumerate(PHASE_BUCKETS):
+            if seconds <= le:
+                h["buckets"][i] += 1
+                break
+        else:
+            h["buckets"][-1] += 1
+        h["sum"] += seconds
+        h["count"] += 1
+
+    @classmethod
+    def _fold_metrics_record(cls, out, rec):
+        """Fold one job record into a metrics aggregate — defensively:
+        a record mid-mutation contributes what it can, never throws.
+        Records still RUNNING contribute nothing: their state flips
+        and their counters/phase totals grow between scrapes, which
+        would make counter-typed /metrics series decrease (Prometheus
+        reads any decrease as a counter reset) — in-flight jobs are
+        exposed separately as the dpark_jobs_running gauge."""
+        try:
+            state = str(rec.get("state", "unknown"))
+            if state == "running":
+                return
+            out["jobs"][state] = out["jobs"].get(state, 0) + 1
+            for k in out["counters"]:
+                out["counters"][k] += int(rec.get(k, 0) or 0)
+            ad = rec.get("adapt") or {}
+            for d in list(ad.get("decisions") or ()):
+                out["adapt_decisions"]["logged"] += 1
+                if d.get("applied"):
+                    out["adapt_decisions"]["applied"] += 1
+            for st in list(rec.get("stage_info") or ()):
+                kind = str(st.get("kind", "object"))
+                out["stages"][kind] = out["stages"].get(kind, 0) + 1
+                for t in list(st.get("tasks") or ()):
+                    out["tasks"]["ok" if t.get("ok")
+                                 else "fail"] += 1
+                pipe = st.get("pipeline")
+                if isinstance(pipe, dict):
+                    for phase, key in (
+                            ("ingest_tokenize", "ingest_ms"),
+                            ("narrow", "compute_ms"),
+                            ("exchange", "exchange_ms"),
+                            ("spill", "spill_ms")):
+                        ms = pipe.get(key)
+                        if ms:
+                            cls._observe_phase(out["phases"], phase,
+                                               float(ms) / 1e3)
+        except Exception:
+            pass                    # record mid-mutation: best effort
+
+    def _archive_metrics(self, records):
+        """Fold records about to fall out of the 100-job history
+        window into the persistent archive, so /metrics counters stay
+        MONOTONIC (Prometheus counters must never decrease — a drop
+        reads as a counter reset and rate() reports a huge spurious
+        increase).  Called under the metrics lock; records this old
+        are finalized."""
+        for rec in records:
+            self._fold_metrics_record(self._metrics_archive, rec)
+
+    def metrics_snapshot(self):
+        """Aggregate counters for the /metrics endpoint (ISSUE 8):
+        the archived aggregate of trimmed history plus a defensive
+        fold of the live window, copied under the scheduler lock — a
+        scrape racing a mutating job record must return valid,
+        monotonic numbers, never throw."""
+        import copy
+        with self._metrics_lock:
+            records = list(self.history)
+            out = copy.deepcopy(self._metrics_archive)
+        for rec in records:
+            self._fold_metrics_record(out, rec)
+        try:
+            out["jobs_running"] = sum(
+                1 for rec in records
+                if str(rec.get("state")) == "running")
+        except Exception:
+            out["jobs_running"] = 0
+        ex = getattr(self, "executor", None)
+        try:
+            out["export_seconds"] = float(
+                getattr(ex, "export_seconds", 0.0)) if ex else 0.0
+        except Exception:
+            out["export_seconds"] = 0.0
         return out
 
     def phase_table(self):
@@ -458,6 +666,12 @@ class DAGScheduler:
         info = self._stage_info(record, stage_id)
         if info.get("started") and info.get("seconds") is None:
             info["seconds"] = round(_time.time() - info["started"], 3)
+            if trace._PLANE is not None:
+                trace.emit("stage", "sched", info["started"],
+                           info["seconds"], job=record["id"],
+                           stage=stage_id, rdd=info.get("rdd"),
+                           kind=info.get("kind"),
+                           parents=list(info.get("parents") or ()))
         # streamed stages report per-wave pipeline timings live; once
         # the stage is done, keep only the tail so a thousand-wave run
         # doesn't bloat the job history (/api/jobs ships it as JSON)
@@ -551,6 +765,15 @@ class DAGScheduler:
                                "host": getattr(task, "_ran_on",
                                                env.host),
                                "ok": status == "success"})
+                if trace._PLANE is not None:
+                    # driver-side task span (submit -> completion
+                    # event), retroactive from the recorded times
+                    trace.emit("task", "sched", started,
+                               _time.time() - started,
+                               job=record["id"], stage=task.stage_id,
+                               task=task.partition, status=status,
+                               host=getattr(task, "_ran_on",
+                                            env.host))
             if status == "success":
                 result, acc_updates, md_updates = payload
                 self.host_manager.task_succeed_on(
@@ -688,7 +911,11 @@ class DAGScheduler:
                     retry = task.retry_copy()
                     in_flight[0] += 1
                     submitted_at[tkey] = _time.time()
-                    self.submit_tasks(stage, [retry], report)
+                    if trace._PLANE is not None:
+                        retry._trace_job = record["id"]
+                    with trace.ctx(job=record["id"],
+                                   stage=task.stage_id):
+                        self.submit_tasks(stage, [retry], report)
             else:       # failure
                 # credit the EXECUTOR that ran the task (fleet
                 # placement): blacklist ranking must see failures
@@ -720,7 +947,11 @@ class DAGScheduler:
                 retry = task.retry_copy()
                 in_flight[0] += 1
                 submitted_at[tkey] = _time.time()
-                self.submit_tasks(stage, [retry], report)
+                if trace._PLANE is not None:
+                    retry._trace_job = record["id"]
+                with trace.ctx(job=record["id"],
+                               stage=task.stage_id):
+                    self.submit_tasks(stage, [retry], report)
 
     # -- master-specific -------------------------------------------------
     def submit_tasks(self, stage, tasks, report):
@@ -735,6 +966,20 @@ PROFILE_KEY = "__profile__"
 
 
 def _run_task_inline(task):
+    if trace._PLANE is None:
+        return _run_task_body(task)
+    # the task.run span is the WORKER-side timeline unit: in a
+    # multiprocess run it lands in that process's spool (its pid
+    # distinguishes it in the merged Chrome trace); nested fetch/spill
+    # spans inherit the job/stage/task fields from this context
+    with trace.ctx(job=getattr(task, "_trace_job", None),
+                   stage=task.stage_id, task=task.partition), \
+            trace.span("task.run", "worker",
+                       kind=type(task).__name__, tried=task.tried):
+        return _run_task_body(task)
+
+
+def _run_task_body(task):
     from dpark_tpu import mutable_dict
     accumulator.start_task()
     mutable_dict.clear_task_updates()
@@ -881,6 +1126,10 @@ def _process_worker(task_bytes, snapshot, environ):
         if checker is not None:
             checker.stop()
             memutil.current_checker = None
+        # cumulative fault/decode counters -> the trace spool (spool
+        # mode only): the driver merges the latest event per process,
+        # closing the per-process counter blindspot (ISSUE 8)
+        trace.emit_process_counters()
     try:
         return serialize.dumps((status, payload))
     except Exception:

@@ -19,7 +19,7 @@ import struct
 import threading
 from queue import Empty, Queue
 
-from dpark_tpu import coding, conf, faults
+from dpark_tpu import coding, conf, faults, trace
 from dpark_tpu.utils import atomic_file, compress, decompress
 from dpark_tpu.utils.log import get_logger
 
@@ -480,6 +480,14 @@ def read_bucket_any(uris, shuffle_id, map_id, reduce_id):
     come from, not just count failures).  With a shuffle code active
     the bucket is fetched shard-wise (fastest k of n, decode instead
     of FetchFailed).  Raises FetchFailed when every replica fails."""
+    if trace._PLANE is None:
+        return _read_bucket_any(uris, shuffle_id, map_id, reduce_id)
+    with trace.span("fetch.bucket", "shuffle", shuffle=shuffle_id,
+                    map=map_id, reduce=reduce_id):
+        return _read_bucket_any(uris, shuffle_id, map_id, reduce_id)
+
+
+def _read_bucket_any(uris, shuffle_id, map_id, reduce_id):
     from dpark_tpu.env import env
     if isinstance(uris, str):
         uris = (uris,)
@@ -755,6 +763,8 @@ class DiskSpillMerger(Merger):
         items = sorted(self.combined.items(), key=lambda kv: kv[0])
         chunk = conf.SHUFFLE_CHUNK_RECORDS
         code = coding.active_code()
+        if trace._PLANE is not None:
+            trace.event("spill.write", "shuffle", records=len(items))
         with atomic_file(path) as f:
             for i in range(0, len(items), chunk):
                 blob = compress(pickle.dumps(items[i:i + chunk], -1))
@@ -783,6 +793,8 @@ class DiskSpillMerger(Merger):
         """Stream one spill run back chunk by chunk (sorted within and
         across chunks: the run was sorted before chunking), verifying
         each chunk's crc32c before unpickling."""
+        if trace._PLANE is not None:
+            trace.event("spill.read", "shuffle")
         with open(path, "rb") as f:
             while True:
                 hdr = f.read(12)

@@ -120,3 +120,43 @@ def builtin_globals_ok(f, code=None):
         elif getattr(fbuiltins, g, None) is not expected:
             return False
     return True
+
+
+# ---------------------------------------------------------------------------
+# crc-framed JSON lines — the shared on-disk format of the adapt store
+# and the trace spool: each line is b"<crc32 hex> <canonical json>\n",
+# appended with a single O_APPEND write so concurrent processes
+# interleave whole lines, and a torn/corrupt line skips at load.
+# ---------------------------------------------------------------------------
+
+def frame_jsonl(rec):
+    """One record -> one framed line (newline included)."""
+    import json
+    from dpark_tpu.shuffle import spill_crc
+    payload = json.dumps(rec, sort_keys=True,
+                         separators=(",", ":")).encode("utf-8")
+    return b"%08x %s\n" % (spill_crc(payload), payload)
+
+
+def unframe_jsonl(raw):
+    """Framed bytes -> ([dict records], skipped line count).  Lines
+    failing the crc, the JSON parse, or the dict shape skip — never an
+    error (a torn concurrent append must not poison the load)."""
+    import json
+    from dpark_tpu.shuffle import spill_crc
+    recs, skipped = [], 0
+    for line in raw.split(b"\n"):
+        if not line.strip():
+            continue
+        head, _, payload = line.partition(b" ")
+        try:
+            if int(head, 16) != spill_crc(payload):
+                raise ValueError("crc mismatch")
+            rec = json.loads(payload.decode("utf-8"))
+            if not isinstance(rec, dict):
+                raise ValueError("non-dict record")
+        except Exception:
+            skipped += 1
+            continue
+        recs.append(rec)
+    return recs, skipped

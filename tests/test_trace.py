@@ -1,0 +1,583 @@
+"""Trace plane (ISSUE 8): span/event parity, spool robustness,
+cross-process merge, critical-path analysis, /metrics scrape.
+
+The non-negotiable contract: tracing OBSERVES, it never perturbs —
+off/ring/spool runs are bit-identical, including across the chaos
+matrix (injected fetch faults, spill corruption, device OOM).  Device
+tests run on a 2-device sliced mesh ("tpu:2") so the suite fits small
+containers."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from dpark_tpu import conf, faults, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(tmp_path):
+    """Every test starts and ends without trace or chaos planes."""
+    trace.configure("off")
+    faults.configure(None)
+    yield
+    trace.configure("off")
+    faults.configure(None)
+
+
+@pytest.fixture()
+def tctx2():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu:2")
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def tiny_waves():
+    old = conf.STREAM_CHUNK_ROWS
+    conf.STREAM_CHUNK_ROWS = 500
+    yield
+    conf.STREAM_CHUNK_ROWS = old
+
+
+def _reduce_job(c, n=200, parts=4, reduce_parts=3):
+    return dict(c.parallelize([(i % 5, 1) for i in range(n)], parts)
+                .reduceByKey(lambda a, b: a + b,
+                             reduce_parts).collect())
+
+
+# ---------------------------------------------------------------------------
+# the plane itself
+# ---------------------------------------------------------------------------
+
+def test_off_mode_is_one_predicate():
+    assert trace._PLANE is None
+    assert trace.mode() == "off"
+    # span()/ctx() return the shared no-op singleton: no allocation
+    assert trace.span("x", "y", a=1) is trace._NOOP
+    assert trace.ctx(job=1) is trace._NOOP
+    trace.event("x", "y", a=1)          # swallowed
+    trace.emit("x", "y", 0.0, 1.0)      # swallowed
+    assert trace.counts() == (0, 0)
+    assert trace.snapshot() == []
+    assert trace.collected() == []
+
+
+def test_configure_validates_mode():
+    with pytest.raises(ValueError):
+        trace.configure("loud")
+
+
+def test_ring_mode_bounded_and_ordered(tmp_path):
+    trace.configure("ring")
+    for i in range(10):
+        trace.emit("e%d" % i, "t", float(i), 0.5)
+    recs = trace.snapshot()
+    assert [r["name"] for r in recs] == ["e%d" % i for i in range(10)]
+    assert trace._PLANE.ring.maxlen == conf.TRACE_RING_SPANS
+    assert trace.counts()[0] == 10
+
+
+def test_span_context_and_error_capture(tmp_path):
+    trace.configure("ring")
+    with trace.ctx(job=7, stage=3):
+        with trace.span("work", "test", detail="x"):
+            pass
+        with pytest.raises(RuntimeError):
+            with trace.span("boom", "test"):
+                raise RuntimeError("no")
+    ok, bad = trace.snapshot()
+    assert ok["job"] == 7 and ok["stage"] == 3
+    assert ok["args"] == {"detail": "x"}
+    assert bad["args"]["error"] == "RuntimeError"
+    assert bad["dur"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# parity: tracing observes, never perturbs (chaos matrix included)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec", [
+    None,
+    "shuffle.fetch:p=0.3,seed=11,times=3",
+    "shuffle.spill_write:nth=1,kind=corrupt",
+])
+def test_mode_parity_local_chaos_matrix(ctx, tmp_path, spec):
+    pairs = [(i % 11, i) for i in range(500)]
+
+    def run():
+        faults.configure(spec)
+        try:
+            return dict(ctx.parallelize(pairs, 4)
+                        .groupByKey(3)
+                        .mapValues(sorted).collect())
+        finally:
+            faults.configure(None)
+
+    expected = run()                     # trace off
+    for mode in ("ring", "spool"):
+        trace.configure(mode, str(tmp_path / mode))
+        try:
+            assert run() == expected, (mode, spec)
+            assert trace.counts()[0] > 0
+        finally:
+            trace.configure("off")
+
+
+def test_mode_parity_device_oom_ladder(tctx2, tiny_waves, tmp_path):
+    import numpy as np
+    from dpark_tpu import Columns
+    i = np.arange(4000, dtype=np.int64)
+    data = Columns(i % 37, i & 0xFF)
+
+    def run():
+        faults.configure("executor.dispatch:nth=1,kind=oom")
+        try:
+            return dict(tctx2.parallelize(data, 2)
+                        .reduceByKey(lambda a, b: a + b, 2).collect())
+        finally:
+            faults.configure(None)
+
+    expected = run()
+    trace.configure("spool", str(tmp_path / "dev"))
+    try:
+        assert run() == expected
+        names = {r["name"] for r in trace.collected()}
+        assert "wave" in names and "stage.exec" in names, names
+    finally:
+        trace.configure("off")
+
+
+# ---------------------------------------------------------------------------
+# spool robustness
+# ---------------------------------------------------------------------------
+
+def test_spool_corruption_and_torn_lines_skip(tmp_path):
+    d = str(tmp_path / "sp")
+    trace.configure("spool", d)
+    for i in range(8):
+        trace.emit("e%d" % i, "t", float(i), 1.0)
+    trace.configure("off")
+    (path,) = [os.path.join(d, f) for f in os.listdir(d)]
+    raw = bytearray(open(path, "rb").read())
+    lines = raw.split(b"\n")
+    # flip a byte inside line 2's payload and tear the final line
+    lines[2] = bytes(lines[2][:-3]) + b"zzz"
+    torn = lines[:-1] + [lines[-2][: len(lines[-2]) // 2]]
+    with open(path, "wb") as f:
+        f.write(b"\n".join(torn))
+    recs = trace.read_spool(d)
+    names = {r["name"] for r in recs}
+    assert "e2" not in names            # corrupt line skipped
+    assert "e0" in names and "e5" in names
+    assert 5 <= len(recs) <= 7          # never raises, never garbage
+
+
+def test_spool_cap_drops_spans_keeps_counters(tmp_path, monkeypatch):
+    monkeypatch.setattr(conf, "TRACE_SPOOL_MAX_BYTES", 600)
+    d = str(tmp_path / "cap")
+    trace.configure("spool", d)
+    for i in range(50):
+        trace.emit("e%d" % i, "t", float(i), 1.0)
+    assert trace.counts()[1] > 0        # spans dropped past the cap
+    trace.emit_process_counters()       # counter events always land
+    recs = trace.read_spool(d)
+    assert any(r["cat"] == "counters" for r in recs)
+    assert len(recs) < 50
+
+
+def test_merged_worker_counters_latest_per_pid(tmp_path):
+    """Counter events are CUMULATIVE per process: the merge takes the
+    newest per (host, pid) and sums across processes."""
+    d = str(tmp_path / "ct")
+    os.makedirs(d)
+
+    def write(pid, ts, fired, repair):
+        rec = {"name": "process.counters", "cat": "counters",
+               "ts": ts, "dur": 0.0, "pid": pid, "host": "w",
+               "tid": 1,
+               "args": {"faults": {"shuffle.fetch":
+                                   {"hits": fired + 2,
+                                    "fired": fired, "kind": "raise"}},
+                        "decodes": {"repair": repair,
+                                    "straggler_win": 0,
+                                    "decode_failures": 0},
+                        "decodes_per_shuffle":
+                            {"3": {"repair": repair}}}}
+        payload = json.dumps(rec, sort_keys=True,
+                             separators=(",", ":")).encode()
+        line = b"%08x %s\n" % (trace._crc(payload), payload)
+        with open(os.path.join(d, "counters-w-%d.jsonl" % pid),
+                  "ab") as f:
+            f.write(line)
+
+    write(100, 1.0, fired=1, repair=1)
+    write(100, 2.0, fired=3, repair=2)   # newer cumulative snapshot
+    write(200, 1.5, fired=2, repair=0)
+    got = trace.merged_worker_counters(d)
+    assert got["processes"] == 2
+    assert got["faults"]["shuffle.fetch"]["fired"] == 5      # 3 + 2
+    assert got["decodes"]["repair"] == 2                     # 2 + 0
+    assert got["decodes_per_shuffle"][3]["repair"] == 2
+
+
+def test_cross_run_spool_isolation(tmp_path):
+    """Job ids restart at 1 per scheduler, so a spool dir surviving
+    across runs (the default /tmp location) must not merge two runs'
+    "job 1" spans: every record carries a run id, collected() and the
+    counter merge restrict to the current run, and dtrace analyzes
+    per run."""
+    d = str(tmp_path / "runs")
+    trace.configure("spool", d)
+    run1 = trace.run_id()
+    trace.emit("job", "sched", 1.0, 5.0, job=1)
+    trace.emit_process_counters()
+    trace.configure("spool", d)          # same dir, NEW run
+    run2 = trace.run_id()
+    assert run2 != run1
+    trace.emit("job", "sched", 10.0, 2.0, job=1)
+    try:
+        recs = trace.collected()
+        assert len(recs) == 1 and recs[0]["run"] == run2
+        # the dead prior run's counters don't contribute phantoms
+        merged = trace.merged_worker_counters(d, include_self=True)
+        assert merged["processes"] == 0   # run1's event filtered out
+        assert trace.merged_worker_counters(
+            d, include_self=True, run=False)["processes"] == 1
+        # dtrace: one critical path PER RUN, never a merged DAG
+        all_recs = trace.read_spool(d)
+        runs = {r.get("run") for r in all_recs
+                if r.get("name") == "job"}
+        assert runs == {run1, run2}
+        cp1 = trace.critical_path(
+            [r for r in all_recs if r.get("run") == run1], 1)
+        cp2 = trace.critical_path(
+            [r for r in all_recs if r.get("run") == run2], 1)
+        assert cp1["wall_s"] == 5.0 and cp2["wall_s"] == 2.0
+    finally:
+        trace.configure("off")
+
+
+def test_metrics_running_jobs_gauge_not_counter(ctx):
+    """A still-running record must not fold into counter-typed series
+    (its state flips and its totals grow between scrapes — Prometheus
+    reads a decrease as a counter reset); it surfaces only in the
+    dpark_jobs_running gauge."""
+    from dpark_tpu.web import render_metrics
+    _reduce_job(ctx)
+    body = render_metrics(ctx.scheduler)
+    assert 'dpark_jobs_total{state="done"} 1' in body
+    assert "dpark_jobs_running 0" in body
+    ctx.scheduler.history.append(
+        {"id": 98, "state": "running", "retries": 7,
+         "stage_info": [{"id": 1, "kind": "array",
+                         "tasks": [{"ok": True}]}]})
+    try:
+        body = render_metrics(ctx.scheduler)
+    finally:
+        ctx.scheduler.history.pop()
+    assert "dpark_jobs_running 1" in body
+    assert 'state="running"' not in body
+    assert "dpark_retries_total 0" in body     # running job excluded
+
+
+@pytest.fixture()
+def fresh_forkserver():
+    """The forkserver is a process-wide singleton that inherits
+    os.environ when it FIRST starts — an earlier process-master test
+    pins a faults-free environment for every later pool.  Restart it
+    on both sides so this test's DPARK_FAULTS reaches the workers and
+    later tests get a clean environment again."""
+    from multiprocessing import forkserver
+
+    def stop():
+        try:
+            forkserver._forkserver._stop()
+        except Exception:
+            pass
+
+    stop()
+    yield
+    stop()
+
+
+def test_cross_process_spool_merge(fresh_forkserver, pctx, tmp_path,
+                                   monkeypatch):
+    # fixture order matters: fresh_forkserver FIRST so its teardown
+    # runs LAST — stopping the forkserver while pctx's pool is alive
+    # wedges pool.terminate()
+    """The multiprocess blindspot closes: worker task.run spans land
+    in the merged spool under their own pids, and worker-observed
+    fault counters surface in recovery_summary() — the driver's own
+    faults.stats() stays empty because only the workers (which
+    inherit DPARK_FAULTS through the forkserver environment) carry a
+    chaos plane."""
+    monkeypatch.setenv("DPARK_FAULTS", "shuffle.fetch:nth=1")
+    trace.configure("spool", str(tmp_path / "mp"))
+    try:
+        assert _reduce_job(pctx, n=400, parts=4, reduce_parts=3) \
+            == {k: 80 for k in range(5)}
+        recs = trace.collected()
+        me = os.getpid()
+        worker_pids = {r["pid"] for r in recs
+                       if r["name"] == "task.run" and r["pid"] != me}
+        assert worker_pids, "no worker-process spans in the spool"
+        # worker spans carry the job/stage parentage shipped with the
+        # task, so the merged timeline parents across processes
+        wspan = next(r for r in recs if r["name"] == "task.run"
+                     and r["pid"] != me)
+        assert wspan.get("stage") is not None
+        assert wspan.get("job") is not None
+        assert faults.stats() == {}          # driver saw nothing...
+        summary = pctx.scheduler.recovery_summary()
+        assert summary["worker_processes"] >= 1
+        assert summary["faults"]["shuffle.fetch"]["fired"] >= 1
+        # acceptance: the Chrome export of a multiprocess run carries
+        # worker spans under their own process rows
+        chrome = trace.to_chrome(recs)
+        assert json.dumps(chrome)
+        ev_pids = {e["pid"] for e in chrome["traceEvents"]
+                   if e.get("ph") == "X"}
+        assert len(ev_pids) >= 2, ev_pids    # driver + >=1 worker
+    finally:
+        trace.configure("off")
+
+
+# ---------------------------------------------------------------------------
+# critical path
+# ---------------------------------------------------------------------------
+
+def _rec(name, cat, ts, dur, **kw):
+    out = {"name": name, "cat": cat, "ts": ts, "dur": dur,
+           "pid": 1, "host": "h", "tid": 1}
+    args = kw.pop("args", None)
+    out.update(kw)
+    if args:
+        out["args"] = args
+    return out
+
+
+def test_critical_path_synthetic_dag():
+    # stage 1 (2s) and stage 2 (5s) both feed stage 3 (1s): the chain
+    # must route through stage 2; stage 2's phases say exchange-bound
+    recs = [
+        _rec("job", "sched", 0.0, 7.0, job=1),
+        _rec("stage", "sched", 0.0, 2.0, job=1, stage=1,
+             args={"parents": []}),
+        _rec("stage", "sched", 0.0, 5.0, job=1, stage=2,
+             args={"parents": []}),
+        _rec("stage", "sched", 5.0, 1.0, job=1, stage=3,
+             args={"parents": [1, 2]}),
+        _rec("phase.narrow", "phase", 0.0, 1.0, job=1, stage=2),
+        _rec("phase.exchange", "phase", 1.0, 3.5, job=1, stage=2),
+        _rec("fetch.bucket", "shuffle", 5.0, 0.5, job=1, stage=3),
+    ]
+    cp = trace.critical_path(recs, 1)
+    assert cp["chain"] == [2, 3]
+    assert cp["wall_s"] == 7.0
+    assert cp["phases_s"]["exchange"] == 3.5
+    assert cp["phases_s"]["fetch"] == 0.5
+    assert cp["bound"] == "exchange"
+    # unattributed stage time lands in `other`, totals cover the chain
+    assert abs(sum(cp["phases_s"].values())
+               - cp["chain_wall_s"]) < 1e-6
+
+
+def test_critical_path_none_without_job():
+    assert trace.critical_path([], 1) is None
+    assert trace.critical_path([]) is None
+
+
+def test_critical_path_reconciles_with_phase_table(tctx2, tiny_waves):
+    """Acceptance: the analyzer's streamed-phase totals match the
+    scheduler's phase_table() within 5% — both read the same
+    _StreamStats snapshot by construction."""
+    import numpy as np
+    from dpark_tpu import Columns
+    trace.configure("ring")
+    i = np.arange(6000, dtype=np.int64)
+    data = Columns(i % 53, i & 0xFF)
+    got = dict(tctx2.parallelize(data, 2)
+               .reduceByKey(lambda a, b: a + b, 2).collect())
+    assert len(got) == 53
+    cp = trace.critical_path(trace.snapshot())
+    pt = tctx2.scheduler.phase_table()
+    assert pt is not None, "streamed path did not run"
+    for phase, key in (("ingest_tokenize", "ingest_tokenize_ms"),
+                       ("narrow", "narrow_ms"),
+                       ("exchange", "exchange_ms"),
+                       ("spill", "spill_ms")):
+        a = cp["phases_s"].get(phase, 0.0) * 1e3
+        b = pt[key]
+        assert abs(a - b) <= 0.05 * max(a, b, 1e-3) + 0.5, \
+            (phase, a, b)
+
+
+# ---------------------------------------------------------------------------
+# chrome export + dtrace CLI
+# ---------------------------------------------------------------------------
+
+def _load_dtrace():
+    import importlib.machinery
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "dtrace")
+    loader = importlib.machinery.SourceFileLoader("_dtrace_cli", path)
+    spec = importlib.util.spec_from_loader("_dtrace_cli", loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+def test_chrome_export_shape(ctx, tmp_path):
+    trace.configure("ring")
+    _reduce_job(ctx)
+    chrome = trace.to_chrome(trace.snapshot())
+    evs = chrome["traceEvents"]
+    assert evs and json.dumps(chrome)
+    complete = [e for e in evs if e.get("ph") == "X"]
+    assert complete, "no complete spans in the export"
+    for e in complete:
+        assert {"name", "cat", "pid", "tid", "ts", "dur"} <= set(e)
+    assert any(e.get("ph") == "M" and e["name"] == "process_name"
+               for e in evs)
+    # counter events are merge substrate, not timeline rows
+    assert not any(e.get("cat") == "counters" for e in evs)
+
+
+def test_dtrace_self_check_and_export(ctx, tmp_path, capsys):
+    d = str(tmp_path / "cli")
+    trace.configure("spool", d)
+    _reduce_job(ctx)
+    trace.configure("off")
+    dtrace = _load_dtrace()
+    assert dtrace.main(["--self-check", "--dir", d]) == 0
+    out = str(tmp_path / "trace.json")
+    assert dtrace.main(["--out", out, "--dir", d]) == 0
+    chrome = json.load(open(out))
+    assert chrome["traceEvents"]
+    assert dtrace.main(["--critical-path", "--dir", d]) == 0
+    body = capsys.readouterr().out
+    assert '"chain"' in body
+    # an empty spool fails the self-check (the CI gate's contract)
+    assert dtrace.main(["--self-check", "--dir",
+                        str(tmp_path / "empty")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# /metrics + /api/trace
+# ---------------------------------------------------------------------------
+
+def test_metrics_scrape_and_api_trace(ctx, tmp_path):
+    from dpark_tpu.web import start_ui
+    trace.configure("ring")
+    _reduce_job(ctx)
+    server, url = start_ui(ctx.scheduler)
+    try:
+        with urllib.request.urlopen(url + "metrics") as r:
+            assert r.status == 200
+            ctype = r.headers.get("Content-Type", "")
+            assert ctype.startswith("text/plain; version=0.0.4")
+            body = r.read().decode()
+        assert 'dpark_jobs_total{state="done"} 1' in body
+        assert "dpark_stages_total" in body
+        assert 'dpark_tasks_total{ok="true"}' in body
+        assert "dpark_faults_injected_total" in body
+        assert "dpark_decodes_total" in body
+        assert "dpark_adapt_decisions_total" in body
+        assert 'dpark_trace_spans_total{mode="ring"}' in body
+        assert "dpark_phase_seconds" in body
+        job = ctx.scheduler.history[-1]["id"]
+        with urllib.request.urlopen(
+                url + "api/trace?job=%d" % job) as r:
+            payload = json.loads(r.read().decode())
+        assert payload["mode"] == "ring"
+        assert payload["job"] == job
+        assert any(s["name"] == "job" for s in payload["spans"])
+        assert all(s.get("job") == job for s in payload["spans"])
+    finally:
+        server.shutdown()
+
+
+def test_metrics_never_throws_mid_mutation(ctx):
+    """A job record mutating mid-scrape must yield valid text, not an
+    error (ISSUE 8 satellite): poison the history with a record shaped
+    like a half-written mutation and render."""
+    from dpark_tpu.web import render_metrics
+    _reduce_job(ctx)
+    ctx.scheduler.history.append(
+        {"id": 99, "state": None, "stage_info": [
+            {"id": 1, "kind": None, "tasks": None,
+             "pipeline": {"ingest_ms": "not-a-number"}},
+            "not-a-dict"]})
+    try:
+        body = render_metrics(ctx.scheduler)
+    finally:
+        ctx.scheduler.history.pop()
+    assert "dpark_jobs_total" in body
+
+
+def test_stage_rows_link_to_trace_api():
+    from dpark_tpu import web
+    assert "/api/trace?job=" in web._PAGE
+
+
+# ---------------------------------------------------------------------------
+# span parentage + phase spans ride the job record path
+# ---------------------------------------------------------------------------
+
+def test_task_spans_carry_job_and_stage(ctx):
+    trace.configure("ring")
+    _reduce_job(ctx)
+    recs = trace.snapshot()
+    tasks = [r for r in recs if r["name"] == "task"]
+    stages = [r for r in recs if r["name"] == "stage"]
+    (job,) = [r for r in recs if r["name"] == "job"]
+    assert tasks and stages
+    for t in tasks:
+        assert t["job"] == job["job"]
+        assert "stage" in t and "task" in t
+        assert t["args"]["status"] == "success"
+    # stage spans carry the parent edges the critical path walks
+    kinds = {s["stage"]: s["args"].get("parents") for s in stages}
+    assert any(kinds.values()), "no stage recorded its parents"
+
+
+def test_worker_span_inherits_ctx_inline(ctx):
+    """On inline masters the task.run span inherits job/stage from the
+    submit-time context (same mechanism workers use via the stamped
+    task attribute)."""
+    trace.configure("ring")
+    _reduce_job(ctx)
+    runs = [r for r in trace.snapshot() if r["name"] == "task.run"]
+    assert runs
+    assert all("stage" in r and "job" in r for r in runs)
+
+
+# ---------------------------------------------------------------------------
+# plan-lint rule
+# ---------------------------------------------------------------------------
+
+def test_trace_overhead_hint_rule(ctx, tmp_path, monkeypatch):
+    from dpark_tpu.analysis.plan_rules import lint_plan
+    wide = ctx.parallelize([(i % 5, 1) for i in range(64)], 16) \
+        .reduceByKey(lambda a, b: a + b, 2)
+    monkeypatch.setattr(conf, "TRACE_SPAN_WRITES_PER_TASK", 8)
+    # quiet with tracing off / ring — no spool writes to warn about
+    assert not [f for f in lint_plan(wide).findings
+                if f.rule == "trace-overhead-hint"]
+    trace.configure("ring")
+    assert not [f for f in lint_plan(wide).findings
+                if f.rule == "trace-overhead-hint"]
+    trace.configure("spool", str(tmp_path / "lint"))
+    hits = [f for f in lint_plan(wide).findings
+            if f.rule == "trace-overhead-hint"]
+    assert hits and "16 parent map buckets" in hits[0].message
+    # a reduce over few map buckets stays under the threshold
+    narrow = ctx.parallelize([(i % 5, 1) for i in range(64)], 4) \
+        .reduceByKey(lambda a, b: a + b, 2)
+    assert not [f for f in lint_plan(narrow).findings
+                if f.rule == "trace-overhead-hint"]

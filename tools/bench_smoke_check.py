@@ -161,6 +161,19 @@ def main():
         print("FAIL: ooc line carries no adapt section "
               "(mode/store_hits/decisions): %r" % (ad,))
         return 1
+    # ISSUE 8: the trace section must ride the ooc line — mode + span
+    # count always ({"mode": "off", "spans": 0} untraced); a traced
+    # run must additionally carry the critical-path summary
+    tr = ooc[0].get("trace")
+    if not isinstance(tr, dict) or "mode" not in tr \
+            or "spans" not in tr:
+        print("FAIL: ooc line carries no trace section "
+              "(mode/spans): %r" % (tr,))
+        return 1
+    if tr["mode"] != "off" and "critical_path" not in tr:
+        print("FAIL: traced ooc run carries no critical_path "
+              "summary: %r" % (tr,))
+        return 1
     aab = [p for p in parsed
            if str(p.get("metric", "")).startswith("adapt_warm_vs_cold")]
     if not aab:
