@@ -79,6 +79,22 @@ _decisions = []
 _decisions_base = 0           # absolute position of _decisions[0]
 _logged = set()               # (point, key, choice) de-dup for the log
 _pending = {}                 # stage key -> decision awaiting observed ms
+# per-thread job attribution (ISSUE 9): the resident job server's
+# slot threads set the job id they are executing for, so a decision
+# taken during a CONCURRENT stage lands in the right job's record
+_job_tls = threading.local()
+
+
+def set_current_job(job):
+    """Tag decisions taken on THIS thread with a job id (None clears).
+    Only the resident service sets this; single-job schedulers leave
+    decisions untagged, and decisions_since(pos) returns them all —
+    the pre-service behavior, bit for bit."""
+    _job_tls.job = job
+
+
+def _current_job():
+    return getattr(_job_tls, "job", None)
 
 
 # ---------------------------------------------------------------------------
@@ -311,17 +327,23 @@ def _decide(point, key, choice, reason, predicted_ms=None,
             applied=True):
     """Log one (de-duplicated) decision; returns the dict so callers
     can later attach the observed outcome."""
+    job = _current_job()
     with _lock:
-        dedup = (point, str(key), str(choice), bool(applied))
+        # the job id is part of the de-dup identity: two concurrent
+        # jobs taking the same choice must EACH log it (each record
+        # filters the log by its own id — ISSUE 9)
+        dedup = (point, str(key), str(choice), bool(applied), job)
         if dedup in _logged:
             for d in reversed(_decisions):
                 if (d["point"], str(d["key"]), str(d["choice"]),
-                        d["applied"]) == dedup:
+                        d["applied"], d.get("job")) == dedup:
                     return d
             # aged out of the log: fall through and re-log
         _logged.add(dedup)
         d = {"point": point, "key": str(key), "choice": choice,
              "reason": reason, "applied": bool(applied)}
+        if job is not None:
+            d["job"] = job
         if predicted_ms is not None:
             d["predicted_ms"] = round(float(predicted_ms), 2)
         from dpark_tpu import trace
@@ -353,16 +375,35 @@ def begin_job():
     record["adapt"] delta and the `steered` counter would otherwise
     silently undercount repeat steering).  Within one job the de-dup
     stands — a streamed stage consulting the store once per wave logs
-    one decision, not hundreds."""
+    one decision, not hundreds.
+
+    Only UNTAGGED entries clear: job-TAGGED de-dup tuples (resident
+    service, ISSUE 9) already scope per job via the id in the tuple,
+    and clearing them here would wipe a CONCURRENT job's epoch — its
+    streamed stage would then re-log the same decision every wave.
+    Tagged entries for long-gone jobs are pruned by rebuilding from
+    the capped decision log once the set outgrows it."""
     with _lock:
-        _logged.clear()
+        stale = {d for d in _logged if d[-1] is None}
+        _logged.difference_update(stale)
+        if len(_logged) > 4 * _LOG_CAP:
+            live = {(d["point"], str(d["key"]), str(d["choice"]),
+                     d["applied"], d.get("job")) for d in _decisions}
+            _logged.intersection_update(live)
         return _decisions_base + len(_decisions)
 
 
-def decisions_since(pos):
+def decisions_since(pos, job=None):
+    """Decisions logged at or after `pos`.  With `job` set (resident
+    service, ISSUE 9), only decisions tagged with that job id return —
+    untagged decisions (made outside any slot thread) stay visible to
+    every job, matching the single-job behavior."""
     with _lock:
         start = max(0, int(pos) - _decisions_base)
-        return [dict(d) for d in _decisions[start:]]
+        out = [dict(d) for d in _decisions[start:]]
+    if job is not None:
+        out = [d for d in out if d.get("job") in (None, job)]
+    return out
 
 
 def summary():

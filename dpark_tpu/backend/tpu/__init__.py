@@ -37,6 +37,12 @@ def _device_error(e):
 
 
 class TPUScheduler(DAGScheduler):
+    # plan analysis mutates module state (fuse.last_fallback_reason)
+    # and probes with shared tracers: with a resident job server's
+    # slot threads (ISSUE 9) analyzing concurrently, serialize it so
+    # the recorded fallback reason belongs to the stage it names
+    _analyze_lock = __import__("threading").Lock()
+
     def __init__(self, ndev=None):
         super().__init__()
         self._requested_ndev = ndev
@@ -56,8 +62,43 @@ class TPUScheduler(DAGScheduler):
             if self._requested_ndev:
                 devices = devices[:self._requested_ndev]
             self.executor = JAXExecutor(devices)
+            # HBM eviction spills re-point stage output locations
+            # (ISSUE 9 satellite): a later job reusing an available
+            # stage must see the disk uris, not stale hbm:// ones
+            self.executor._spill_notify = self._on_store_spilled
             logger.info("tpu master on %d %s device(s)",
                         len(devices), devices[0].platform)
+
+    def _on_store_spilled(self, sid, uri):
+        stage = self.shuffle_to_stage.get(sid)
+        if stage is None:
+            return
+        for i, loc in enumerate(stage.output_locs):
+            if loc and str(loc).startswith("hbm://"):
+                stage.output_locs[i] = uri
+
+    def _job_started(self, record):
+        """Pin this job's HBM buckets against disk spill and snapshot
+        the program-cache counters (the per-job cache-hit column;
+        under CONCURRENT jobs the delta is a process-wide view, noted
+        as such in the README)."""
+        ex = self.executor
+        if ex is not None:
+            ex.live_jobs.add(record["id"])
+            pc = ex.program_cache_stats()
+            record["_pc_base"] = (pc["hits"], pc["misses"])
+
+    def _job_finished(self, record):
+        ex = self.executor
+        if ex is None:
+            return
+        ex.live_jobs.discard(record["id"])
+        base = record.pop("_pc_base", None)
+        if base is not None:
+            pc = ex.program_cache_stats()
+            record["program_cache"] = {
+                "hits": pc["hits"] - base[0],
+                "misses": pc["misses"] - base[1]}
 
     def stop(self):
         super().stop()
@@ -75,19 +116,27 @@ class TPUScheduler(DAGScheduler):
 
         from dpark_tpu import adapt
         from dpark_tpu.backend.tpu import fuse
+        # stamp the job this thread is executing for (ISSUE 9): the
+        # executor tags shuffle stores with it so the HBM eviction
+        # arbiter knows which buckets belong to live jobs
+        record = self._current_record
+        self.executor._job_tls.job = \
+            record["id"] if record is not None else None
         plan = None
         adapt_sig = None
         if len(tasks) >= stage.num_partitions:
             # single-task retries skip the array path: run_stage always
             # processes all partitions, so replaying it for one failed
             # task would redo the whole stage
-            try:
-                plan = fuse.analyze_stage(stage, self.executor.ndev,
-                                          self.executor)
-            except Exception as e:
-                logger.debug("analysis failed for %s: %s", stage, e)
+            with self._analyze_lock:
+                try:
+                    plan = fuse.analyze_stage(stage, self.executor.ndev,
+                                              self.executor)
+                except Exception as e:
+                    logger.debug("analysis failed for %s: %s", stage, e)
+                reason = None if plan is not None \
+                    else fuse.last_fallback_reason()
             if plan is None:
-                reason = fuse.last_fallback_reason()
                 if reason:
                     # why the plan left the array path (key shape,
                     # non-numeric leaf, ...): rides the per-stage job
@@ -114,7 +163,14 @@ class TPUScheduler(DAGScheduler):
                                     adapt_reason=choice["reason"])
                     plan = None
         if plan is not None:
-            if self._run_degradable(stage, tasks, plan, report):
+            # the mesh lock spans the WHOLE degradable run, not just
+            # run_stage: the OOM ladder swaps conf.STREAM_CHUNK_ROWS
+            # around its retry, which must stay invisible to another
+            # job's concurrently dispatched device stage (ISSUE 9)
+            with self.executor._mesh_lock:
+                handled = self._run_degradable(stage, tasks, plan,
+                                               report)
+            if handled:
                 return
         # object path: run tasks inline on the driver (golden semantics);
         # cogroup stages first pre-materialize their CoGroupedRDD via the

@@ -13,7 +13,9 @@ through the ordinary ShuffleFetcher protocol.
 """
 
 import os
+import threading
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -363,6 +365,78 @@ class _RunPremerger:
             self._thread.join(timeout=10)
 
 
+class _StoreInFlight(Exception):
+    """An HBM shuffle store whose producing stage has not registered
+    its outputs yet — the eviction scan must skip it, not drop it."""
+
+
+class _ProgramCache:
+    """Bounded LRU over compiled stage programs (ISSUE 9 satellite).
+
+    The executor compiles one jitted program per (kind, program_key,
+    size class, ...) — fine for a one-job process, unbounded for a
+    RESIDENT service compiling across every job it ever serves.
+    conf.PROGRAM_CACHE_MAX bounds the entry count (0 = unbounded, the
+    pre-service behavior); hit/miss/evict counters ride /metrics
+    (dpark_program_cache_*_total), the web UI's per-job cache column,
+    and the bench `service` section — the warm-submit A/B asserts a
+    re-submitted DAG compiles NOTHING from these counters.
+
+    Thread-safe: the service's slot threads compile concurrently
+    (device dispatch serializes on the mesh lock, but host-side
+    tracing does not)."""
+
+    def __init__(self, cap=None):
+        self._d = OrderedDict()
+        self.cap = conf.PROGRAM_CACHE_MAX if cap is None else cap
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._lock = threading.Lock()
+
+    # Speaks the plain-dict idiom every compile site already uses —
+    # `if key in cache: return cache[key]` / `cache[key] = jitted` —
+    # so bounding the cache changed no call site.  The membership
+    # probe is where hit/miss counts: each compile site probes exactly
+    # once per call, and a probe that misses is always followed by a
+    # compile.
+
+    def __contains__(self, key):
+        with self._lock:
+            if key in self._d:
+                # LRU-touch at probe time: the caller's next statement
+                # is `cache[key]`, and a concurrent insert at capacity
+                # must never evict the key between the two (the probe
+                # makes it MRU)
+                self._d.move_to_end(key)
+                self.hits += 1
+                return True
+            self.misses += 1
+            return False
+
+    def __getitem__(self, key):
+        with self._lock:
+            return self._d[key]     # probe already counted + touched
+
+    def __setitem__(self, key, fn):
+        with self._lock:
+            self._d[key] = fn
+            self._d.move_to_end(key)
+            if self.cap:
+                while len(self._d) > max(1, self.cap):
+                    self._d.popitem(last=False)
+                    self.evictions += 1
+
+    def __len__(self):
+        return len(self._d)
+
+    def stats(self):
+        with self._lock:
+            return {"entries": len(self._d), "cap": self.cap,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
     try:
         from jax import shard_map as _sm
@@ -437,7 +511,10 @@ class JAXExecutor:
         # between jobs reuses programs instead of recompiling adjacent
         # 1/16-octave classes
         self._slot_memo = {}
-        self._compiled = {}
+        # bounded LRU over compiled programs (ISSUE 9 satellite):
+        # conf.PROGRAM_CACHE_MAX entries, hit/miss/evict counters for
+        # /metrics and the warm-submit A/B
+        self._compiled = _ProgramCache()
         # buffer donation is gated off on multi-controller meshes:
         # donating a process-spanning global array switches XLA:CPU to
         # a multiprocess aliasing path it doesn't implement
@@ -468,15 +545,27 @@ class JAXExecutor:
         from dpark_tpu import shuffle as shuffle_mod
         shuffle_mod.HBM_EXPORTERS[id(self)] = self.export_bucket
         self._exporter_key = id(self)
-        # export-bridge device reads are SERIALIZED: slicing a sharded
-        # (ndev, ...) store leaf launches a program with a cross-device
-        # gather, and two such programs dispatched concurrently from
-        # parallel fetcher threads deadlock the XLA:CPU collective
-        # rendezvous (each run pins one device participant; observed as
-        # the classic multi-thread lookup/fetch wedge).  Disk-run
-        # exports stay lock-free — they touch no device.
-        import threading
-        self._export_lock = threading.Lock()
+        # ONE mesh lock serializes every device-program dispatch path:
+        # stage programs (run_stage), device joins/gathers, AND the
+        # export bridge's sharded-leaf reads.  Two collective programs
+        # dispatched concurrently deadlock the XLA:CPU rendezvous
+        # (each run pins one device participant; observed as the
+        # classic multi-thread lookup/fetch wedge — PR 3 addendum),
+        # and with a resident job server (ISSUE 9) CONCURRENT jobs'
+        # stages now genuinely race for the mesh.  Reentrant so the
+        # eviction spiller can export under a stage's lock.  Disk-run
+        # exports stay lock-free — they touch no device.  Lock order
+        # where both are held: _mesh_lock -> _shard_build_lock.
+        self._mesh_lock = threading.RLock()
+        self._export_lock = self._mesh_lock
+        # jobs currently RUNNING on the owning scheduler (ISSUE 9):
+        # their HBM shuffle stores are preferred-KEEP when the budget
+        # evicts; completed jobs' buckets spill to disk first
+        self.live_jobs = set()
+        self._job_tls = threading.local()   # job id of this thread's stage
+        # scheduler hook: called as (sid, uri) after an HBM store is
+        # spilled to disk so stage output locations follow the move
+        self._spill_notify = None
         # coded-shuffle shard serving (ISSUE 6): each hbm bucket is
         # lazily serialized + erasure-encoded ONCE, then individual
         # framed shards answer per-shard fetches.  Builds serialize
@@ -852,8 +941,12 @@ class JAXExecutor:
     def run_stage(self, plan):
         """Execute the whole stage for all partitions at once.
 
-        Returns ("result", list_of_row_lists) or ("shuffle", sid)."""
-        with trace.span("stage.exec", "exec", source=plan.source[0]):
+        Returns ("result", list_of_row_lists) or ("shuffle", sid).
+        Holds the mesh lock throughout: with a resident job server
+        (ISSUE 9) concurrent jobs' stages race for the device, and two
+        collective programs in flight wedge the XLA:CPU rendezvous."""
+        with self._mesh_lock, \
+                trace.span("stage.exec", "exec", source=plan.source[0]):
             return self._run_stage(plan)
 
     def _run_stage(self, plan):
@@ -1192,28 +1285,115 @@ class JAXExecutor:
 
     def _evict_hbm(self, keep_sid=None, keep_rdd=None):
         """One budget across BOTH HBM tiers (shuffle outputs + cached
-        results): evict the globally least-recently-used entry until under
-        conf.SHUFFLE_HBM_BUDGET.  Evicted shuffles recover via FetchFailed
-        lineage recomputation; evicted results recompute on next use."""
+        results): shed the least-recently-used entries until under
+        conf.SHUFFLE_HBM_BUDGET.
+
+        Shuffle stores SPILL TO DISK instead of dropping (ISSUE 9
+        satellite): each bucket round-trips through the host bridge
+        into the standard on-disk bucket files — crc-framed erasure
+        SHARD CONTAINERS when a shuffle code is active, so coded reads
+        still decode — and the map-output locations follow the move.
+        A later consumer pays a disk read, never a lineage recompute
+        (the pre-service behavior on eviction).  COMPLETED jobs' stores
+        spill first, least-recently-fetched order; a store the live
+        jobs still grow (keep_sid) stays pinned.  Cached results still
+        drop — they recompute on next use and have no disk format.
+        A spill that fails (disk full) falls back to dropping the
+        store, which is exactly the old lineage-recovery contract."""
         budget = conf.SHUFFLE_HBM_BUDGET
+        pinned = set()      # in-flight stores (outputs not registered)
         while self._store_bytes + self._result_bytes > budget:
             # spilled (host_runs) stores hold no HBM: evicting them
             # frees nothing and destroys on-disk runs
+            live = self.live_jobs
             cands = [(meta["seq"], "sid", sid)
                      for sid, meta in self.shuffle_store.items()
-                     if sid != keep_sid and "host_runs" not in meta]
-            cands += [(meta["seq"], "rdd", rid)
-                      for rid, meta in self.result_cache.items()
-                      if rid != keep_rdd]
+                     if sid != keep_sid and sid not in pinned
+                     and "host_runs" not in meta
+                     and meta.get("job") not in live]
+            if not cands:
+                # every store belongs to a RUNNING job: prefer
+                # dropping recomputable cached results before touching
+                # a live job's working set
+                cands = [(meta["seq"], "rdd", rid)
+                         for rid, meta in self.result_cache.items()
+                         if rid != keep_rdd]
+            if not cands:
+                # still over: spill live jobs' stores too (quota
+                # arbitration — the job with the most HBM pays first,
+                # least-recently-fetched bucket of that job)
+                by_job = {}
+                for sid, meta in self.shuffle_store.items():
+                    if sid == keep_sid or sid in pinned \
+                            or "host_runs" in meta:
+                        continue
+                    by_job.setdefault(meta.get("job"), []).append(
+                        (meta["seq"], sid, meta["nbytes"]))
+                if by_job:
+                    biggest = max(
+                        by_job.values(),
+                        key=lambda ss: sum(b for _, _, b in ss))
+                    seq, sid, _ = min(biggest)
+                    cands = [(seq, "sid", sid)]
             if not cands:
                 break
             _, kind, victim = min(cands)
             if kind == "sid":
-                logger.debug("evicting HBM shuffle %d", victim)
-                self.drop_shuffle(victim)
+                try:
+                    self._spill_shuffle_to_disk(victim)
+                except _StoreInFlight:
+                    # its producing stage hasn't reported outputs yet:
+                    # the buckets are in flight — pinned, try the next
+                    # candidate instead
+                    pinned.add(victim)
+                except Exception as e:
+                    logger.warning(
+                        "spill of HBM shuffle %d failed (%s); "
+                        "dropping it — consumers recover via lineage",
+                        victim, e)
+                    self.drop_shuffle(victim)
             else:
                 logger.debug("evicting HBM cached result %d", victim)
                 self.drop_result(victim)
+
+    def _spill_shuffle_to_disk(self, sid):
+        """Round-trip one HBM shuffle store into the standard on-disk
+        bucket layout (shard containers when coding is active) and
+        re-point its map-output locations at the files.  Runs under
+        the mesh lock (the export reads device slices)."""
+        from dpark_tpu.env import env
+        from dpark_tpu.shuffle import LocalFileShuffle
+        store = self.shuffle_store[sid]
+        with self._mesh_lock:
+            locs = env.map_output_tracker.get_outputs(sid)
+            if locs is None:
+                # the producing stage hasn't completed/registered yet:
+                # its buckets are in flight — treat as pinned
+                raise _StoreInFlight(sid)
+            n_reduce = int(store.get(
+                "n_reduce",
+                layout.host_read(store["counts"]).shape[-1]))
+            uri = None
+            for map_id, old in enumerate(locs):
+                if old is None or not str(old).startswith("hbm://"):
+                    continue        # lost or already host-resident
+                buckets = [self._export_bucket(sid, map_id, r)
+                           for r in range(n_reduce)]
+                uri = LocalFileShuffle.write_buckets(
+                    sid, map_id, buckets)
+            if uri is None:
+                uri = LocalFileShuffle.get_server_uri()
+            new_locs = [uri if (l and str(l).startswith("hbm://"))
+                        else l for l in locs]
+            env.map_output_tracker.register_outputs(sid, new_locs)
+            notify = self._spill_notify
+            if notify is not None:
+                # the owning scheduler re-points its Stage.output_locs
+                # so a later job reusing the stage sees disk locations
+                notify(sid, uri)
+            logger.info("spilled HBM shuffle %d (%d bytes) to disk "
+                        "buckets at %s", sid, store["nbytes"], uri)
+            self.drop_shuffle(sid)
 
     def _finish_stage(self, plan, outs):
         if plan.epilogue is None:
@@ -1481,6 +1661,12 @@ class JAXExecutor:
         store["key_cols"] = getattr(plan, "epi_nk", 1) or 1
         store["nbytes"] = sum(int(l.nbytes) for l in store["leaves"])
         store["seq"] = self._next_seq()
+        # eviction metadata (ISSUE 9 satellite): the reduce width the
+        # disk spiller writes bucket files for, and the owning job —
+        # completed jobs' stores spill FIRST when a new exchange would
+        # blow conf.SHUFFLE_HBM_BUDGET
+        store["n_reduce"] = dep.partitioner.num_partitions
+        store["job"] = getattr(self._job_tls, "job", None)
         self.shuffle_store[sid] = store
         self._store_bytes += store["nbytes"]
         self._evict_hbm(keep_sid=sid)
@@ -2637,11 +2823,12 @@ class JAXExecutor:
     def gather_rows(self, dep):
         """Device exchange + key sort for one no-combine shuffle dep;
         returns per-partition sorted row lists (host)."""
-        store = self.shuffle_store[dep.shuffle_id]
-        counts, leaves = self._exchange_sorted(dep, store)
-        batch = layout.Batch(store["out_treedef"], leaves, counts)
-        return [self._maybe_decode(store, rows)
-                for rows in layout.egest(batch)]
+        with self._mesh_lock:
+            store = self.shuffle_store[dep.shuffle_id]
+            counts, leaves = self._exchange_sorted(dep, store)
+            batch = layout.Batch(store["out_treedef"], leaves, counts)
+            return [self._maybe_decode(store, rows)
+                    for rows in layout.egest(batch)]
 
     # ------------------------------------------------------------------
     # device join: two exchanged+sorted sides expand to key-matched pairs
@@ -2680,6 +2867,10 @@ class JAXExecutor:
     def run_device_join(self, dep_a, dep_b):
         """Per-partition inner join of two HBM-resident no-combine
         shuffles; returns per-partition host rows (k, (va, vb))."""
+        with self._mesh_lock:
+            return self._run_device_join(dep_a, dep_b)
+
+    def _run_device_join(self, dep_a, dep_b):
         store_a = self.shuffle_store[dep_a.shuffle_id]
         batch = self.device_join_batch(dep_a, dep_b)
         rows_per_part = layout.egest(batch)
@@ -2837,7 +3028,13 @@ class JAXExecutor:
         # GIL-atomic; entries are only ever replaced whole)
         frames = self._shard_cache.get(key)
         if frames is None:
-            with self._shard_build_lock:
+            # lock ORDER on the build path: mesh before shard_build —
+            # _export_bucket's device read takes the mesh lock, and a
+            # stage registering a shuffle holds the mesh lock while
+            # drop_shuffle takes shard_build; acquiring shard_build
+            # first here would deadlock those two threads (ISSUE 9:
+            # concurrent jobs make this race real)
+            with self._mesh_lock, self._shard_build_lock:
                 frames = self._shard_cache.get(key)
                 if frames is None:
                     # KeyError (no such hbm shuffle) propagates so the
@@ -2866,10 +3063,18 @@ class JAXExecutor:
                              % (idx, len(frames)))
         return frames[idx]
 
+    def program_cache_stats(self):
+        """Hit/miss/evict counters of the bounded compiled-program
+        cache (ISSUE 9): /metrics, the web UI per-job cache column,
+        and the warm-submit bench read these."""
+        return self._compiled.stats()
+
     def _export_bucket(self, sid, map_id, reduce_id):
         store = self.shuffle_store.get(sid)
         if store is None:
             raise KeyError("no HBM shuffle %d" % sid)
+        store["seq"] = self._next_seq()     # least-recently-FETCHED
+        #   ordering for the disk spiller (ISSUE 9 satellite)
         if store.get("pre_reduced"):
             # device d holds reduce partition d fully combined: expose it
             # as map 0's bucket (other maps contribute nothing)

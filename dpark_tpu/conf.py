@@ -135,6 +135,57 @@ ADAPT_COMBINE_MAX_RATIO = float(os.environ.get(
 EMULATED_WAVE_OOM_ROWS = int(os.environ.get(
     "DPARK_EMULATED_WAVE_OOM_ROWS", "0") or 0)
 
+# ---------------------------------------------------------------------------
+# resident executor service (dpark_tpu/service.py — ISSUE 9)
+# ---------------------------------------------------------------------------
+
+# When set, every DparkContext in this process attaches to ONE shared
+# JobServer instead of owning a scheduler: the value is the master
+# spec the server runs ("local", "tpu", "tpu:2", ...).  The server
+# owns the mesh + JAXExecutor for the life of the process and
+# multiplexes all contexts' jobs onto it — compiled programs and the
+# HBM shuffle store amortize across jobs.  "" (the default) keeps the
+# one-context-one-scheduler behavior bit-identical (one `is None`
+# check per seam).  Remote clients do not use this knob: they ship
+# job FUNCTIONS to a served JobServer (see service.serve /
+# service.ServiceClient).
+DPARK_SERVICE = os.environ.get("DPARK_SERVICE", "")
+
+# concurrent stage-execution slots in the job server's fair
+# dispatcher.  Device stages additionally serialize on the executor's
+# mesh lock (two concurrently dispatched collective programs wedge
+# the XLA:CPU rendezvous), so extra slots buy overlap between one
+# job's device stage and another's host/object-path stage.
+SERVICE_SLOTS = int(os.environ.get("DPARK_SERVICE_SLOTS", "2") or 1)
+
+# admission control: at most this many jobs RUN concurrently; further
+# submissions queue (fairness weights still apply once admitted)...
+SERVICE_MAX_JOBS = int(os.environ.get("DPARK_SERVICE_MAX_JOBS",
+                                      "4") or 1)
+
+# ...and the admission queue itself is bounded: a submission that
+# would make more than this many jobs wait is REFUSED with an error
+# instead of growing an unbounded backlog (a resident service must
+# shed load, not buffer it forever).  0 (or an empty env var) means
+# UNBOUNDED — explicitly opting out of load shedding.
+SERVICE_QUEUE_MAX = int(os.environ.get("DPARK_SERVICE_QUEUE_MAX",
+                                       "16") or 0)
+
+# weighted round-robin fairness: this client's jobs get this many
+# stage-execution turns per cycle relative to weight-1 peers (read at
+# context attach; the per-job weight rides the submission)
+SERVICE_WEIGHT = int(os.environ.get("DPARK_SERVICE_WEIGHT", "1") or 1)
+
+# compiled-program cache bound (ISSUE 9 satellite): the executor's
+# per-process program cache holds at most this many entries (LRU
+# eviction; hit/miss/evict counters ride /metrics and the bench
+# `service` section).  A resident service compiles across many jobs
+# for the life of the mesh — unbounded growth was fine for one-job
+# processes, not for a server.  0 = unbounded (the pre-service
+# behavior).
+PROGRAM_CACHE_MAX = int(os.environ.get("DPARK_PROGRAM_CACHE_MAX",
+                                       "512") or 0)
+
 # dcn transient-connect retry: total attempts (1 = no retry) and the
 # base backoff seconds (exponential with full jitter: attempt k sleeps
 # uniform in [base*2^k/2, base*2^k]).  Application-level ServerError

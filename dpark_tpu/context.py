@@ -15,6 +15,7 @@ import argparse
 import atexit
 import os
 import sys
+import threading
 
 import importlib
 import itertools
@@ -78,7 +79,19 @@ class DparkContext:
         if self.options.profile:
             env.profile = True
         master, _, arg = self.master.partition(":")
-        if master == "local":
+        # resident executor service (ISSUE 9): with DPARK_SERVICE set
+        # (or master "service[:spec]"), this context attaches to the
+        # process-global JobServer instead of owning a scheduler — the
+        # mesh, compiled-program cache, and HBM shuffle store amortize
+        # across every context/job in the process.  Unset, the seam
+        # costs one string check.
+        from dpark_tpu import conf as _conf
+        svc = _conf.DPARK_SERVICE
+        if master == "service" or svc:
+            from dpark_tpu import service as service_mod
+            spec = arg if master == "service" and arg else (svc or None)
+            self.scheduler = service_mod.client_scheduler(spec)
+        elif master == "local":
             from dpark_tpu.schedule import LocalScheduler
             self.scheduler = LocalScheduler()
         elif master in ("process", "multiprocess"):
@@ -130,7 +143,11 @@ class DparkContext:
                 import sys
                 print(prof.summary(20), file=sys.stderr)
             self.scheduler.stop()
-        env.stop()
+        # a service-attached context shares env (workdir, fetcher,
+        # trackers) with every other tenant of the resident server —
+        # one tenant leaving must not tear the process down
+        if not getattr(self.scheduler, "is_service_client", False):
+            env.stop()
 
     def __enter__(self):
         self.start()
@@ -144,16 +161,22 @@ class DparkContext:
     # key by rdd id, and multiple contexts (e.g. streaming recovery)
     # share those singletons in one process
     _rdd_id_counter = [0]
+    # concurrent drivers on a resident job server (ISSUE 9) mint rdd
+    # ids from their own threads; the read-modify-write must be atomic
+    _rdd_id_lock = threading.Lock()
 
     def new_rdd_id(self):
-        DparkContext._rdd_id_counter[0] += 1
-        return DparkContext._rdd_id_counter[0]
+        with DparkContext._rdd_id_lock:
+            DparkContext._rdd_id_counter[0] += 1
+            return DparkContext._rdd_id_counter[0]
 
     @classmethod
     def advance_rdd_ids(cls, minimum):
         """Recovery: never re-mint ids at or below a restored high-water
         mark (checkpoint dirs are keyed rdd-<id> in a persistent dir)."""
-        cls._rdd_id_counter[0] = max(cls._rdd_id_counter[0], int(minimum))
+        with cls._rdd_id_lock:
+            cls._rdd_id_counter[0] = max(cls._rdd_id_counter[0],
+                                         int(minimum))
 
     @property
     def default_parallelism(self):

@@ -61,12 +61,16 @@ class CartesianDependency(NarrowDependency):
         return [pid % self.num_other]
 
 
-_next_shuffle_id = [0]
+# itertools.count: atomic under the GIL — concurrent drivers on a
+# resident job server (ISSUE 9) build graphs from their own threads,
+# and two shuffles sharing an id would cross their map outputs
+import itertools
+
+_next_shuffle_id = itertools.count(1)
 
 
 def new_shuffle_id():
-    _next_shuffle_id[0] += 1
-    return _next_shuffle_id[0]
+    return next(_next_shuffle_id)
 
 
 class ShuffleDependency(Dependency):

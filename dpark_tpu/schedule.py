@@ -42,11 +42,14 @@ PHASE_BUCKETS = (0.05, 0.25, 1.0, 5.0, 30.0, 120.0)
 
 
 class Stage:
-    _next_id = [0]
+    # itertools.count: atomic under the GIL — concurrent drivers on a
+    # resident job server (ISSUE 9) mint stage ids from their own
+    # threads, and a read-modify-write counter could hand two stages
+    # one id
+    _next_id = __import__("itertools").count(1)
 
     def __init__(self, rdd, shuffle_dep, parents):
-        Stage._next_id[0] += 1
-        self.id = Stage._next_id[0]
+        self.id = next(Stage._next_id)
         self.rdd = rdd
         self.shuffle_dep = shuffle_dep          # None for a result stage
         self.parents = parents
@@ -101,6 +104,21 @@ class DAGScheduler:
         # counters never decrease.
         self._metrics_lock = threading.RLock()
         self._metrics_archive = self._new_metrics()
+        # resident job server (ISSUE 9): when attached, stage
+        # execution routes through the server's fair dispatcher
+        # instead of running inline — one `is None` check per submit
+        # seam, so a service-less process pays nothing
+        self._service = None
+        # per-driver-thread state: with N drivers multiplexed onto one
+        # scheduler, the "current" job record is whichever job THIS
+        # thread is building/executing (the slot threads set it around
+        # each stage execution); _last_record keeps the single-thread
+        # fallback for embedders that read it from another thread
+        self._tls = threading.local()
+        self._last_record = None
+        # guards the shared stage graph (shuffle_to_stage) against
+        # concurrent run_job invocations from different driver threads
+        self._graph_lock = threading.RLock()
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -109,18 +127,41 @@ class DAGScheduler:
     def stop(self):
         self.started = False
 
+    # -- per-thread current record (ISSUE 9) -----------------------------
+    # note_stage() and the executor's _stage_note callback attribute
+    # to whichever job the CALLING thread is working on: driver
+    # threads set it when they mint a record, the job server's slot
+    # threads set it around each stage execution.  Single-threaded
+    # schedulers see the exact pre-service behavior through the
+    # _last_record fallback.
+    @property
+    def _current_record(self):
+        rec = getattr(self._tls, "record", None)
+        return rec if rec is not None else self._last_record
+
+    @_current_record.setter
+    def _current_record(self, rec):
+        self._tls.record = rec
+        self._last_record = rec
+
     # -- stage graph -----------------------------------------------------
     def new_stage(self, rdd, shuffle_dep):
-        return Stage(rdd, shuffle_dep, self.get_parent_stages(rdd))
+        with self._graph_lock:
+            return Stage(rdd, shuffle_dep, self.get_parent_stages(rdd))
 
     def get_shuffle_map_stage(self, dep):
-        stage = self.shuffle_to_stage.get(dep.shuffle_id)
-        if stage is None:
-            stage = self.new_stage(dep.rdd, dep)
-            self.shuffle_to_stage[dep.shuffle_id] = stage
-        return stage
+        with self._graph_lock:
+            stage = self.shuffle_to_stage.get(dep.shuffle_id)
+            if stage is None:
+                stage = self.new_stage(dep.rdd, dep)
+                self.shuffle_to_stage[dep.shuffle_id] = stage
+            return stage
 
     def get_parent_stages(self, rdd):
+        with self._graph_lock:
+            return self._get_parent_stages_locked(rdd)
+
+    def _get_parent_stages_locked(self, rdd):
         parents = []
         visited = set()
 
@@ -140,6 +181,27 @@ class DAGScheduler:
 
     def get_missing_parent_stages(self, stage):
         return [p for p in stage.parents if not p.is_available]
+
+    def _needed_shuffles(self, rdd, acc=None, visited=None,
+                         transitive=False):
+        """Shuffle ids reachable through NARROW deps — exactly what a
+        task over `rdd` fetches, the multiprocess master's per-task
+        map-output snapshot.  `transitive=True` additionally walks
+        PAST shuffle boundaries: the whole lineage's shuffle ids, for
+        per-job decode attribution under concurrent jobs (ISSUE 9) —
+        that set must not ride every task message."""
+        acc = acc if acc is not None else set()
+        visited = visited if visited is not None else set()
+        if rdd.id in visited:
+            return acc
+        visited.add(rdd.id)
+        for dep in rdd.dependencies:
+            if isinstance(dep, ShuffleDependency):
+                acc.add(dep.shuffle_id)
+                if not transitive:
+                    continue
+            self._needed_shuffles(dep.rdd, acc, visited, transitive)
+        return acc
 
     # -- the job loop ----------------------------------------------------
     def run_job(self, final_rdd, func, partitions=None, allow_local=False):
@@ -169,8 +231,10 @@ class DAGScheduler:
                 raise
             finally:
                 record["seconds"] = round(_time.time() - t0, 3)
+                record.pop("_t_submit", None)
                 self._finalize_decodes(record)
                 self._trace_job_span(record, t0)
+                self._job_finished(record)
             return
 
         output_parts = list(partitions)
@@ -248,7 +312,7 @@ class DAGScheduler:
                 for t in tasks:
                     t._trace_job = record["id"]
             with trace.ctx(job=record["id"], stage=stage.id):
-                self.submit_tasks(stage, tasks, report)
+                self._dispatch(stage, tasks, report, record)
 
         def spawn_duplicate(stage, p):
             """Speculative copy of a straggling task (first result wins)."""
@@ -263,7 +327,7 @@ class DAGScheduler:
             if trace._PLANE is not None:
                 t._trace_job = record["id"]
             with trace.ctx(job=record["id"], stage=stage.id):
-                self.submit_tasks(stage, [t], report)
+                self._dispatch(stage, [t], report, record)
 
         submit_stage(final_stage)
         record["stages"] = len(stage_of)
@@ -282,13 +346,18 @@ class DAGScheduler:
             if record["state"] == "running":
                 record["state"] = "done" if all(finished) else "aborted"
             record["seconds"] = round(_time.time() - job_t0, 3)
+            record.pop("_t_submit", None)
             self._finalize_decodes(record)
             self._finalize_adapt(record)
             self._trace_job_span(record, job_t0)
+            self._job_finished(record)
 
     def _new_job_record(self, final_rdd, parts, stages=1):
-        self._next_job_id += 1
-        record = {"id": self._next_job_id, "scope": final_rdd.scope_name,
+        import time as _time
+        with self._metrics_lock:
+            self._next_job_id += 1
+            job_id = self._next_job_id
+        record = {"id": job_id, "scope": final_rdd.scope_name,
                   "parts": parts, "finished": 0, "stages": stages,
                   "seconds": 0.0, "state": "running", "stage_info": [],
                   # pre-flight lint findings (context.runJob stashes
@@ -323,6 +392,25 @@ class DAGScheduler:
                     trace.merged_worker_counters()
             except Exception:
                 pass
+        # resident-service bookkeeping (ISSUE 9): tag the record with
+        # the submitting client, stamp submit time (queue-wait and
+        # first-wave latency measure from it), and pre-walk the
+        # lineage's shuffle ids so decode attribution under CONCURRENT
+        # jobs restricts to this job's own shuffles instead of the
+        # overlapping process-global totals delta
+        if self._service is not None:
+            record["service"] = True
+            record["_t_submit"] = _time.time()
+            client = getattr(self._tls, "client", None)
+            if client:
+                record["client"] = client
+            try:
+                # a sorted list, not a set: /api/jobs may serialize
+                # the record as JSON while the job is still running
+                record["_sids"] = sorted(self._needed_shuffles(
+                    final_rdd, transitive=True))
+            except Exception:
+                pass
         with self._metrics_lock:
             self.history.append(record)
             dropped = self.history[:-100]
@@ -330,7 +418,16 @@ class DAGScheduler:
                 self._archive_metrics(dropped)
             del self.history[:-100]
         self._current_record = record
+        self._job_started(record)
         return record
+
+    def _job_started(self, record):
+        """Hook: a job record was minted (the tpu master pins the
+        job's HBM buckets and snapshots program-cache counters)."""
+
+    def _job_finished(self, record):
+        """Hook: the job finalized (counters attributed, pins
+        released)."""
 
     def _trace_job_span(self, record, t0):
         """Emit the job's span (trace plane, ISSUE 8) — the root of
@@ -353,19 +450,34 @@ class DAGScheduler:
         column."""
         from dpark_tpu import coding
         base = record.pop("_decode_base", None)
+        sids = record.pop("_sids", None)
         if base is None:
             return
         snap = coding.counters_snapshot()
-        base_totals = base.get("totals", {})
-        totals = {k: v - base_totals.get(k, 0)
-                  for k, v in snap["totals"].items()}
-        if any(totals.values()) or coding.active():
-            record["decodes"] = dict(totals, mode=coding.describe())
         base_per = base.get("per_shuffle", {})
+        per_deltas = {}
         for sid, counts in snap.get("per_shuffle", {}).items():
             prev = base_per.get(sid, {})
             delta = {k: v - prev.get(k, 0) for k, v in counts.items()}
-            if not any(delta.values()):
+            if any(delta.values()):
+                per_deltas[sid] = delta
+        if sids is not None:
+            # concurrent jobs on a resident service (ISSUE 9): the
+            # process-global totals delta overlaps with every other
+            # in-flight job — attribute only the per-shuffle deltas of
+            # THIS job's own lineage, so records never cross-contaminate
+            totals = {k: 0 for k in snap["totals"]}
+            for sid in sids:
+                for k, v in per_deltas.get(sid, {}).items():
+                    totals[k] = totals.get(k, 0) + v
+        else:
+            base_totals = base.get("totals", {})
+            totals = {k: v - base_totals.get(k, 0)
+                      for k, v in snap["totals"].items()}
+        if any(totals.values()) or coding.active():
+            record["decodes"] = dict(totals, mode=coding.describe())
+        for sid, delta in per_deltas.items():
+            if sids is not None and sid not in sids:
                 continue
             parent = self.shuffle_to_stage.get(sid)
             if parent is not None:
@@ -373,14 +485,15 @@ class DAGScheduler:
                 d = info.setdefault("decodes", {})
                 for k, v in delta.items():
                     d[k] = d.get(k, 0) + v
-        self._merge_worker_decodes(record)
+        self._merge_worker_decodes(record, sids)
 
-    def _merge_worker_decodes(self, record):
+    def _merge_worker_decodes(self, record, sids=None):
         """Fold WORKER-PROCESS decode deltas (spooled counter events,
         ISSUE 8 satellite) into this job's record: the multiprocess
         master's workers decode in their own processes, and before the
         trace spool their counters never reached the driver (the
-        documented per-process caveat of PRs 6-7)."""
+        documented per-process caveat of PRs 6-7).  `sids` (service
+        mode) restricts attribution to this job's own shuffles."""
         from dpark_tpu import coding
         wbase = record.pop("_trace_decode_base", None)
         if wbase is None:
@@ -389,21 +502,31 @@ class DAGScheduler:
             snap = trace.merged_worker_counters()
         except Exception:
             return
-        base_tot = wbase.get("decodes", {})
-        totals = {k: v - base_tot.get(k, 0)
-                  for k, v in snap.get("decodes", {}).items()}
+        base_per = wbase.get("decodes_per_shuffle", {})
+        per_deltas = {}
+        for sid, counts in snap.get("decodes_per_shuffle",
+                                    {}).items():
+            prev = base_per.get(sid, {})
+            delta = {k: v - prev.get(k, 0) for k, v in counts.items()}
+            if any(delta.values()):
+                per_deltas[sid] = delta
+        if sids is not None:
+            totals = {}
+            for sid in sids:
+                for k, v in per_deltas.get(sid, {}).items():
+                    totals[k] = totals.get(k, 0) + v
+        else:
+            base_tot = wbase.get("decodes", {})
+            totals = {k: v - base_tot.get(k, 0)
+                      for k, v in snap.get("decodes", {}).items()}
         if any(totals.values()):
             d = record.setdefault("decodes",
                                   {"mode": coding.describe()})
             for k, v in totals.items():
                 d[k] = d.get(k, 0) + v
             d["worker_processes"] = snap.get("processes", 0)
-        base_per = wbase.get("decodes_per_shuffle", {})
-        for sid, counts in snap.get("decodes_per_shuffle",
-                                    {}).items():
-            prev = base_per.get(sid, {})
-            delta = {k: v - prev.get(k, 0) for k, v in counts.items()}
-            if not any(delta.values()):
+        for sid, delta in per_deltas.items():
+            if sids is not None and sid not in sids:
                 continue
             parent = self.shuffle_to_stage.get(sid)
             if parent is not None:
@@ -427,7 +550,11 @@ class DAGScheduler:
             from dpark_tpu import adapt
             if not adapt.enabled():
                 return
-            decisions = adapt.decisions_since(base)
+            # concurrent jobs (ISSUE 9): the log interleaves decisions
+            # from every in-flight job — restrict to the ones tagged
+            # with THIS job's id (the service's slot threads tag them)
+            job = record["id"] if record.get("service") else None
+            decisions = adapt.decisions_since(base, job=job)
             record["adapt"] = {"mode": adapt.mode(),
                                "decisions": decisions}
         except Exception:
@@ -620,6 +747,19 @@ class DAGScheduler:
                 getattr(ex, "export_seconds", 0.0)) if ex else 0.0
         except Exception:
             out["export_seconds"] = 0.0
+        # resident-service observability (ISSUE 9): compiled-program
+        # cache counters and the admission-queue gauge ride /metrics
+        try:
+            out["program_cache"] = ex.program_cache_stats() \
+                if ex is not None else None
+        except Exception:
+            out["program_cache"] = None
+        svc = getattr(self, "_service", None)
+        if svc is not None:
+            try:
+                out["service"] = svc.service_stats()
+            except Exception:
+                pass
         return out
 
     def phase_table(self):
@@ -745,6 +885,13 @@ class DAGScheduler:
                         speculated, spawn_duplicate)
                 continue        # a long task is legitimately running
             in_flight[0] -= 1
+            if "_t_submit" in record and "first_wave_ms" not in record:
+                # resident-service latency metric (ISSUE 9): submit ->
+                # first completed wave of work (includes queue wait and
+                # any trace+compile the first stage paid — the number
+                # the warm-submit A/B drives down)
+                record["first_wave_ms"] = round(
+                    (_time.time() - record["_t_submit"]) * 1e3, 1)
             stage = stage_of.get(task.stage_id)
             tkey = (task.stage_id, task.partition)
             started = submitted_at.pop(tkey, None)
@@ -915,7 +1062,7 @@ class DAGScheduler:
                         retry._trace_job = record["id"]
                     with trace.ctx(job=record["id"],
                                    stage=task.stage_id):
-                        self.submit_tasks(stage, [retry], report)
+                        self._dispatch(stage, [retry], report, record)
             else:       # failure
                 # credit the EXECUTOR that ran the task (fleet
                 # placement): blacklist ranking must see failures
@@ -951,9 +1098,20 @@ class DAGScheduler:
                     retry._trace_job = record["id"]
                 with trace.ctx(job=record["id"],
                                stage=task.stage_id):
-                    self.submit_tasks(stage, [retry], report)
+                    self._dispatch(stage, [retry], report, record)
 
     # -- master-specific -------------------------------------------------
+    def _dispatch(self, stage, tasks, report, record):
+        """Run tasks now — or, with a resident job server attached
+        (ISSUE 9), enqueue them into its fair dispatcher so stages
+        from concurrent jobs interleave on the shared mesh.  One
+        `is None` check when no service is attached."""
+        svc = self._service
+        if svc is None:
+            self.submit_tasks(stage, tasks, report)
+        else:
+            svc.enqueue(self, record, stage, tasks, report)
+
     def submit_tasks(self, stage, tasks, report):
         """Run tasks and call report(task, status, payload) for each."""
         raise NotImplementedError
@@ -1200,19 +1358,6 @@ class MultiProcessScheduler(DAGScheduler):
             self.pool.terminate()
             self.pool.join()
             self.pool = None
-
-    def _needed_shuffles(self, rdd, acc=None, visited=None):
-        acc = acc if acc is not None else set()
-        visited = visited if visited is not None else set()
-        if rdd.id in visited:
-            return acc
-        visited.add(rdd.id)
-        for dep in rdd.dependencies:
-            if isinstance(dep, ShuffleDependency):
-                acc.add(dep.shuffle_id)
-            else:
-                self._needed_shuffles(dep.rdd, acc, visited)
-        return acc
 
     def submit_tasks(self, stage, tasks, report):
         if self.pool is None:

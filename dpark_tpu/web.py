@@ -57,6 +57,20 @@ def render_metrics(scheduler):
            or [({"state": "none"}, 0)])
     metric("dpark_jobs_running", "gauge", "jobs currently in flight",
            [({}, snap.get("jobs_running", 0))])
+    # resident service (ISSUE 9): jobs waiting on admission, and the
+    # bounded compiled-program cache's counters — the warm-submit
+    # acceptance ("0 re-compiles") is asserted from these
+    svc = snap.get("service") or {}
+    metric("dpark_jobs_queued", "gauge",
+           "jobs waiting for service admission",
+           [({}, svc.get("jobs_queued", 0))])
+    pc = snap.get("program_cache") or {}
+    for key, help_text in (
+            ("hits", "compiled-program cache hits"),
+            ("misses", "compiled-program cache misses (compiles)"),
+            ("evictions", "compiled-program cache LRU evictions")):
+        metric("dpark_program_cache_%s_total" % key, "counter",
+               help_text, [({}, pc.get(key, 0))])
     metric("dpark_stages_total", "counter", "stages by execution kind",
            [({"kind": k}, n) for k, n in sorted(snap["stages"].items())]
            or [({"kind": "none"}, 0)])
@@ -158,6 +172,7 @@ _PAGE = """<!doctype html>
 <h2>dpark_tpu jobs</h2>
 <table id="t"><tr><th>job</th><th>scope</th><th>parts</th>
 <th>finished</th><th>stages</th><th>seconds</th><th>state</th>
+<th>client</th><th>queue ms</th><th>cache (hit/miss)</th>
 <th>recovery (resubmit/recompute/retry)</th>
 <th>decodes (repair/straggler/fail)</th>
 <th>adapt (steered/logged)</th></tr></table>
@@ -225,8 +240,16 @@ async function tick() {
     const adp = aj.mode
       ? ads.filter(d => d.applied).length + '/' + ads.length +
         ' [' + aj.mode + ']' : '';
+    // resident service (ISSUE 9): submitting tenant, admission/queue
+    // wait, and the job's compiled-program cache delta (a warm
+    // re-submission shows hits/0 — zero compiles)
+    const pc = j.program_cache || {};
+    const cache = pc.hits !== undefined
+      ? pc.hits + '/' + pc.misses : '';
+    const qw = j.queue_wait_ms !== undefined ? j.queue_wait_ms : '';
     for (const v of [j.id, j.scope, j.parts, j.finished, j.stages,
-                     j.seconds, j.state, rec, dec, adp])
+                     j.seconds, j.state, j.client || '', qw, cache,
+                     rec, dec, adp])
       row.insertCell().textContent = v;
     row.className = j.state === 'done' ? 'done' : 'run';
     const d = document.createElement('div');

@@ -203,6 +203,53 @@ def main():
                   "(expected 0 ladder retries, >=1 store hit): %r"
                   % aab[0])
             return 1
+    # ISSUE 9: the resident-service A/B line must be present — the
+    # warm re-submission must show ZERO compiles with cache hits (the
+    # amortized-compile acceptance), the concurrent section must be
+    # bit-identical (parity), and per-job queue-wait must ride the
+    # `jobs` list.  Latency/wall ratios are not graded here (CI boxes
+    # are too noisy; BENCH_*.json records the honest numbers).
+    sv = [p for p in parsed
+          if str(p.get("metric", "")).startswith("service_warm_submit")]
+    if not sv:
+        print("FAIL: no service_warm_submit line")
+        return 1
+    for side in ("cold", "warm"):
+        d = sv[0].get(side)
+        if not isinstance(d, dict) or "compiles" not in d \
+                or "first_wave_ms" not in d or "cache_hits" not in d:
+            print("FAIL: service %s side missing compiles/"
+                  "first_wave_ms/cache_hits: %r" % (side, d))
+            return 1
+    if sv[0]["warm"]["compiles"] != 0:
+        print("FAIL: warm service submission re-compiled %d programs "
+              "(expected 0): %r" % (sv[0]["warm"]["compiles"], sv[0]))
+        return 1
+    if not sv[0]["warm"]["cache_hits"]:
+        print("FAIL: warm service submission hit the program cache 0 "
+              "times: %r" % sv[0])
+        return 1
+    if not sv[0]["cold"]["compiles"]:
+        print("FAIL: cold service submission compiled nothing — the "
+              "A/B measured a pre-warmed server: %r" % sv[0])
+        return 1
+    conc = sv[0].get("concurrent")
+    if not isinstance(conc, dict) or not conc.get("parity"):
+        print("FAIL: concurrent service jobs broke parity: %r"
+              % (conc,))
+        return 1
+    svc = sv[0].get("service")
+    if not isinstance(svc, dict) \
+            or not isinstance(svc.get("program_cache"), dict):
+        print("FAIL: service section missing program_cache: %r"
+              % (svc,))
+        return 1
+    jobs = sv[0].get("jobs")
+    if not isinstance(jobs, list) or not jobs \
+            or any("queue_wait_ms" not in j for j in jobs):
+        print("FAIL: service jobs list missing queue_wait_ms: %r"
+              % (jobs,))
+        return 1
     # ISSUE 4 satellite: the segmented-apply A/B line must be present
     # with its schema (the ratio itself is not graded here — CI boxes
     # are too noisy — but the device side must have ridden the array
@@ -226,13 +273,17 @@ def main():
     print("OK: %d JSON lines, ooc pipeline+phases fields present "
           "(waves=%d idle=%.3f depth=%d donated=%s narrow=%.0fms "
           "fallbacks=%d groupmap=%.1fx coded=%.2fx adapt cold/warm "
-          "ladder=%d/%d hits=%d/%d)"
+          "ladder=%d/%d hits=%d/%d service warm=%.1fx compiles=%d/%d "
+          "conc=%.2fx)"
           % (len(parsed), pipe["waves"], pipe["device_idle_frac"],
              pipe["pipeline_depth"], pipe["donated"],
              phases["narrow_ms"], len(ooc[0]["fallback_reasons"]),
              gm[0]["value"], coded[0]["value"],
              cold["ladder_retries"], warm["ladder_retries"],
-             cold["store_hits"], warm["store_hits"]))
+             cold["store_hits"], warm["store_hits"],
+             sv[0]["value"], sv[0]["cold"]["compiles"],
+             sv[0]["warm"]["compiles"],
+             conc.get("ratio_vs_slower_solo", 0.0)))
     return 0
 
 
