@@ -574,12 +574,18 @@ def _stream_phase():
     import jax
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
-    from dpark_tpu import DparkContext
+    from dpark_tpu import DparkContext, panes
     ctx = DparkContext("tpu")
     _stream_run(ctx)                              # warm-up compile
     dt = _stream_run(ctx)
+    # pane-plane accounting (ISSUE 10): the window above rides the
+    # pane path — report the last driven stream's live stats so the
+    # bench artifact records pane mode/counts next to the throughput
+    stats = panes.stream_stats()
+    pane_info = list(stats.values())[-1] if stats else {}
     ctx.stop()
-    print("STREAM_RESULT %s" % json.dumps({"t": dt}), flush=True)
+    print("STREAM_RESULT %s" % json.dumps(
+        {"t": dt, "panes": pane_info}), flush=True)
 
 
 def _coded_phase():
@@ -1180,7 +1186,8 @@ def main():
                 "unit": "Mrecords/s",
                 "vs_baseline": round(t_stream_proc / s["t"], 2),
                 "recs_per_batch": STREAM_RECS,
-                "batches": STREAM_BATCHES}
+                "batches": STREAM_BATCHES,
+                "panes": s.get("panes", {})}
         if emulated:
             sout["emulated_cpu_mesh"] = True
         print(json.dumps(sout))

@@ -72,7 +72,8 @@ _lock = threading.RLock()
 _mode = None                  # resolved mode, or None = read conf lazily
 _dir = None                   # resolved store dir, or None = read conf
 _loaded = False
-_agg = {"wave_budget": {}, "stage": {}, "skew": {}, "combine": {}}
+_agg = {"wave_budget": {}, "stage": {}, "skew": {}, "combine": {},
+        "pane": {}}
 _counters = {"store_hits": 0, "store_misses": 0, "steered": 0,
              "recorded": 0, "skipped_lines": 0}
 _decisions = []
@@ -245,6 +246,12 @@ def _compact_locked(path):
             recs.append({"k": "combine", "key": key,
                          "rows_in": 1000000,
                          "rows_out": int(ent["ratio"] * 1000000)})
+    for key, ent in _agg["pane"].items():
+        for mode in ("tree", "flat", "inv"):
+            if ent.get(mode + "_ms") is not None:
+                recs.append({"k": "pane", "key": key, "mode": mode,
+                             "ms": round(ent[mode + "_ms"], 2),
+                             "w": int(ent.get("w", 0))})
     try:
         from dpark_tpu.utils import frame_jsonl
         tmp = path + ".compact.%d" % os.getpid()
@@ -317,6 +324,20 @@ def _apply(rec):
         ent["ratio"] = ratio if cur is None \
             else cur * (1 - _EMA) + ratio * _EMA
         ent["n"] += 1
+    elif kind == "pane":
+        # per-(stream signature) windowed-emit tick cost by pane
+        # strategy ("tree" | "flat" | "inv"): the split-point pricing
+        # substrate (ISSUE 10)
+        mode = rec.get("mode")
+        if mode not in ("tree", "flat", "inv", "pane"):
+            return
+        ent = _agg["pane"].setdefault(key, {"w": 0})
+        slot = mode + "_ms"
+        ms = float(rec.get("ms", 0.0))
+        cur = ent.get(slot)
+        ent[slot] = ms if cur is None \
+            else cur * (1 - _EMA) + ms * _EMA
+        ent["w"] = int(rec.get("w", ent.get("w", 0)))
 
 
 # ---------------------------------------------------------------------------
@@ -726,3 +747,70 @@ def map_side_combine(site, kind):
     except Exception as e:
         logger.debug("map_side_combine failed: %s", e)
         return True
+
+
+# ---------------------------------------------------------------------------
+# decision point 5: pane-tree split points from observed pane costs
+# ---------------------------------------------------------------------------
+
+def record_pane_cost(site, mode, ms, panes):
+    """Persist one observed per-tick windowed-emit wall (ms) for a
+    pane stream signature under a pane strategy ("tree" = dyadic merge
+    tree, "flat" = union all panes, "inv" = invertible O(1) update).
+    Streams sample this ONCE per stream (median of post-warmup ticks),
+    so the store sees one line per (stream shape, mode) per run."""
+    try:
+        if not enabled() or not site:
+            return
+        _append({"k": "pane", "key": str(site), "mode": str(mode),
+                 "ms": round(float(ms), 2), "w": int(panes)})
+    except Exception as e:
+        logger.debug("record_pane_cost failed: %s", e)
+
+
+def steer_pane_mode(site, panes, static_tree):
+    """Split-point choice for a non-invertible pane window ("Partial
+    Partial Aggregates": pick the decomposition by COST, not by
+    shape): `static_tree` is the conf.STREAM_PANE_TREE_MIN default;
+    with DPARK_ADAPT=on and BOTH strategies' per-tick costs on record
+    for this stream signature, the observed-cheaper one wins (logged
+    as a `pane_split` decision).  Observe mode logs the would-be
+    choice and keeps the static default."""
+    try:
+        if not site or not enabled():
+            return static_tree
+        _ensure_loaded()
+        with _lock:
+            ent = _agg["pane"].get(str(site))
+        if ent is None:
+            _counters["store_misses"] += 1
+            return static_tree
+        tree_ms, flat_ms = ent.get("tree_ms"), ent.get("flat_ms")
+        if tree_ms is None or flat_ms is None:
+            _counters["store_misses"] += 1
+            return static_tree
+        _counters["store_hits"] += 1
+        use_tree = tree_ms <= flat_ms
+        reason = ("observed pane costs for w=%d: tree ~%.1fms vs flat "
+                  "~%.1fms per tick — %s merge"
+                  % (panes, tree_ms, flat_ms,
+                     "dyadic-tree" if use_tree else "flat"))
+        if not steering():
+            if use_tree != static_tree:
+                _decide("pane_split", site,
+                        "tree" if use_tree else "flat", reason,
+                        applied=False)
+            return static_tree
+        _decide("pane_split", site, "tree" if use_tree else "flat",
+                reason, applied=(use_tree != static_tree))
+        return use_tree
+    except Exception as e:
+        logger.debug("steer_pane_mode failed: %s", e)
+        return static_tree
+
+
+def pane_history():
+    """Copy of the per-stream pane cost aggregates (tests / debug)."""
+    _ensure_loaded()
+    with _lock:
+        return {k: dict(v) for k, v in _agg["pane"].items()}

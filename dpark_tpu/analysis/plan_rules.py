@@ -732,6 +732,28 @@ def _rule_trace_overhead_hint(r, report):
 # entry point
 # ---------------------------------------------------------------------------
 
+def _rule_window_noninv(r, report):
+    """window-noninv-no-merge (ISSUE 10 satellite): a non-invertible
+    windowed reduce whose op has NO registered partial-aggregate merge
+    (and no invFunc) re-reduces the whole window every slide — O(w)
+    per tick where the pane tree would pay O(log w).  dstream marks
+    the emitted plan (`_window_noninv`) when it falls back; this rule
+    surfaces the why."""
+    info = getattr(r, "_window_noninv", None)
+    if not info:
+        return
+    report.add(
+        "window-noninv-no-merge", "warn", r.scope_name,
+        "non-invertible windowed reduce over %r recomputes the whole "
+        "window every slide (O(w) per tick): %s"
+        % (info.get("op"), info.get("reason", "")),
+        hint="register a partial-aggregate merge (a classified monoid "
+             "op, or set func.__dpark_window_merge__ = True to assert "
+             "associativity over partials) and keep window/slide/batch "
+             "grid-aligned so the pane tree serves the window in "
+             "O(log w); or supply invFunc for O(1) slides")
+
+
 def lint_plan(rdd, master="local", report=None, lineage=None):
     """Run every plan rule over the lineage of `rdd`; returns a Report.
 
@@ -751,6 +773,7 @@ def lint_plan(rdd, master="local", report=None, lineage=None):
         _rule_host_fallback_group(r, report)
         _rule_adapt_stale_hint(r, report)
         _rule_trace_overhead_hint(r, report)
+        _rule_window_noninv(r, report)
     _rule_uncached_reshuffle(lineage, report)
     excess = _excess_wide_depth(rdd)
     _rule_wide_depth(rdd, report, excess)

@@ -281,6 +281,46 @@ def stream_chunk_rows(row_bytes=16):
 STREAM_TEXT_BYTES = 1 << 28
 
 # ---------------------------------------------------------------------------
+# pane-tree windowing (dpark_tpu/panes.py + dstream.py — ISSUE 10)
+# ---------------------------------------------------------------------------
+
+# slice windowed DStreams into slide-sized PANES whose partial
+# aggregates persist across ticks (cached reduced RDDs; on the tpu
+# master their shuffle outputs stay HBM-resident): invertible
+# reduceByKeyAndWindow updates the window in O(1) panes per slide
+# (prev + new pane - expired pane) regardless of the window/slide
+# ratio, and non-invertible window reduces merge O(log w) cached
+# dyadic tree nodes instead of re-reducing all w panes.  "0" disables
+# — every windowed op then takes the pre-pane whole-window paths (the
+# parity suite's reference side, and a bisection aid).  Pane mode
+# needs window % slide == 0 and slide % batch == 0; misaligned
+# windows keep the old paths regardless of this knob.
+STREAM_PANES = os.environ.get("DPARK_STREAM_PANES", "1") != "0"
+
+# non-invertible pane windows below this many panes skip the dyadic
+# merge tree and union their panes flat each tick (the tree's extra
+# cached intermediate shuffles only amortize once O(log w) beats w).
+# With DPARK_ADAPT=on the planner overrides this static split-point
+# choice from OBSERVED per-tick pane costs (adapt.steer_pane_mode).
+STREAM_PANE_TREE_MIN = int(os.environ.get(
+    "DPARK_STREAM_PANE_TREE_MIN", "8") or 0)
+
+# default allowed event-time lateness in seconds for windowed ops that
+# set an eventTime extractor without an explicit lateness= argument:
+# the watermark trails the max observed event time by this much, and
+# records older than the watermark drop (counted per stream).  Late
+# records inside the bound patch ONLY their pane, never the window.
+STREAM_ALLOWED_LATENESS = float(os.environ.get(
+    "DPARK_STREAM_LATENESS", "0") or 0)
+
+# bounded late-data buffer: at most this many late records are admitted
+# per pane patch per tick — anything beyond drops (counted as
+# late_dropped) so a storm of stragglers cannot grow a patch job
+# without bound.  0 = unbounded.
+STREAM_LATE_BUFFER_ROWS = int(os.environ.get(
+    "DPARK_STREAM_LATE_BUFFER", "100000") or 0)
+
+# ---------------------------------------------------------------------------
 # overlapped wave pipeline (backend/tpu executor stream loops)
 # ---------------------------------------------------------------------------
 

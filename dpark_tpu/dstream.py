@@ -128,6 +128,7 @@ class StreamingContext:
                 last_t = max(stream.generated)
                 last_rdd = stream.generated[last_t]
                 stream.generated = {round(new_zero, 6): last_rdd}
+            stream._on_rebase()
         self.zero_time = new_zero
         self._recovered = False
 
@@ -151,13 +152,16 @@ class StreamingContext:
         """queue: list/deque of RDDs or of plain lists (auto-parallelized)."""
         return QueueInputDStream(self, list(queue), oneAtATime, defaultRDD)
 
-    def textFileStream(self, directory, filter_fn=None):
-        return FileInputDStream(self, directory, filter_fn)
+    def textFileStream(self, directory, filter_fn=None,
+                       stamp_arrival=False):
+        return FileInputDStream(self, directory, filter_fn,
+                                stamp_arrival=stamp_arrival)
 
     fileStream = textFileStream
 
-    def socketTextStream(self, hostname, port):
-        return SocketInputDStream(self, hostname, port)
+    def socketTextStream(self, hostname, port, stamp_arrival=False):
+        return SocketInputDStream(self, hostname, port,
+                                  stamp_arrival=stamp_arrival)
 
     def makeStream(self, rdd):
         return ConstantInputDStream(self, rdd)
@@ -216,14 +220,19 @@ class StreamingContext:
         self._checkpoint_now = (
             self.checkpoint_path is not None
             and self._batches_done % self.checkpoint_interval == 0)
+        from dpark_tpu import trace
         for out in self.output_streams:
+            t0 = _time.perf_counter()
             try:
-                out.generate_job(t)
+                with trace.span("stream.batch", "stream", t=t):
+                    out.generate_job(t)
             except (TypeError, RuntimeError) as e:
                 if not self._disable_numeric_rewrites(t, e, out):
                     raise
                 try:
-                    out.generate_job(t)  # regenerate via the generic path
+                    with trace.span("stream.batch", "stream", t=t,
+                                    replay=True):
+                        out.generate_job(t)  # regenerate, generic path
                 except Exception:
                     # the generic path rejects this batch too (the
                     # user's own function raises on the data): drop the
@@ -235,6 +244,17 @@ class StreamingContext:
                         if not isinstance(s, InputDStream):
                             s.generated.pop(t, None)
                     raise
+            # per-tick wall observed per output chain: pane streams
+            # sample it into the adapt store (split-point pricing) —
+            # chains sharing a pane stream attribute the same wall
+            ms = (_time.perf_counter() - t0) * 1000.0
+            for s in self._chain_streams(out):
+                observe = getattr(s, "_observe_tick_ms", None)
+                if observe is not None:
+                    try:
+                        observe(ms)
+                    except Exception:
+                        pass
         for out in self.output_streams:
             out.forget_old(t)
         if self._checkpoint_now:
@@ -296,6 +316,13 @@ class StreamingContext:
             self._thread = None
         for ins in self.input_streams:
             ins.stop()
+        # drop this context's pane streams from the live-stats
+        # registry (bounded /metrics cardinality across restarts)
+        from dpark_tpu import panes as panes_mod
+        for s in self._all_streams():
+            sid = getattr(s, "_sid", None)
+            if sid is not None:
+                panes_mod.unregister_stream(sid)
         if stop_context:
             self.ctx.stop()
 
@@ -329,6 +356,16 @@ class DStream:
             return None                 # before the stream started
         if t in self.generated:
             return self.generated[t]
+        sd = self.slide_duration
+        if zero is not None and sd:
+            # slide cadence (reference parity): a stream only emits at
+            # multiples of its OWN slide duration.  Off-cadence ticks
+            # (a windowed stream with slide > batch) produce nothing —
+            # the pane plane depends on this: pane boundaries ARE the
+            # emit boundaries.
+            k = (t - zero) / sd
+            if abs(k - round(k)) > 1e-4:
+                return None
         rdd = self.compute(t)
         self.generated[t] = rdd
         if rdd is not None and self.must_checkpoint \
@@ -366,6 +403,12 @@ class DStream:
 
     def _remember_duration(self):
         return max(self.slide_duration * 4, self.window_duration * 2)
+
+    def _on_rebase(self):
+        """Hook: the recovery timeline rebase re-keys `generated` to
+        the new clock; streams holding OTHER time-keyed state (pane
+        stores, per-batch reduce caches) clear it here — the carried
+        predecessor window stays, stale-clock partials never mix in."""
 
     # -- transformations -------------------------------------------------
     def map(self, f):
@@ -441,9 +484,29 @@ class DStream:
                 .transform(_count_to_rdd))
 
     def reduceByKeyAndWindow(self, func, windowDuration, slideDuration=None,
-                             numSplits=None, invFunc=None):
+                             numSplits=None, invFunc=None,
+                             eventTime=None, lateness=None):
         """Windowed per-key reduce; with invFunc the window updates
         incrementally (prev - leaving + entering).
+
+        PANE PLANE (ISSUE 10): when window %% slide == 0 and slide %%
+        batch == 0 (and conf.STREAM_PANES is on), the window is sliced
+        into slide-sized panes whose partial aggregates persist across
+        ticks.  With invFunc the slide is O(1) panes (prev + new pane
+        - expired pane); without invFunc a provably mergeable func (a
+        classified monoid, or ``func.__dpark_window_merge__ = True``
+        asserting associativity over partial aggregates) merges
+        O(log w) cached dyadic tree nodes per slide instead of
+        re-reducing all w panes.  A non-invertible func with NO
+        registered merge keeps the whole-window O(w) recompute and the
+        `window-noninv-no-merge` plan-lint rule says so.
+
+        EVENT TIME: `eventTime` (record -> timestamp) assigns records
+        to panes by event time instead of arrival batch; the watermark
+        trails the max observed timestamp by `lateness` seconds
+        (default conf.STREAM_ALLOWED_LATENESS).  Late records inside
+        the bound patch ONLY their pane; older ones drop, counted per
+        stream.  Requires the pane plane.
 
         PROBE CONTRACT: when (func, invFunc) prove to be plain (+, -),
         the incremental update is rewritten to one union-reduce per
@@ -457,11 +520,32 @@ class DStream:
         regenerates through the generic leftOuterJoin+invFunc path —
         the probe accelerates, it never decides correctness."""
         if invFunc is None:
+            from dpark_tpu import conf
+            slide = float(slideDuration or self.slide_duration)
+            aligned = (_grid_multiple(float(windowDuration), slide)
+                       and _grid_multiple(slide, self.slide_duration))
+            merge_ok = _window_merge_registered(func)
+            if conf.STREAM_PANES and aligned and merge_ok:
+                return PanedWindowReduceDStream(
+                    self, func, windowDuration, slideDuration, numSplits,
+                    eventTime=eventTime, lateness=lateness)
+            if eventTime is not None:
+                raise ValueError(
+                    "eventTime windows need the pane plane: aligned "
+                    "window/slide/batch durations, DPARK_STREAM_PANES "
+                    "on, and (for non-invertible ops) a registered "
+                    "merge")
+            why = ("no registered merge for %r"
+                   % getattr(func, "__name__", func)) if not merge_ok \
+                else ("window/slide/batch durations not grid-aligned"
+                      if not aligned else "DPARK_STREAM_PANES off")
             w = self.window(windowDuration, slideDuration)
             return TransformedDStream(
-                w, _rdd_op("reduceByKey", func, numSplits))
+                w, _MarkedWindowReduce(func, numSplits, why))
         return ReducedWindowedDStream(self, func, invFunc, windowDuration,
-                                      slideDuration, numSplits)
+                                      slideDuration, numSplits,
+                                      eventTime=eventTime,
+                                      lateness=lateness)
 
     # -- state -----------------------------------------------------------
     def updateStateByKey(self, updateFunc, numSplits=None):
@@ -573,6 +657,53 @@ def _safe_reduce(it, func):
 
 def _count_to_rdd(rdd):
     return rdd.ctx.parallelize([rdd.count()], 1)
+
+
+def _grid_multiple(a, b):
+    """round(a/b) when a is an (approximate) integer multiple >= 1 of
+    b, else 0 — the pane-grid alignment test."""
+    if not b:
+        return 0
+    k = a / b
+    n = int(round(k))
+    return n if n >= 1 and abs(k - n) < 1e-6 else 0
+
+
+def _window_merge_registered(func):
+    """A non-invertible windowed reduce may merge PARTIAL aggregates
+    (pane tree) only when merging partials with `func` provably equals
+    folding the raw records: a classified monoid (exact bytecode /
+    identity match), or the user's explicit
+    ``func.__dpark_window_merge__`` assertion (truthy = func itself is
+    associative over partials).  Anything else keeps the whole-window
+    recompute — reduceByKey's contract nominally promises
+    associativity, but the pane tree RE-ASSOCIATES across ticks, so
+    only provable or asserted merges ride."""
+    if getattr(func, "__dpark_window_merge__", None):
+        return True
+    from dpark_tpu.utils.monoid import classify_merge
+    try:
+        return classify_merge(func) is not None
+    except Exception:
+        return False
+
+
+class _MarkedWindowReduce:
+    """The O(w) whole-window reduce fallback, marking every emitted
+    plan so the `window-noninv-no-merge` lint rule can explain the
+    per-tick recompute cost (ISSUE 10 satellite)."""
+
+    def __init__(self, func, numSplits, reason):
+        self.func = func
+        self.numSplits = numSplits
+        self.reason = reason
+
+    def __call__(self, rdd):
+        out = rdd.reduceByKey(self.func, self.numSplits)
+        out._window_noninv = {
+            "reason": self.reason,
+            "op": getattr(self.func, "__name__", str(self.func))}
+        return out
 
 
 class DerivedDStream(DStream):
@@ -692,20 +823,346 @@ class WindowedDStream(DerivedDStream):
         return self.ssc.ctx.union(rdds)
 
 
-class ReducedWindowedDStream(DerivedDStream):
-    """Incremental windowed reduce: new_window = inv(prev_window - old
-    slice) + new slice (reference: ReducedWindowedDStream)."""
+class _PaneWindowBase(DerivedDStream):
+    """Shared pane-plane machinery for the windowed streams (ISSUE 10
+    tentpole; see dpark_tpu/panes.py for the decomposition): the
+    window is sliced into slide-sized PANES whose partial aggregates
+    live as cached reduced RDDs keyed by pane end time — on the tpu
+    master their shuffle outputs stay HBM-resident between ticks, so
+    sliding the window costs merge work over a constant (invertible)
+    or logarithmic (merge-tree) number of panes, never a whole-window
+    recompute.  Event-time classification, the bounded-lateness
+    watermark, single-pane late patches, per-stream live stats
+    (panes.stream_stats -> web UI + /metrics), trace events, and the
+    adapt-store cost sampling all live here."""
 
-    def __init__(self, parent, func, invFunc, windowDuration,
-                 slideDuration=None, numSplits=None):
+    _kind = "win"
+
+    def __init__(self, parent, func, windowDuration, slideDuration,
+                 numSplits, eventTime=None, lateness=None):
         super().__init__(parent)
         self.func = func
-        self.invFunc = invFunc
         self._window = float(windowDuration)
         self._slide = float(slideDuration or parent.slide_duration)
         self.numSplits = numSplits
         self.must_checkpoint = True
+        from dpark_tpu import conf
+        # pane-grid admission: the window must be a whole number of
+        # slides and the slide a whole number of parent batches
+        self._np = _grid_multiple(self._window, self._slide)
+        self._bpp = _grid_multiple(self._slide, parent.slide_duration)
+        self._pane_mode = bool(conf.STREAM_PANES and self._np
+                               and self._bpp)
+        self.eventTime = eventTime
+        if eventTime is not None and not self._pane_mode:
+            raise ValueError(
+                "eventTime windows need the pane plane: aligned "
+                "window/slide/batch durations and DPARK_STREAM_PANES")
+        if lateness is None:
+            lateness = conf.STREAM_ALLOWED_LATENESS
+        from dpark_tpu import panes as panes_mod
+        self._wm = (panes_mod.Watermark(lateness)
+                    if eventTime is not None else None)
+        self._panes = {}        # pane END time -> reduced rdd or None
+        self._tick_deltas = {}  # tick -> in-window late-delta rdds
+        self._retired = []      # (due_time, replaced-pane rdd)
+        self._anchor = None     # first emit time == pane index 0
+        self._sid = None
+        self._stats = None
+        self._adapt_site = None
+        self._tick_samples = []
+
+    @property
+    def slide_duration(self):
+        return self._slide
+
+    @property
+    def window_duration(self):
+        return self._window
+
+    # -- identity / registration ----------------------------------------
+    def _mode_name(self):
+        return "pane"
+
+    def _ensure_registered(self):
+        from dpark_tpu import panes as panes_mod
+        if self._sid is None:
+            self._sid = panes_mod.new_stream_id(self._kind)
+            self._stats = {
+                "type": type(self).__name__, "mode": self._mode_name(),
+                "window": self._window, "slide": self._slide,
+                "panes": 0, "nodes": 0, "node_builds": 0, "ticks": 0,
+                "watermark": None, "watermark_lag_s": None,
+                "late_dropped": 0, "late_patched_rows": 0,
+                "late_patches": 0}
+            panes_mod.register_stream(self._sid, self._stats)
+        if self._adapt_site is None:
+            from dpark_tpu import adapt
+            try:
+                self._adapt_site = adapt.stable_key(
+                    ("pane", type(self).__name__,
+                     getattr(self.func, "__code__", repr(self.func)),
+                     self._np))
+            except Exception:
+                self._adapt_site = False
+
+    def _tag(self, rdd, role, pane=None):
+        """Stage attribution (schedule.py reads `_stream_tag` into
+        stage_info): which stream and which pane-plane role a stage's
+        RDD serves."""
+        if rdd is not None and self._sid is not None:
+            tag = {"stream": self._sid, "role": role}
+            if pane is not None:
+                tag["pane"] = pane
+            rdd._stream_tag = tag
+        return rdd
+
+    # -- pane store ------------------------------------------------------
+    def _idx(self, t):
+        return int(round((t - self._anchor) / self._slide))
+
+    def _pane_time(self, idx):
+        return round(self._anchor + idx * self._slide, 6)
+
+    def _pane_by_idx(self, idx):
+        return self._panes.get(self._pane_time(idx))
+
+    def _new_data(self, t):
+        """Union of the parent batches in (t - slide, t], generated in
+        ASCENDING time order (queue inputs pop in arrival order)."""
+        step = self.parent.slide_duration
+        rdds = []
+        for j in range(self._bpp - 1, -1, -1):
+            r = self.parent.getOrCompute(round(t - j * step, 6))
+            if r is not None:
+                rdds.append(r)
+        if not rdds:
+            return None
+        return rdds[0] if len(rdds) == 1 else self.ssc.ctx.union(rdds)
+
+    def _reduce(self, rdd):
+        return rdd.reduceByKey(self.func, self.numSplits)
+
+    def _on_pane_patched(self, pane_time):
+        """Hook: the merge tree invalidates the nodes covering a
+        patched pane."""
+
+    def _ingest_pane(self, t):
+        """Build pane(t) from the tick's new data, event-time-split
+        when configured: on-time records form the new pane, admissible
+        late records patch ONLY their pane (bounded by the watermark,
+        the window horizon, and conf.STREAM_LATE_BUFFER_ROWS), the
+        rest drop (counted).  Returns the tick's in-window late-delta
+        RDDs so incremental window updates can fold the patches in;
+        idempotent per tick (the numeric-rewrite fallback replays a
+        batch through compute())."""
+        from dpark_tpu import conf, panes as panes_mod, trace
+        t = round(t, 6)
+        self._tick_emitted = True       # adapt sampling: a REAL emit
+                                        # tick (run_batch also observes
+                                        # off-cadence no-op ticks)
+        if t in self._panes:
+            return self._tick_deltas.get(t, [])
+        self._ensure_registered()
+        if self._anchor is None:
+            self._anchor = t
+        new = self._new_data(t)
+        deltas = []
+        if new is None:
+            self._panes[t] = None
+            self._note_tick(t)
+            return deltas
+        if self.eventTime is None:
+            pane = self._tag(self._reduce(new).cache(), "pane-build",
+                             pane=self._idx(t))
+            self._panes[t] = pane
+            trace.event("stream.pane.build", "stream", stream=self._sid,
+                        pane=self._idx(t))
+            self._note_tick(t)
+            return deltas
+        new = new.cache()
+        # the raw tick union materializes for the scan job and feeds
+        # the pane/delta filters; retire its cache at the horizon like
+        # a replaced pane (its lineage stays recomputable)
+        self._retired.append(
+            (t + self._window + self._wm.lateness, new))
+        # classify the tick's records under the PREVIOUS watermark
+        # (one small job; the filters below share the same rule)
+        max_back = min(self._np - 1, self._idx(t))
+        floor = self._wm.floor()
+        mx, on_time, late, dropped = panes_mod.event_scan(
+            new, self.eventTime, t, self._slide, max_back, floor)
+        pane = None
+        if on_time:
+            pane = new.filter(panes_mod._PaneFilter(
+                self.eventTime, t, self._slide, 0, floor))
+            pane = self._tag(self._reduce(pane).cache(), "pane-build",
+                             pane=self._idx(t))
+            trace.event("stream.pane.build", "stream", stream=self._sid,
+                        pane=self._idx(t))
+        self._panes[t] = pane
+        cap = conf.STREAM_LATE_BUFFER_ROWS
+        for back in sorted(late):
+            rows = late[back]
+            if cap and rows > cap:
+                # bounded late buffer: an oversized patch drops WHOLE
+                # (deterministic — a first-N admission would depend on
+                # partition scan order)
+                dropped += rows
+                continue
+            pt = round(t - back * self._slide, 6)
+            delta = new.filter(panes_mod._PaneFilter(
+                self.eventTime, t, self._slide, back, floor))
+            delta = self._tag(self._reduce(delta).cache(), "late-patch",
+                              pane=self._idx(pt))
+            old = self._panes.get(pt)
+            if old is None:
+                patched = delta
+            else:
+                patched = self._tag(
+                    self._reduce(old.union(delta)).cache(),
+                    "pane-build", pane=self._idx(pt))
+                # the replaced pane may still back cached lineage of
+                # already-emitted windows: retire it at the horizon
+                self._retired.append(
+                    (pt + self._window + self._wm.lateness, old))
+            self._panes[pt] = patched
+            self._on_pane_patched(pt)
+            deltas.append(delta)
+            self._stats["late_patches"] += 1
+            self._stats["late_patched_rows"] += rows
+            trace.event("stream.late.patch", "stream", stream=self._sid,
+                        pane=self._idx(pt), rows=rows)
+        self._wm.update(mx)
+        self._stats["late_dropped"] += dropped
+        if deltas:
+            self._tick_deltas[t] = deltas
+        self._note_tick(t)
+        return deltas
+
+    def _window_pane_rdds(self, t):
+        """The window's existing pane partials (cold start / flat
+        emit)."""
+        out = []
+        k = t
+        while k > t - self._window + 1e-9:
+            p = self._panes.get(round(k, 6))
+            if p is not None:
+                out.append(p)
+            k -= self._slide
+        return out
+
+    # -- bookkeeping -----------------------------------------------------
+    def _note_tick(self, t):
+        st = self._stats
+        st["ticks"] += 1
+        st["panes"] = sum(1 for r in self._panes.values()
+                          if r is not None)
+        if self._wm is not None:
+            st["watermark"] = self._wm.value()
+            lag = self._wm.lag(t)
+            st["watermark_lag_s"] = (None if lag is None
+                                     else round(lag, 6))
+
+    def _observe_tick_ms(self, ms):
+        """Sample the per-tick wall into the adapt store (split-point
+        pricing: the planner compares tree vs flat emit costs for this
+        stream signature across runs).  One append per stream — the
+        median of the post-warmup ticks."""
+        if not self._pane_mode or not self._adapt_site:
+            return
+        # only REAL emit ticks count (with slide > batch, run_batch
+        # also times off-cadence no-op ticks — ~0 ms walls that would
+        # poison the median), and the list stops growing once sampled
+        if not getattr(self, "_tick_emitted", False) \
+                or len(self._tick_samples) >= 8:
+            return
+        self._tick_emitted = False
+        self._tick_samples.append(float(ms))
+        if len(self._tick_samples) == 8:
+            from dpark_tpu import adapt
+            tail = sorted(self._tick_samples[4:])
+            adapt.record_pane_cost(self._adapt_site, self._mode_name(),
+                                   tail[len(tail) // 2], self._np)
+
+    def forget_old(self, t, keep=None):
+        super().forget_old(t, keep)
+        horizon = self._window + self._slide * 2 \
+            + (self._wm.lateness if self._wm is not None else 0.0)
+        for ts in list(self._panes):
+            if ts < t - horizon:
+                rdd = self._panes.pop(ts)
+                if rdd is not None and rdd.should_cache:
+                    rdd.unpersist()
+        for ts in list(self._tick_deltas):
+            if ts < t - horizon:
+                for rdd in self._tick_deltas.pop(ts):
+                    if rdd.should_cache:
+                        rdd.unpersist()
+        keep_retired = []
+        for due, rdd in self._retired:
+            if due < t:
+                if rdd.should_cache:
+                    rdd.unpersist()
+            else:
+                keep_retired.append((due, rdd))
+        self._retired = keep_retired
+        if self._stats is not None:
+            self._stats["panes"] = sum(
+                1 for r in self._panes.values() if r is not None)
+
+    def _on_rebase(self):
+        # pane stores are keyed by the OLD clock: clear them (the
+        # carried predecessor window survives via `generated`; panes
+        # refill from the new anchor, exactly like the pre-pane
+        # per-batch reduce cache)
+        self._panes = {}
+        self._tick_deltas = {}
+        self._retired = []
+        self._anchor = None
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        # only checkpointed panes survive the metadata snapshot (same
+        # contract as `generated`); live stats/registry re-create on
+        # the first tick after recovery
+        for r in self._panes.values():
+            if r is not None:
+                r._maybe_promote_checkpoint()
+        d["_panes"] = {
+            ts: r for ts, r in self._panes.items()
+            if r is not None and r._checkpoint_rdd is not None}
+        d["_tick_deltas"] = {}
+        d["_retired"] = []
+        d["_sid"] = None
+        d["_stats"] = None
+        d["_tick_samples"] = []
+        return d
+
+
+class ReducedWindowedDStream(_PaneWindowBase):
+    """Incremental windowed reduce: new_window = inv(prev_window - old
+    slice) + new slice (reference: ReducedWindowedDStream).
+
+    PANE PLANE (ISSUE 10): on the aligned grid the slide is O(1) PANES
+    regardless of the window/slide ratio — prev + new pane - expired
+    pane — where the pre-pane path paid one join/reduce per BATCH
+    leaving and entering (O(slide/batch) per tick, O(window/batch) on
+    cold start).  Pane partials are cached reduced RDDs; the expired
+    pane was built when it entered, so no recompute.  Misaligned
+    windows (or DPARK_STREAM_PANES=0) keep the per-batch path."""
+
+    _kind = "rwin"
+
+    def __init__(self, parent, func, invFunc, windowDuration,
+                 slideDuration=None, numSplits=None, eventTime=None,
+                 lateness=None):
+        super().__init__(parent, func, windowDuration, slideDuration,
+                         numSplits, eventTime=eventTime,
+                         lateness=lateness)
+        self.invFunc = invFunc
         self._reduced = {}      # time -> per-batch reduced rdd
+                                # (pre-pane path only)
         # provably (add, sub): the incremental update rewrites to
         # prev + new - old as ONE union-reduce — every branch is a
         # reduced shuffle, so the whole window update rides the device
@@ -724,13 +1181,8 @@ class ReducedWindowedDStream(DerivedDStream):
         self._checked_op = (_CheckedNumericOp(func, "add")
                             if self._linear_ops else None)
 
-    @property
-    def slide_duration(self):
-        return self._slide
-
-    @property
-    def window_duration(self):
-        return self._window
+    def _mode_name(self):
+        return "inv"
 
     def _batch_reduced(self, t):
         if t not in self._reduced:
@@ -739,7 +1191,95 @@ class ReducedWindowedDStream(DerivedDStream):
                                 if rdd is not None else None)
         return self._reduced[t]
 
+    def _probe_numeric(self, prev):
+        if self._linear_ops and self._numeric is None:
+            # one-time value probe (a one-partition job on the cached
+            # window): plain numbers form a group under (+, -); other
+            # +/- types (Counter saturates) must keep the join path.
+            # Probe SEVERAL records, not one (ADVICE r4): a stream whose
+            # first reduced value is a number but whose later ones are
+            # not would otherwise silently take the union-negate
+            # rewrite and diverge from the leftOuterJoin+invFunc path.
+            # The verdict caches per (op, value type) process-wide —
+            # sibling streams folding the same op over the same record
+            # type skip the re-derivation (ISSUE 10 satellite)
+            probe = _probe_values(prev)
+            if probe:
+                self._numeric = _numeric_verdict(
+                    "add", [rec[1] for rec in probe])
+
     def compute(self, t):
+        if not self._pane_mode:
+            return self._compute_batchwise(t)
+        from dpark_tpu import trace
+        t = round(t, 6)
+        prev = self.generated.get(round(t - self._slide, 6))
+        deltas = self._ingest_pane(t)
+        pane_new = self._panes.get(t)
+        if prev is None:
+            # cold start: flat union-reduce over the window's panes
+            # (each pane already reduced; deltas are folded into the
+            # patched panes, so they must NOT be added again here)
+            rdds = self._window_pane_rdds(t)
+            if not rdds:
+                return None
+            if len(rdds) == 1:
+                return rdds[0]
+            out = rdds[0].union(*rdds[1:]) \
+                         .reduceByKey(self.func, self.numSplits).cache()
+            trace.event("stream.window.emit", "stream",
+                        stream=self._sid, branches=len(rdds))
+            return self._tag(out, "window-emit")
+        pane_old = self._panes.get(round(t - self._window, 6))
+        self._probe_numeric(prev)
+        if self._linear_ops and self._numeric:
+            # prev + new pane - expired pane (+ late patch deltas), ONE
+            # union-reduce over a CONSTANT number of branches.  Key-set
+            # parity with the join formulation: every key in the
+            # expired pane also appears in prev (prev's window
+            # contained that pane), so negated orphan keys cannot
+            # materialize; keys at the zero element stay present,
+            # exactly like leftOuterJoin + sub
+            branches = [prev]
+            if pane_new is not None:
+                branches.append(pane_new)
+            branches.extend(deltas)
+            if pane_old is not None:
+                branches.append(pane_old.mapValue(_neg_value))
+            if len(branches) == 1:
+                return prev             # quiet tick: window unchanged
+            # checked op: a non-numeric tail raises TypeError and
+            # run_batch falls back to the join+invFunc path
+            out = branches[0].union(*branches[1:]) \
+                .reduceByKey(self._checked_op, self.numSplits).cache()
+            trace.event("stream.window.emit", "stream",
+                        stream=self._sid, branches=len(branches))
+            return self._tag(out, "window-emit")
+        # generic invFunc path, pane granularity: ONE inverse join for
+        # the expired pane (invFunc sees the pane's AGGREGATE — the
+        # reference contract: old values are reduced first, then
+        # inverse-reduced once) + one union-reduce for the new pane
+        # and any late patches
+        out = prev
+        if pane_old is not None:
+            out = out.leftOuterJoin(pane_old, self.numSplits) \
+                     .mapValue(_InvApply(self.invFunc))
+        entering = ([pane_new] if pane_new is not None else []) + deltas
+        if entering:
+            out = out.union(*entering) \
+                     .reduceByKey(self.func, self.numSplits)
+        if out is prev:
+            return prev
+        # drop keys whose count reached the zero element is left to the
+        # user's invFunc semantics (parity with reference)
+        trace.event("stream.window.emit", "stream", stream=self._sid,
+                    branches=1 + len(entering))
+        return self._tag(out.cache(), "window-emit")
+
+    def _compute_batchwise(self, t):
+        """The pre-pane per-batch path (misaligned windows or
+        DPARK_STREAM_PANES=0 — also the parity suite's reference
+        side)."""
         prev = self.generated.get(round(t - self._slide, 6))
         step = self.parent.slide_duration
         if prev is None:
@@ -771,25 +1311,8 @@ class ReducedWindowedDStream(DerivedDStream):
             if r is not None:
                 entering.append(r)
             k -= step
-        if self._linear_ops and self._numeric is None:
-            # one-time value probe (a one-partition job on the cached
-            # window): plain numbers form a group under (+, -); other
-            # +/- types (Counter saturates) must keep the join path.
-            # Probe SEVERAL records, not one (ADVICE r4): a stream whose
-            # first reduced value is a number but whose later ones are
-            # not would otherwise silently take the union-negate
-            # rewrite and diverge from the leftOuterJoin+invFunc path
-            import numbers
-            probe = _probe_values(prev)
-            if probe:
-                self._numeric = all(
-                    isinstance(rec[1], numbers.Number) for rec in probe)
+        self._probe_numeric(prev)
         if self._linear_ops and self._numeric:
-            # prev + new - old, one union-reduce.  Key-set parity with
-            # the join formulation: every key in a leaving slice also
-            # appears in prev (prev's window contains that slice), so
-            # negated orphan keys cannot materialize; keys at the zero
-            # element stay present, exactly like leftOuterJoin + sub.
             branches = ([prev] + entering
                         + [r.mapValue(_neg_value) for r in leaving])
             out = branches[0]
@@ -805,8 +1328,6 @@ class ReducedWindowedDStream(DerivedDStream):
             out = joined.mapValue(_InvApply(self.invFunc))
         for r in entering:
             out = out.union(r).reduceByKey(self.func, self.numSplits)
-        # drop keys whose count reached the zero element is left to the
-        # user's invFunc semantics (parity with reference)
         return out.cache()
 
     def forget_old(self, t, keep=None):
@@ -816,6 +1337,122 @@ class ReducedWindowedDStream(DerivedDStream):
                 rdd = self._reduced.pop(ts)
                 if rdd is not None and rdd.should_cache:
                     rdd.unpersist()
+
+    def _on_rebase(self):
+        super()._on_rebase()
+        self._reduced = {}
+
+
+class PanedWindowReduceDStream(_PaneWindowBase):
+    """Non-invertible windowed reduce over the pane plane: each tick
+    merges the window's pane range through a cache of ALIGNED dyadic
+    merge nodes (panes.MergeTree) — at most ~2*log2(w) branches per
+    emit and amortized O(1) node builds per pane, vs. re-reducing all
+    w panes (let alone all raw batches) every slide.  Below
+    conf.STREAM_PANE_TREE_MIN panes the tree's extra cached
+    intermediate shuffles don't pay and the panes union FLAT; with
+    DPARK_ADAPT=on the split-point choice comes from OBSERVED per-tick
+    costs instead (adapt.steer_pane_mode).
+
+    Admission (checked by reduceByKeyAndWindow before constructing
+    this class): merging PARTIAL aggregates with `func` must provably
+    equal folding raw records — a classified monoid or an explicit
+    ``func.__dpark_window_merge__`` assertion.  Float caveat: the tree
+    re-associates the fold, so float low-order bits can differ from
+    the whole-window recompute (the GROUP_AGG_REWRITE caveat); integer
+    and min/max aggregates are exact."""
+
+    _kind = "pwin"
+
+    def __init__(self, parent, func, windowDuration, slideDuration=None,
+                 numSplits=None, eventTime=None, lateness=None):
+        super().__init__(parent, func, windowDuration, slideDuration,
+                         numSplits, eventTime=eventTime,
+                         lateness=lateness)
+        assert self._pane_mode, "constructed without pane admission"
+        self._tree = None
+        self._use_tree = None           # decided at first emit
+        # a node wider than half the window is covered at most once
+        # per window length — not worth caching
+        half = max(1, self._np // 2)
+        self._max_node = 1 << (half.bit_length() - 1)
+
+    def _mode_name(self):
+        if self._use_tree is None:
+            return "pane"
+        return "tree" if self._use_tree else "flat"
+
+    def _get_tree(self):
+        if self._tree is None:
+            from dpark_tpu import panes as panes_mod
+            self._tree = panes_mod.MergeTree(self._pane_by_idx,
+                                             self._merge_node)
+        return self._tree
+
+    def _merge_node(self, kids, size, start):
+        from dpark_tpu import trace
+        out = kids[0].union(*kids[1:]) \
+            .reduceByKey(self.func, self.numSplits).cache()
+        self._tag(out, "tree-merge", pane=start)
+        trace.event("stream.tree.merge", "stream", stream=self._sid,
+                    start=start, size=size)
+        return out
+
+    def _on_pane_patched(self, pane_time):
+        if self._tree is not None:
+            # a late patch dirties exactly the O(log w) nodes covering
+            # its pane; the next emit rebuilds only those
+            self._tree.invalidate(self._idx(pane_time))
+
+    def _decide_mode(self):
+        from dpark_tpu import adapt, conf
+        static = self._np >= max(2, conf.STREAM_PANE_TREE_MIN)
+        self._use_tree = adapt.steer_pane_mode(
+            self._adapt_site, self._np, static)
+        if self._stats is not None:
+            self._stats["mode"] = self._mode_name()
+
+    def compute(self, t):
+        from dpark_tpu import trace
+        t = round(t, 6)
+        self._ingest_pane(t)    # deltas fold via the patched panes
+        if self._use_tree is None:
+            self._decide_mode()
+        hi = self._idx(t)
+        lo = max(0, hi - self._np + 1)
+        if self._use_tree:
+            tree = self._get_tree()
+            rdds = tree.cover(lo, hi, max_size=self._max_node)
+            if self._stats is not None:
+                self._stats["nodes"] = len(tree.nodes)
+                self._stats["node_builds"] = tree.builds
+        else:
+            rdds = self._window_pane_rdds(t)
+        if not rdds:
+            return None
+        trace.event("stream.window.emit", "stream", stream=self._sid,
+                    branches=len(rdds))
+        if len(rdds) == 1:
+            return rdds[0]
+        out = rdds[0].union(*rdds[1:]) \
+            .reduceByKey(self.func, self.numSplits).cache()
+        return self._tag(out, "window-emit")
+
+    def forget_old(self, t, keep=None):
+        super().forget_old(t, keep)
+        if self._tree is not None and self._anchor is not None:
+            horizon = self._window + self._slide * 2 + (
+                self._wm.lateness if self._wm is not None else 0.0)
+            self._tree.forget(self._idx(t - horizon))
+
+    def _on_rebase(self):
+        super()._on_rebase()
+        self._tree = None
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["_tree"] = None               # rebuilt from panes on demand
+        return d
 
 
 class _InvApply:
@@ -889,29 +1526,74 @@ class _CheckedNumericOp:
     classifies the merge: the device path only ever runs over ingested
     NUMERIC columns (non-numeric rows can't ingest and fall back to
     the host object path, where this check executes), so the hint is
-    sound."""
+    sound.
+
+    The per-operand verdict caches per (class, dtype kind) in a table
+    SHARED across streams (ISSUE 10 satellite): the isinstance probe
+    runs once per value type seen in the process, and every later fold
+    over that type is one dict hit — not one isinstance chain per pair
+    per batch.  The dtype kind is part of the key because np.ndarray
+    is one class over many dtypes (an int array must not pre-approve a
+    string array); the verdict itself is op-independent (the op was
+    vetted at rewrite admission), so the table is shared by add/min/
+    max/mul checked ops alike."""
 
     __slots__ = ("op", "__dpark_monoid__")
 
     _HINTS = {"add": "add", "min": "min", "max": "max", "mul": "mul"}
+
+    # (operand class, dtype kind or None) -> bool, process-global
+    _TYPE_VERDICTS = {}
 
     def __init__(self, op, hint=None):
         self.op = op
         if hint in self._HINTS:
             self.__dpark_monoid__ = hint
 
+    @classmethod
+    def _operand_ok(cls, x):
+        dt = getattr(x, "dtype", None)
+        key = (x.__class__, getattr(dt, "kind", None))
+        ok = cls._TYPE_VERDICTS.get(key)
+        if ok is None:
+            # array-likes (jax tracers during the merge-fn trace,
+            # numpy scalars/arrays on ingested columns) are numeric by
+            # construction — the check targets arbitrary Python
+            # objects on the host object path (str concatenation was
+            # the r5 finding)
+            ok = isinstance(x, numbers.Number) or _arraylike(x)
+            cls._TYPE_VERDICTS[key] = ok
+        return ok
+
     def __call__(self, a, b):
-        # array-likes (jax tracers during the merge-fn trace, numpy
-        # scalars/arrays on ingested columns) are numeric by
-        # construction — the check targets arbitrary Python objects on
-        # the host object path (str concatenation was the r5 finding)
-        if (isinstance(a, numbers.Number) or _arraylike(a)) \
-                and (isinstance(b, numbers.Number) or _arraylike(b)):
+        if self._operand_ok(a) and self._operand_ok(b):
             return self.op(a, b)
         raise _NumericRewriteError(
             "numeric union-reduce rewrite saw a non-numeric pair "
             "(%s, %s): the probe-based rewrite does not apply to "
             "this stream" % (type(a).__name__, type(b).__name__))
+
+
+# probe-verdict cache (ISSUE 10 satellite): (op kind, value type) ->
+# bool, so sibling streams folding the same op over the same record
+# type skip re-deriving the numeric verdict from their own probe rows
+_PROBE_VERDICTS = {}
+
+
+def _numeric_verdict(op_kind, values):
+    """Are these probed values plain numbers (the union-reduce rewrite
+    admission)?  Cached per (op kind, value type) when the sample is
+    type-homogeneous; a mixed sample never caches (its verdict is not
+    a property of one type)."""
+    vt = values[0].__class__
+    if all(v.__class__ is vt for v in values):
+        key = (op_kind, vt)
+        v = _PROBE_VERDICTS.get(key)
+        if v is None:
+            v = all(isinstance(x, numbers.Number) for x in values)
+            _PROBE_VERDICTS[key] = v
+        return v
+    return all(isinstance(x, numbers.Number) for x in values)
 
 
 def _probe_values(rdd, k=5):
@@ -1052,12 +1734,14 @@ class StateDStream(DerivedDStream):
             # ADVICE r4: several records, all must be numbers): the
             # union-reduce rewrite folds values PAIRWISE where the
             # updateFunc summed a list from 0 — identical for numbers,
-            # different for e.g. strings (sum() raises, a + b doesn't)
-            import numbers
+            # different for e.g. strings (sum() raises, a + b doesn't).
+            # The verdict caches per (op, value type) process-wide
+            # (ISSUE 10 satellite)
             probe = _probe_values(batch)
             if probe:
-                self._numeric = all(
-                    isinstance(rec[1], numbers.Number) for rec in probe)
+                self._numeric = _numeric_verdict(
+                    getattr(self._checked_op, "__dpark_monoid__", "add"),
+                    [rec[1] for rec in probe])
         if self._monoid_op is not None and self._numeric:
             # monoid state: state' = prev U reduce(batch), one flat
             # union-reduce per batch — every stage rides the array path
@@ -1254,17 +1938,41 @@ class QueueInputDStream(InputDStream):
         return self.defaultRDD
 
 
+class _ArrivalStamp:
+    """record -> (arrival_ts, record); one picklable instance per scan
+    so every record a scan picked up carries the same timestamp."""
+
+    def __init__(self, ts):
+        self.ts = ts
+
+    def __call__(self, rec):
+        return (self.ts, rec)
+
+
 class FileInputDStream(InputDStream):
     """Scan a directory each batch; per-file byte offsets are tracked so a
     batch picks up both new files AND data appended to known files
-    (tail -f semantics; reference FileInputDStream scans by mtime)."""
+    (tail -f semantics; reference FileInputDStream scans by mtime).
 
-    def __init__(self, ssc, directory, filter_fn=None, newFilesOnly=True):
+    CLOCK CONTRACT (ISSUE 10 satellite): with ``stamp_arrival=True``
+    every record is emitted as ``(arrival_ts, line)``.  The arrival
+    time is the DRIVER's wall clock at the directory scan that first
+    observed the bytes — one timestamp per scan, so all lines a batch
+    picked up share it, and the stamp is monotonically non-decreasing
+    across batches of one stream.  That makes it a consistent
+    event-time source for the watermark plane (e.g.
+    ``eventTime=lambda kv: kv[1][0]`` after keying) when records carry
+    no domain timestamp; file mtimes are deliberately NOT used (they
+    follow the writer's clock, which may jump)."""
+
+    def __init__(self, ssc, directory, filter_fn=None, newFilesOnly=True,
+                 stamp_arrival=False):
         super().__init__(ssc)
         self.directory = directory
         self.filter_fn = filter_fn or (lambda n: not n.startswith("."))
         self.offsets = {}               # path -> bytes already consumed
         self.new_files_only = newFilesOnly
+        self.stamp_arrival = stamp_arrival
 
     def start(self):
         if self.new_files_only:
@@ -1275,6 +1983,7 @@ class FileInputDStream(InputDStream):
 
     def compute(self, t):
         rdds = []
+        scan_ts = _time.time()
         for name in sorted(os.listdir(self.directory)):
             if not self.filter_fn(name):
                 continue
@@ -1288,17 +1997,31 @@ class FileInputDStream(InputDStream):
                 self.offsets[p] = size
         if not rdds:
             return None
-        return rdds[0] if len(rdds) == 1 else self.ssc.ctx.union(rdds)
+        out = rdds[0] if len(rdds) == 1 else self.ssc.ctx.union(rdds)
+        if self.stamp_arrival:
+            out = out.map(_ArrivalStamp(scan_ts))
+        return out
 
 
 class SocketInputDStream(InputDStream):
     """TCP line reader: a background thread accumulates lines; each batch
-    drains the buffer (reference: socketTextStream)."""
+    drains the buffer (reference: socketTextStream).
 
-    def __init__(self, ssc, hostname, port):
+    CLOCK CONTRACT (ISSUE 10 satellite): with ``stamp_arrival=True``
+    every record is emitted as ``(arrival_ts, line)``.  The arrival
+    time is the RECEIVER thread's wall clock at the moment the line
+    was parsed off the socket — assigned BEFORE batching, so two lines
+    that arrive around a batch boundary keep their true arrival order
+    in their stamps even when the boundary splits them into different
+    batches; stamps are monotonically non-decreasing per stream.  Use
+    it as the watermark plane's event-time source when the wire
+    carries no domain timestamp."""
+
+    def __init__(self, ssc, hostname, port, stamp_arrival=False):
         super().__init__(ssc)
         self.hostname = hostname
         self.port = port
+        self.stamp_arrival = stamp_arrival
         self.buffer = []
         self.lock = threading.Lock()
         self._stop = threading.Event()
@@ -1318,9 +2041,11 @@ class SocketInputDStream(InputDStream):
                 for line in f:
                     if self._stop.is_set():
                         break
+                    rec = line.rstrip(b"\r\n").decode("utf-8", "replace")
+                    if self.stamp_arrival:
+                        rec = (_time.time(), rec)
                     with self.lock:
-                        self.buffer.append(
-                            line.rstrip(b"\r\n").decode("utf-8", "replace"))
+                        self.buffer.append(rec)
                 sock.close()
             except OSError:
                 if self._stop.wait(0.5):

@@ -1,0 +1,297 @@
+"""Pane-tree windowing (ISSUE 10 tentpole): shared machinery behind
+the sliding-window DStreams in dstream.py.
+
+The decomposition is "Partial Partial Aggregates" (PAPERS.md): a
+window of w = window/slide panes shares slide-sized PARTIAL
+aggregates across consecutive window instances instead of re-reducing
+the whole window every slide.  Each pane is one cached reduced RDD —
+on the tpu master its shuffle output stays HBM-resident between ticks
+(the SegMapOp-era device shuffle store), so the per-tick cost is the
+merge work, not recompute:
+
+  invertible ops      window' = prev + new pane - expired pane: O(1)
+                      panes per slide (ReducedWindowedDStream)
+  non-invertible ops  the window's pane range decomposes into at most
+                      ~2*log2(w) ALIGNED dyadic blocks; each block's
+                      merge is built once, cached, and reused while
+                      any later window covers it (MergeTree below) —
+                      O(log w) merged branches per tick, amortized
+                      O(1) node builds per pane
+
+Fault story: a pane is an ordinary cached reduced RDD, so a lost
+shuffle bucket under `DPARK_FAULTS` recovers through the standard
+planes — coded-shuffle decode (DPARK_SHUFFLE_CODE) or lineage — and
+NEVER forces a whole-window recompute: only the lost pane's stage is
+touched (chaos cell in tests/test_dstream.py).
+
+Event time: `Watermark` tracks the max observed event timestamp; the
+watermark trails it by the allowed lateness.  Late records inside the
+bound patch ONLY their pane (the window update folds the patch delta
+in; the merge tree invalidates just the O(log w) nodes covering that
+pane); older records drop, counted per stream.  The admission buffer
+is bounded (conf.STREAM_LATE_BUFFER_ROWS).
+
+Every pane stream registers a live stats dict here; the web UI's
+/api/streams and the /metrics stream gauges read `stream_stats()`.
+"""
+
+import itertools
+import math
+import threading
+
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("panes")
+
+_INF = float("inf")
+
+
+# ---------------------------------------------------------------------------
+# dyadic pane-range decomposition
+# ---------------------------------------------------------------------------
+
+def dyadic_blocks(lo, hi, max_size=None):
+    """Aligned power-of-two blocks covering the inclusive pane-index
+    range [lo, hi]: each block (start, size) has size a power of two,
+    start % size == 0, and consecutive windows share most blocks — a
+    block is built once ever and reused while any window covers it.
+    At most ~2*log2(hi-lo+1) blocks.  `max_size` caps the block size
+    (a node bigger than the window itself can never be reused)."""
+    assert lo >= 0 and hi >= lo, (lo, hi)
+    out = []
+    i = lo
+    while i <= hi:
+        size = (i & -i) if i else 1 << 60
+        if max_size:
+            size = min(size, max_size)
+        while i + size - 1 > hi:
+            size >>= 1
+        out.append((i, size))
+        i += size
+    return out
+
+
+class MergeTree:
+    """Cache of dyadic pane-merge nodes for a non-invertible window.
+
+    `get_pane(idx)` returns the pane partial (an RDD) or None;
+    `merge(rdds, level, start)` combines children into one node RDD
+    (the caller supplies the union+reduce and does its own caching
+    side effects).  `cover(lo, hi)` returns the O(log w) node RDDs for
+    a window's pane range, building missing nodes bottom-up (each
+    build merges exactly its two half-size children, so a pane
+    participates in at most log2(w) builds over its lifetime).
+
+    Late-data patches call `invalidate(idx)`: only the nodes covering
+    that pane (one per level) drop; the next cover rebuilds them."""
+
+    def __init__(self, get_pane, merge):
+        self.get_pane = get_pane
+        self.merge = merge
+        self.nodes = {}                # (start, size) -> rdd or None
+        self._owned = set()            # keys whose rdd THIS tree built
+        self.builds = 0                # merge nodes built (stats)
+
+    def _node(self, start, size):
+        if size == 1:
+            return self.get_pane(start)
+        key = (start, size)
+        if key in self.nodes:
+            return self.nodes[key]
+        half = size // 2
+        kids = [self._node(start, half), self._node(start + half, half)]
+        kids = [k for k in kids if k is not None]
+        if not kids:
+            rdd = None
+        elif len(kids) == 1:
+            rdd = kids[0]              # empty half: the node IS its child
+        else:
+            rdd = self.merge(kids, size, start)
+            self._owned.add(key)       # dropping may unpersist this one
+            self.builds += 1
+        self.nodes[key] = rdd
+        return rdd
+
+    def cover(self, lo, hi, max_size=None):
+        """Node RDDs covering panes [lo, hi] (Nones filtered)."""
+        out = []
+        for start, size in dyadic_blocks(lo, hi, max_size):
+            rdd = self._node(start, size)
+            if rdd is not None:
+                out.append(rdd)
+        return out
+
+    def invalidate(self, idx):
+        """Drop every cached node covering pane `idx` (<= 1 per level,
+        so a late patch costs O(log w) rebuilds, not a tree rebuild)."""
+        for start, size in list(self.nodes):
+            if start <= idx < start + size:
+                self._drop((start, size))
+
+    def forget(self, before_idx):
+        """Drop nodes that end before `before_idx` (window + lateness
+        horizon): their panes can never be covered again."""
+        for start, size in list(self.nodes):
+            if start + size - 1 < before_idx:
+                self._drop((start, size))
+
+    def _drop(self, key):
+        rdd = self.nodes.pop(key)
+        # only unpersist rdds this tree BUILT: a single-child node
+        # shares identity with a pane (or a lower node) that may still
+        # be live in the window
+        if key in self._owned:
+            self._owned.discard(key)
+            if rdd is not None and getattr(rdd, "should_cache", False):
+                rdd.unpersist()
+
+
+# ---------------------------------------------------------------------------
+# event-time watermarks
+# ---------------------------------------------------------------------------
+
+class Watermark:
+    """Bounded-delay event-time watermark: trails the max OBSERVED
+    event timestamp by `lateness` seconds.  Admission is gated on the
+    watermark as of the PREVIOUS tick (update() runs after the tick's
+    records were classified), the standard micro-batch contract — a
+    batch can never retro-tighten the bound on its own records."""
+
+    def __init__(self, lateness):
+        self.lateness = float(lateness)
+        self.max_event_ts = None
+
+    def floor(self):
+        """Records with event ts below this drop."""
+        if self.max_event_ts is None:
+            return -_INF
+        return self.max_event_ts - self.lateness
+
+    def value(self):
+        return None if self.max_event_ts is None else self.floor()
+
+    def update(self, mx):
+        if mx is not None and (self.max_event_ts is None
+                               or mx > self.max_event_ts):
+            self.max_event_ts = mx
+
+    def lag(self, t):
+        """Processing-time distance from tick `t` back to the
+        watermark (how far completed event time trails the clock)."""
+        if self.max_event_ts is None:
+            return None
+        return max(0.0, t - self.floor())
+
+
+def pane_back_index(ts, t, slide):
+    """How many panes BEFORE the pane ending at `t` the event
+    timestamp `ts` belongs to: 0 = the current pane (ts in (t-slide,
+    t], and future timestamps clamp to 0), k >= 1 = the pane ending at
+    t - k*slide.  The single shared assignment rule — the scan job and
+    the pane filters both use it, so counts and contents cannot
+    drift."""
+    if ts > t:
+        return 0                      # ahead of the clock: current pane
+    # pane b covers (t-(b+1)*slide, t-b*slide]: b = floor((t-ts)/slide),
+    # nudged UP so an exact pane-boundary timestamp (ts == t-b*slide,
+    # which belongs to pane b) survives float error in either direction
+    return int(math.floor((t - ts) / slide + 1e-9))
+
+
+class _EventScan:
+    """Per-partition classifier for the tick's new records (picklable
+    task function): returns (max_ts, on_time_rows, {back: late_rows},
+    dropped_rows) under the PREVIOUS watermark floor."""
+
+    def __init__(self, ts_fn, t, slide, max_back, floor):
+        self.ts_fn = ts_fn
+        self.t = t
+        self.slide = slide
+        self.max_back = max_back
+        self.floor = floor
+
+    def __call__(self, it):
+        mx = None
+        on_time = dropped = 0
+        late = {}
+        for rec in it:
+            ts = self.ts_fn(rec)
+            if mx is None or ts > mx:
+                mx = ts
+            back = pane_back_index(ts, self.t, self.slide)
+            if back <= 0:
+                on_time += 1
+            elif back <= self.max_back and ts >= self.floor:
+                late[back] = late.get(back, 0) + 1
+            else:
+                dropped += 1
+        return [(mx, on_time, late, dropped)]
+
+
+def event_scan(rdd, ts_fn, t, slide, max_back, floor):
+    """One small driver job over the tick's new data: fold the
+    per-partition classifications into (max_ts, on_time, {back:
+    rows}, dropped)."""
+    parts = rdd.ctx.runJob(rdd, _EventScan(ts_fn, t, slide, max_back,
+                                           floor))
+    mx, on_time, dropped = None, 0, 0
+    late = {}
+    for rows in parts:
+        for pmx, pon, plate, pdrop in rows:
+            if pmx is not None and (mx is None or pmx > mx):
+                mx = pmx
+            on_time += pon
+            dropped += pdrop
+            for back, n in plate.items():
+                late[back] = late.get(back, 0) + n
+    return mx, on_time, late, dropped
+
+
+class _PaneFilter:
+    """Predicate selecting the records of ONE pane (picklable): back
+    index equality under the shared assignment rule, plus the
+    watermark floor for late panes."""
+
+    def __init__(self, ts_fn, t, slide, back, floor):
+        self.ts_fn = ts_fn
+        self.t = t
+        self.slide = slide
+        self.back = back
+        self.floor = floor
+
+    def __call__(self, rec):
+        ts = self.ts_fn(rec)
+        if pane_back_index(ts, self.t, self.slide) != self.back:
+            return False
+        return self.back == 0 or ts >= self.floor
+
+
+# ---------------------------------------------------------------------------
+# live per-stream stats registry (web UI /api/streams, /metrics gauges)
+# ---------------------------------------------------------------------------
+
+_REG_LOCK = threading.Lock()
+_REGISTRY = {}
+_ids = itertools.count(1)
+
+
+def new_stream_id(kind):
+    return "%s-%d" % (kind, next(_ids))
+
+
+def register_stream(sid, stats):
+    """Expose a stream's live stats dict (the stream mutates it in
+    place per tick; readers snapshot under the lock)."""
+    with _REG_LOCK:
+        _REGISTRY[sid] = stats
+
+
+def unregister_stream(sid):
+    with _REG_LOCK:
+        _REGISTRY.pop(sid, None)
+
+
+def stream_stats():
+    """Snapshot of every registered pane stream's stats."""
+    with _REG_LOCK:
+        return {sid: dict(st) for sid, st in _REGISTRY.items()}

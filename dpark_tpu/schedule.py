@@ -304,6 +304,14 @@ class DAGScheduler:
                          "shuffle": stage.is_shuffle_map,
                          "parents": [p.id for p in stage.parents],
                          "started": now})
+            # pane-plane attribution (ISSUE 10): windowed DStreams tag
+            # the RDDs they build ({stream, role, pane} — pane-build /
+            # tree-merge / late-patch / window-emit), so a stage's
+            # cost lands on the pane-plane role that caused it in the
+            # web UI and trace analysis
+            stream_tag = getattr(stage.rdd, "_stream_tag", None)
+            if stream_tag:
+                info["stream"] = dict(stream_tag)
             logger.debug("submit stage %s with %d tasks", stage, len(tasks))
             in_flight[0] += len(tasks)
             if trace._PLANE is not None:
@@ -366,6 +374,11 @@ class DAGScheduler:
                   # per-stage timings
                   "lint": list(getattr(final_rdd, "_lint_findings",
                                        ()) or ())}
+        # pane-plane job attribution (ISSUE 10): a job collecting a
+        # windowed stream's emitted RDD carries that stream's tag
+        stream_tag = getattr(final_rdd, "_stream_tag", None)
+        if stream_tag:
+            record["stream"] = dict(stream_tag)
         # coded-shuffle decode accounting (ISSUE 6): counters are
         # process-global, so each job snapshots a baseline at start
         # and takes the delta at finish (popped before the record

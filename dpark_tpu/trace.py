@@ -49,6 +49,15 @@ Span taxonomy (name / cat):
     dcn.connect,             "dcn"     peer connects / request bytes
     dcn.transfer
     adapt.decision           "adapt"   cost-model choices
+    stream.batch             "stream"  one micro-batch tick of an
+                                       output chain (driver side)
+    stream.pane.build,       "stream"  pane-plane lifecycle (ISSUE
+    stream.tree.merge,                 10): pane partials built, merge
+    stream.late.patch,                 -tree nodes merged, late-data
+    stream.window.emit                 pane patches, and the per-tick
+                                       window emit with its branch
+                                       count (instant events keyed by
+                                       stream id + pane index)
     process.counters         "counters" cumulative per-process fault/
                                        decode counters (the merge
                                        substrate, see

@@ -130,6 +130,37 @@ def render_metrics(scheduler):
     metric("dpark_export_seconds_total", "counter",
            "cumulative host-bridge export wall seconds",
            [({}, round(float(snap.get("export_seconds", 0.0)), 6))])
+    # pane-plane stream gauges (ISSUE 10): live per-windowed-stream
+    # state from the panes registry — resident pane partials, merge
+    # activity, watermark lag, and late-record accounting
+    try:
+        from dpark_tpu import panes as panes_mod
+        sstats = panes_mod.stream_stats()
+    except Exception:
+        sstats = {}
+    rows = sorted(sstats.items())
+    metric("dpark_stream_panes", "gauge",
+           "resident pane partial aggregates per windowed stream",
+           [({"stream": s}, st.get("panes", 0)) for s, st in rows]
+           or [({"stream": "none"}, 0)])
+    metric("dpark_stream_pane_merges_total", "counter",
+           "pane merge-tree nodes built per windowed stream",
+           [({"stream": s}, st.get("node_builds", 0))
+            for s, st in rows] or [({"stream": "none"}, 0)])
+    metric("dpark_stream_watermark_lag_seconds", "gauge",
+           "processing-time distance back to the event-time watermark",
+           [({"stream": s}, round(st["watermark_lag_s"], 6))
+            for s, st in rows
+            if st.get("watermark_lag_s") is not None]
+           or [({"stream": "none"}, 0)])
+    metric("dpark_stream_late_dropped_total", "counter",
+           "late records dropped below the watermark / buffer bound",
+           [({"stream": s}, st.get("late_dropped", 0))
+            for s, st in rows] or [({"stream": "none"}, 0)])
+    metric("dpark_stream_late_patched_rows_total", "counter",
+           "admitted late records folded into pane patches",
+           [({"stream": s}, st.get("late_patched_rows", 0))
+            for s, st in rows] or [({"stream": "none"}, 0)])
     # phase-seconds histograms: one observation per streamed stage per
     # phase, pre-folded (with the trimmed-history archive) by
     # metrics_snapshot so the series stay monotonic
@@ -182,8 +213,14 @@ _PAGE = """<!doctype html>
 <th>HBM bytes</th><th>wire bytes</th><th>pad eff</th>
 <th>waves</th><th>idle %</th><th>pipeline ms (in/cmp/xchg/spill)</th>
 <th>decodes</th>
+<th>stream</th>
 <th>fallback / degrade</th>
 </tr></table>
+<h2>streams <small>(pane plane: windowed DStreams)</small></h2>
+<table id="w"><tr><th>stream</th><th>type</th><th>mode</th>
+<th>window/slide</th><th>panes</th><th>nodes (built)</th>
+<th>watermark lag s</th><th>late rows (patched/dropped)</th>
+<th>patches</th><th>ticks</th></tr></table>
 <div id="dags"></div>
 <h2>profile</h2>
 <pre id="prof">(run with --profile)</pre>
@@ -276,10 +313,17 @@ async function tick() {
       const sdec = Object.keys(ds).length
         ? (ds.repair || 0) + '/' + (ds.straggler_win || 0) + '/' +
           (ds.decode_failures || 0) : '';
+      // pane-plane attribution (ISSUE 10): which stream + role
+      // (pane-build / tree-merge / late-patch / window-emit) this
+      // stage served, with the pane index when one applies
+      const sw = st.stream || {};
+      const srole = sw.stream
+        ? sw.stream + ' ' + (sw.role || '') +
+          (sw.pane !== undefined ? ' #' + sw.pane : '') : '';
       for (const v of [j.id, st.id, st.rdd, st.parts, st.kind,
                        st.seconds, st.run_seconds, st.hbm_bytes,
                        st.wire_bytes, st.pad_efficiency,
-                       p.waves, idle, pms, sdec, why])
+                       p.waves, idle, pms, sdec, srole, why])
         sr.insertCell().textContent = v === undefined ? '' : v;
       // span timeline link (ISSUE 8): the stage's job timeline from
       // the trace plane ring/spool via /api/trace
@@ -293,10 +337,28 @@ async function tick() {
       };
       if (open.has(key)) {
         const dr = s.insertRow();
-        const c = dr.insertCell(); c.colSpan = 15;
+        const c = dr.insertCell(); c.colSpan = 16;
         c.className = 'tasks'; c.innerHTML = taskRows(st);
       }
     }
+  }
+  // pane-plane streams (ISSUE 10): live pane counts, watermark lag,
+  // late-record accounting per windowed stream
+  const wr = await fetch('/api/streams'); const streams = await wr.json();
+  const w = document.getElementById('w');
+  while (w.rows.length > 1) w.deleteRow(1);
+  for (const sid of Object.keys(streams).sort()) {
+    const st = streams[sid];
+    const row = w.insertRow();
+    const lag = st.watermark_lag_s === null ||
+                st.watermark_lag_s === undefined
+      ? '' : st.watermark_lag_s.toFixed(3);
+    for (const v of [sid, st.type, st.mode,
+                     st.window + '/' + st.slide, st.panes,
+                     st.nodes + ' (' + st.node_builds + ')', lag,
+                     st.late_patched_rows + '/' + st.late_dropped,
+                     st.late_patches, st.ticks])
+      row.insertCell().textContent = v === undefined ? '' : v;
   }
   const pr = await fetch('/api/profile');
   document.getElementById('prof').textContent = await pr.text();
@@ -345,6 +407,15 @@ def start_ui(scheduler, host="127.0.0.1", port=0):
                 body = json.dumps(
                     {"mode": trace_mod.mode(), "job": job,
                      "spans": recs}).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/streams"):
+                # pane-plane live stats (ISSUE 10): one row per
+                # windowed stream from the panes registry
+                try:
+                    from dpark_tpu import panes as panes_mod
+                    body = json.dumps(panes_mod.stream_stats()).encode()
+                except Exception:
+                    body = b"{}"
                 ctype = "application/json"
             elif self.path.startswith("/api/profile"):
                 prof = getattr(scheduler, "profile", None)

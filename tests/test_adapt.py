@@ -621,3 +621,81 @@ def test_adapt_stale_hint_lint_rule(tmp_path, ctx):
     # off mode: always quiet
     adapt.configure(mode="off", store_dir=str(tmp_path / "l1"))
     assert "adapt-stale-hint" not in rules(lint_plan(r))
+
+
+# ---------------------------------------------------------------------------
+# decision point 5: pane-tree split points (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+def test_pane_cost_record_and_steer():
+    """record_pane_cost persists per-(site, mode) EMA tick costs;
+    steer_pane_mode picks the observed-cheaper strategy only in `on`
+    mode and only with BOTH strategies on record."""
+    adapt.configure(mode="on", store_dir=adapt.store_dir())
+    site = "pane-site-1"
+    # no history: static default wins either way
+    assert adapt.steer_pane_mode(site, 16, True) is True
+    assert adapt.steer_pane_mode(site, 16, False) is False
+    adapt.record_pane_cost(site, "tree", 120.0, 16)
+    # one-sided history: still static
+    assert adapt.steer_pane_mode(site, 16, False) is False
+    adapt.record_pane_cost(site, "flat", 40.0, 16)
+    # both observed: flat is cheaper, overriding the static tree
+    assert adapt.steer_pane_mode(site, 16, True) is False
+    decs = [d for d in adapt.summary()["decisions"]
+            if d["point"] == "pane_split"]
+    assert decs and decs[-1]["choice"] == "flat" and decs[-1]["applied"]
+    ent = adapt.pane_history()[site]
+    assert ent["tree_ms"] == 120.0 and ent["flat_ms"] == 40.0
+    assert ent["w"] == 16
+
+
+def test_pane_cost_observe_mode_never_steers():
+    adapt.configure(mode="observe", store_dir=adapt.store_dir())
+    site = "pane-site-2"
+    adapt.record_pane_cost(site, "tree", 10.0, 8)
+    adapt.record_pane_cost(site, "flat", 90.0, 8)
+    # observed says tree, static says flat: observe keeps static and
+    # logs the would-be as applied=False
+    assert adapt.steer_pane_mode(site, 8, False) is False
+    decs = [d for d in adapt.summary()["decisions"]
+            if d["point"] == "pane_split"]
+    assert decs and decs[-1]["applied"] is False
+
+
+def test_pane_cost_round_trips_store(tmp_path):
+    """Pane records survive reload in a fresh process-equivalent
+    (configure resets the in-memory plane)."""
+    store = str(tmp_path / "pane-store")
+    adapt.configure(mode="on", store_dir=store)
+    adapt.record_pane_cost("s", "tree", 55.0, 32)
+    adapt.record_pane_cost("s", "flat", 11.0, 32)
+    adapt.configure(mode="on", store_dir=store)     # reload from disk
+    assert adapt.steer_pane_mode("s", 32, True) is False
+
+
+def test_pane_stream_samples_cost(monkeypatch, tmp_path):
+    """An end-to-end pane stream records ONE pane-cost line (median of
+    post-warmup ticks) keyed by a cross-process-stable site."""
+    import operator
+    from dpark_tpu import DparkContext
+    from dpark_tpu.dstream import StreamingContext
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "ps"))
+    monkeypatch.setattr(conf, "STREAM_PANES", True)
+    c = DparkContext("local")
+    ssc = StreamingContext(c, 1.0)
+    out = []
+    q = ssc.queueStream([[("k", j)] for j in range(10)])
+    q.reduceByKeyAndWindow(operator.add, 4.0,
+                           invFunc=operator.sub).collect_batches(out)
+    ssc.ctx.start()
+    for ins in ssc.input_streams:
+        ins.start()
+    ssc.zero_time = 1000.0
+    for k in range(1, 11):
+        ssc.run_batch(1000.0 + k)
+    c.stop()
+    hist = adapt.pane_history()
+    assert len(hist) == 1
+    ent = next(iter(hist.values()))
+    assert ent.get("inv_ms") is not None and ent["w"] == 4

@@ -270,6 +270,77 @@ def main():
         print("FAIL: groupmap device side left the array path: %r"
               % gm[0])
         return 1
+    # ISSUE 10: the pane-plane stream section — the dstream window
+    # line (when the child ran) must carry pane accounting, and
+    # benchmarks/stream_rate.py --smoke must emit both the sustained-
+    # ingest line (records/s at a fixed p99 batch-latency budget) and
+    # the window-scaling A/B with all three series.  Wall ratios are
+    # not graded here (CI boxes are too noisy; the acceptance numbers
+    # live in BENCH_*.json) — but the schema and the pane-mode
+    # indicators are: a refactor that silently drops the pane path
+    # reports mode != inv/tree/flat and fails.
+    ds = [p for p in parsed
+          if str(p.get("metric", "")).startswith("dstream_window")]
+    if ds and not isinstance(ds[0].get("panes"), dict):
+        print("FAIL: dstream_window line carries no panes dict: %r"
+              % sorted(ds[0]))
+        return 1
+    sproc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "benchmarks", "stream_rate.py"), "--smoke"],
+        capture_output=True, text=True, env=env,
+        timeout=int(env.get("BENCH_SMOKE_TIMEOUT", "1500")))
+    sys.stderr.write(sproc.stderr[-2000:])
+    print(sproc.stdout)
+    if sproc.returncode != 0:
+        print("FAIL: stream_rate.py exited %d" % sproc.returncode)
+        return 1
+    sparsed = []
+    for ln in sproc.stdout.splitlines():
+        if ln.startswith("{"):
+            try:
+                sparsed.append(json.loads(ln))
+            except ValueError as e:
+                print("FAIL: unparseable stream_rate JSON %r: %s"
+                      % (ln[:120], e))
+                return 1
+    scale = [p for p in sparsed
+             if p.get("metric") == "stream_window_scaling"]
+    if not scale:
+        print("FAIL: no stream_window_scaling line")
+        return 1
+    for field in ("ratios", "pane_ms", "inv_ms", "old_ms",
+                  "pane_growth", "inv_growth", "old_growth"):
+        if field not in scale[0]:
+            print("FAIL: scaling line missing %r (got %r)"
+                  % (field, sorted(scale[0])))
+            return 1
+    if len(scale[0]["pane_ms"]) != len(scale[0]["ratios"]) \
+            or len(scale[0]["inv_ms"]) != len(scale[0]["ratios"]):
+        print("FAIL: scaling series/ratio length mismatch: %r"
+              % scale[0])
+        return 1
+    rate = [p for p in sparsed if p.get("metric") == "stream_rate"]
+    if not rate:
+        print("FAIL: no stream_rate line")
+        return 1
+    for field in ("value", "p99_batch_ms", "batch_s", "target_p99_ms",
+                  "sustained", "rates_tried", "panes"):
+        if field not in rate[0]:
+            print("FAIL: stream_rate line missing %r (got %r)"
+                  % (field, sorted(rate[0])))
+            return 1
+    if rate[0].get("panes", {}).get("mode") not in ("inv", "tree",
+                                                    "flat", "pane"):
+        print("FAIL: stream_rate drove a non-pane window (mode=%r)"
+              % rate[0].get("panes", {}).get("mode"))
+        return 1
+    print("OK stream: rate=%.0f records/s (p99 %.0fms <= %.0fms: %s) "
+          "scaling pane/inv/old growth=%.2f/%.2f/%.2f"
+          % (rate[0]["value"], rate[0]["p99_batch_ms"],
+             rate[0]["target_p99_ms"], rate[0]["sustained"],
+             scale[0]["pane_growth"], scale[0]["inv_growth"],
+             scale[0]["old_growth"]))
     print("OK: %d JSON lines, ooc pipeline+phases fields present "
           "(waves=%d idle=%.3f depth=%d donated=%s narrow=%.0fms "
           "fallbacks=%d groupmap=%.1fx coded=%.2fx adapt cold/warm "
