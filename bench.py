@@ -626,6 +626,122 @@ def _coded_phase():
         flush=True)
 
 
+_BULK_PEER_SCRIPT = r'''
+import os, sys, time
+import numpy as np
+n, wd = int(sys.argv[1]), sys.argv[2]
+from dpark_tpu import shuffle as sm
+from dpark_tpu.dcn import BucketServer
+i = np.arange(n, dtype=np.int64)
+keys = (i * 2654435761) % 100003
+vals = i & 0xFFFF
+# rows are materialized ONCE (conservative: the real bridge rebuilds
+# them from device slices per fetch) — the bridge still pays
+# pickle+compress per request, which is its real per-byte cost
+rows = list(zip(keys.tolist(), vals.tolist()))
+sm.HBM_EXPORTERS["bench"] = lambda sid, m, r, shard=None: rows
+sm.HBM_COL_EXPORTERS["bench"] = \
+    lambda sid, m, r: ({"no_combine": False}, [keys, vals])
+srv = BucketServer(wd, host="127.0.0.1").start()
+print("ADDR %s" % srv.addr, flush=True)
+time.sleep(600)
+'''
+
+
+def _bulk_phase():
+    """Child-process entry: bulk-channel vs pickled-bridge A/B
+    (ISSUE 12 acceptance).  A PEER PROCESS serves the same
+    HBM-shaped bucket both ways over same-box loopback: the bridge
+    path (single-frame ``("bucket", ...)`` — server pickles rows,
+    client unpickles then re-columnarizes) vs the bulk path (chunked
+    ``bulk_bucket`` stream of RAW COLUMN BYTES assembled zero-copy).
+    Both sides end at numpy columns on the receiving controller;
+    bytes/s is logical column bytes over the median fetch, p99 over
+    the rep distribution.  Acceptance: bulk >= 2x the bridge's
+    bytes/s."""
+    import pickle
+    import statistics
+    import subprocess
+    import tempfile
+
+    import numpy as np
+    from dpark_tpu import bulkplane, dcn
+    from dpark_tpu.utils import decompress
+    n = int(os.environ.get("BENCH_BULK_ROWS", "2000000"))
+    reps = max(3, int(os.environ.get("BENCH_BULK_REPS", "9")))
+    tmp = tempfile.mkdtemp(prefix="dpark-bulk-ab-")
+    script = os.path.join(tmp, "peer.py")
+    with open(script, "w") as f:
+        f.write(_BULK_PEER_SCRIPT)
+    here = os.path.dirname(os.path.abspath(__file__))
+    child_env = dict(os.environ)
+    child_env["PYTHONPATH"] = here + os.pathsep + \
+        child_env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, script, str(n), tmp],
+        stdout=subprocess.PIPE, text=True, env=child_env)
+    try:
+        addr = proc.stdout.readline().split()[1]
+        logical = n * 16                      # two int64 columns
+
+        def bridge_fetch():
+            payload = dcn.fetch(addr, ("bucket", 0, 0, 0))
+            items = pickle.loads(decompress(payload))
+            ks = np.fromiter((kv[0] for kv in items), dtype=np.int64,
+                             count=len(items))
+            vs = np.fromiter((kv[1] for kv in items), dtype=np.int64,
+                             count=len(items))
+            return ks, vs, items
+
+        def bulk_fetch():
+            meta, view = bulkplane.fetch(addr,
+                                         ("bulk_bucket", 0, 0, 0))
+            return bulkplane.cols_from_buf(meta, view)
+
+        # warm both paths (connects, page cache, the peer's pickle of
+        # rows is per-request by design), then verify BIT-PARITY
+        bks, bvs, items = bridge_fetch()
+        cols = bulk_fetch()
+        parity = (list(zip(cols[0].tolist(), cols[1].tolist()))
+                  == items
+                  and bks.tolist() == cols[0].tolist()
+                  and bvs.tolist() == cols[1].tolist())
+        t_bridge, t_bulk = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            bridge_fetch()
+            t_bridge.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            bulk_fetch()
+            t_bulk.append(time.perf_counter() - t0)
+
+        def p99(ts):
+            s = sorted(ts)
+            return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+        bridge_bps = logical / statistics.median(t_bridge)
+        bulk_bps = logical / statistics.median(t_bulk)
+        out = {"rows": n, "reps": reps,
+               "logical_mb": round(logical / 1e6, 1),
+               "bridge_MBps": round(bridge_bps / 1e6, 1),
+               "bulk_MBps": round(bulk_bps / 1e6, 1),
+               "ratio": round(bulk_bps / max(bridge_bps, 1e-9), 2),
+               "p50_bridge_ms": round(
+                   statistics.median(t_bridge) * 1e3, 1),
+               "p50_bulk_ms": round(
+                   statistics.median(t_bulk) * 1e3, 1),
+               "p99_bridge_ms": round(p99(t_bridge) * 1e3, 1),
+               "p99_bulk_ms": round(p99(t_bulk) * 1e3, 1),
+               "parity": bool(parity),
+               "bulk_streams": bulkplane.stats()["streams"]}
+        print("BULKPLANE_RESULT %s" % json.dumps(out), flush=True)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _adapt_phase():
     """Child-process entry: adaptive-execution warm-vs-cold A/B
     (ISSUE 7 acceptance) — the streamed sortgroup config run twice
@@ -927,6 +1043,9 @@ def main():
     if "--coded-only" in sys.argv:
         _coded_phase()
         return
+    if "--bulk-only" in sys.argv:
+        _bulk_phase()
+        return
     if "--adapt-only" in sys.argv:
         _adapt_phase()
         return
@@ -1110,6 +1229,29 @@ def main():
                     "pairs": c["pairs"],
                     "coding": c["decodes"]}
             print(json.dumps(cout))
+    # bulk-channel vs pickled-bridge A/B (ISSUE 12 acceptance): the
+    # same HBM-shaped bucket fetched cross-process over loopback both
+    # ways — the chunked raw-column bulk stream must move >= 2x the
+    # bytes/s of the single-frame pickled host bridge, with fetch p99
+    # for both recorded
+    if os.environ.get("BENCH_BULK", "1") != "0":
+        got = _run_child("--bulk-only", child_timeout,
+                         ok_prefix="BULKPLANE_RESULT ")
+        if got is not None:
+            b = json.loads(got)
+            bout = {"metric": "bulk_channel_vs_bridge",
+                    "value": b["ratio"],
+                    "unit": "x bytes/s (higher is better; >=2 passes)",
+                    "bridge_MBps": b["bridge_MBps"],
+                    "bulk_MBps": b["bulk_MBps"],
+                    "p99_bridge_ms": b["p99_bridge_ms"],
+                    "p99_bulk_ms": b["p99_bulk_ms"],
+                    "p50_bridge_ms": b["p50_bridge_ms"],
+                    "p50_bulk_ms": b["p50_bulk_ms"],
+                    "rows": b["rows"], "reps": b["reps"],
+                    "parity": b["parity"],
+                    "bulk_streams": b["bulk_streams"]}
+            print(json.dumps(bout))
     # adaptive-execution warm-vs-cold A/B (ISSUE 7 acceptance): the
     # streamed sortgroup/groupmap config run twice with DPARK_ADAPT=on
     # against a deterministic emulated HBM ceiling — the warm run must

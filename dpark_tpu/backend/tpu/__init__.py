@@ -17,6 +17,32 @@ from dpark_tpu.utils.log import get_logger
 logger = get_logger("tpu")
 
 
+# the known XLA:CPU capability gap (PR 2 notes): collective/aliasing
+# programs over a PROCESS-SPANNING mesh raise "Multiprocess
+# computations aren't implemented on the CPU backend".  Real TPU/GPU
+# pods implement them; a CPU-emulated multi-controller run records
+# this as the stage's fallback_reason and serves the job through the
+# object path instead of dying on a raw assert (ISSUE 12 satellite).
+SPMD_CPU_FALLBACK = ("multi-controller SPMD unsupported on the CPU "
+                     "backend (XLA:CPU implements no cross-process "
+                     "computations); object path")
+
+
+def _multiproc_cpu_gap(e):
+    """Is this the CPU backend refusing a cross-process computation
+    (a CAPABILITY gap, not a runtime fault)?  Matched by message so
+    every jax version's concrete error type classifies."""
+    for exc in (e, getattr(e, "__cause__", None)):
+        if exc is None:
+            continue
+        text = str(exc)
+        if "Multiprocess computations" in text:
+            return True
+        if "implemented" in text and "CPU backend" in text:
+            return True
+    return False
+
+
 def _device_error(e):
     """Is this a device RUNTIME error (XlaRuntimeError, HBM
     RESOURCE_EXHAUSTED) — the class the stage-level degradation ladder
@@ -129,13 +155,20 @@ class TPUScheduler(DAGScheduler):
             # processes all partitions, so replaying it for one failed
             # task would redo the whole stage
             with self._analyze_lock:
+                analysis_gap = False
                 try:
                     plan = fuse.analyze_stage(stage, self.executor.ndev,
                                               self.executor)
                 except Exception as e:
                     logger.debug("analysis failed for %s: %s", stage, e)
+                    analysis_gap = _multiproc_cpu_gap(e)
                 reason = None if plan is not None \
                     else fuse.last_fallback_reason()
+                if plan is None and not reason and analysis_gap:
+                    # the CPU backend's multi-controller gap raised
+                    # during analysis itself: record the capability
+                    # reason, not silence (ISSUE 12 satellite)
+                    reason = SPMD_CPU_FALLBACK
             if plan is None:
                 if reason:
                     # why the plan left the array path (key shape,
@@ -187,6 +220,8 @@ class TPUScheduler(DAGScheduler):
             except Exception as e:
                 logger.debug("cogroup precompute skipped: %s", e)
         all_ok = False
+        from dpark_tpu import bulkplane
+        rx0 = bulkplane.total_received_bytes()
         try:
             statuses = []
             for task in tasks:
@@ -194,6 +229,7 @@ class TPUScheduler(DAGScheduler):
                 statuses.append(status)
                 report(task, status, payload)
             all_ok = all(s == "success" for s in statuses)
+            self._note_remote_fetch(stage.id, rx0)
         finally:
             if precomputed is not None:
                 # free the seeded partitions (unless the USER cached this
@@ -253,6 +289,19 @@ class TPUScheduler(DAGScheduler):
             self._spill_write_failed(stage, tasks, report, e)
             return True
         except Exception as e:
+            if _multiproc_cpu_gap(e):
+                # a CAPABILITY gap, not a runtime fault: the CPU
+                # backend implements no cross-process computations
+                # (pre-existing per PR 2 notes).  Record it as the
+                # stage's fallback_reason — the SPMD dryrun reads it
+                # to SKIP cleanly instead of raw-asserting — and
+                # serve the stage through the object path.
+                logger.warning(
+                    "array path unavailable for %s (%s); object path",
+                    stage, SPMD_CPU_FALLBACK)
+                self.note_stage(stage.id,
+                                fallback_reason=SPMD_CPU_FALLBACK)
+                return False
             if not (conf.DEGRADE and _device_error(e)):
                 logger.warning(
                     "array path failed for %s (%s); object fallback",

@@ -544,6 +544,10 @@ class JAXExecutor:
         # register the host bridge so file-path stages can read HBM shuffles
         from dpark_tpu import shuffle as shuffle_mod
         shuffle_mod.HBM_EXPORTERS[id(self)] = self.export_bucket
+        # columnar twin (ISSUE 12): the bulk data plane serves flat
+        # (k, v) buckets as raw column bytes to peer controllers — no
+        # per-row pickling on the cross-process path
+        shuffle_mod.HBM_COL_EXPORTERS[id(self)] = self.export_bucket_cols
         self._exporter_key = id(self)
         # ONE mesh lock serializes every device-program dispatch path:
         # stage programs (run_stage), device joins/gathers, AND the
@@ -3010,6 +3014,72 @@ class JAXExecutor:
                            _time.time() - t_wall, shuffle=sid,
                            map=map_id, reduce=reduce_id)
 
+    def export_bucket_cols(self, sid, map_id, reduce_id):
+        """Device-resident map output -> (meta, [numpy column arrays])
+        for the bulk data plane (ISSUE 12): a peer controller receives
+        the RAW COLUMN BYTES and assembles them zero-copy into
+        np.frombuffer views / device_put batches — the per-row
+        pickle/unpickle of the host bridge never runs.  Raises
+        KeyError when this executor owns no such shuffle (the server
+        tries the next exporter) and ValueError when the record shape
+        cannot columnarize (encoded keys, spilled host runs, nested
+        records) — the server then falls back to the pickled payload,
+        still chunk-framed on the bulk channel.  The materialized
+        columns are bit-equal sources of the rows export_bucket would
+        have pickled (both sides materialize via .tolist())."""
+        import time as _time
+        t0 = _time.perf_counter()
+        t_wall = _time.time() if trace._PLANE is not None else 0.0
+        try:
+            return self._export_bucket_cols(sid, map_id, reduce_id)
+        finally:
+            self.export_seconds += _time.perf_counter() - t0
+            if trace._PLANE is not None:
+                trace.emit("phase.export", "phase", t_wall,
+                           _time.time() - t_wall, shuffle=sid,
+                           map=map_id, reduce=reduce_id, cols=True)
+
+    def _export_bucket_cols(self, sid, map_id, reduce_id):
+        import jax.tree_util as jtu
+        store = self.shuffle_store.get(sid)
+        if store is None:
+            raise KeyError("no HBM shuffle %d" % sid)
+        if store.get("encoded_keys") or "host_runs" in store \
+                or store.get("single_map"):
+            raise ValueError("store %d cannot columnarize for the "
+                             "bulk plane" % sid)
+        if store["out_treedef"] != jtu.tree_structure((0, 0)):
+            raise ValueError("columnar export needs flat (k, v) "
+                             "records")
+        store["seq"] = self._next_seq()     # least-recently-FETCHED
+        if store.get("pre_reduced"):
+            # device d holds reduce partition d fully combined: the
+            # whole bucket exposes as map 0 (same contract as
+            # _export_bucket)
+            if map_id != 0:
+                return {"no_combine": False}, []
+            with self._export_lock:
+                counts = layout.host_read(store["counts"])
+                cnt = int(counts[reduce_id])
+                if not cnt:
+                    return {"no_combine": False}, []
+                mats = [np.ascontiguousarray(
+                    self._read_dev_slice(l, reduce_id)[:cnt])
+                    for l in store["leaves"]]
+            return {"no_combine": False}, mats
+        wrap = bool(store.get("no_combine"))
+        with self._export_lock:
+            counts = layout.host_read(store["counts"])
+            offsets = layout.host_read(store["offsets"])
+            off = int(offsets[map_id, reduce_id])
+            cnt = int(counts[map_id, reduce_id])
+            if not cnt:
+                return {"no_combine": wrap}, []
+            mats = [np.ascontiguousarray(
+                self._read_dev_slice(l, map_id)[off:off + cnt])
+                for l in store["leaves"]]
+        return {"no_combine": wrap}, mats
+
     # serialized+encoded bucket shards kept for re-fetch; beyond this
     # the oldest buckets drop (re-encoding is cheap vs re-exporting)
     _SHARD_CACHE_BYTES = 64 << 20
@@ -3240,6 +3310,7 @@ class JAXExecutor:
         from dpark_tpu import cache as cache_mod
         from dpark_tpu import shuffle as shuffle_mod
         shuffle_mod.HBM_EXPORTERS.pop(self._exporter_key, None)
+        shuffle_mod.HBM_COL_EXPORTERS.pop(self._exporter_key, None)
         cache_mod.DEVICE_CACHES.pop(self._cache_key, None)
         if self._tracing:
             try:

@@ -48,6 +48,21 @@ def _tracker_for(addr):
     return cli
 
 
+def _fetch_chunk(pool, src, bid, i):
+    """One chunk from one holder — over the bulk data plane
+    (ISSUE 12: the P2P fan-out rides the same chunk-framed channel,
+    per-peer window, and retry schedule as shuffle data) unless
+    disabled or the holder predates the protocol."""
+    from dpark_tpu import conf
+    if conf.BULK_PLANE:
+        from dpark_tpu import bulkplane
+        try:
+            return bulkplane.fetch_bcast(src, bid, i)
+        except bulkplane.BulkUnsupported:
+            pass
+    return pool.fetch(src, ("bcast", bid, i))
+
+
 class Broadcast:
     _next_id = [0]
 
@@ -155,12 +170,12 @@ class Broadcast:
                     if peers:
                         src = random.choice(peers)
                 try:
-                    blob = pool.fetch(src, ("bcast", self.bid, i))
+                    blob = _fetch_chunk(pool, src, self.bid, i)
                 except (IOError, OSError):
                     if src == self._origin:
                         raise              # origin down: unrecoverable
-                    blob = pool.fetch(self._origin,
-                                      ("bcast", self.bid, i))
+                    blob = _fetch_chunk(pool, self._origin,
+                                        self.bid, i)
                 land(i, blob)
         finally:
             pool.close()

@@ -1,0 +1,538 @@
+"""Multi-controller bulk data plane (ISSUE 12 tentpole).
+
+Every cross-process byte in this repo used to funnel through the
+single-frame pickled host bridge: one ``("bucket", ...)`` request, one
+``pickle.dumps`` of Python row tuples, one monolithic response.  Coded
+MapReduce and "Leveraging Coding Techniques for Speeding up Distributed
+Computing" (PAPERS.md) both treat the inter-worker exchange as THE
+dominant distributed cost — this module makes that path real: a
+chunked, crc-framed, streaming byte channel over the existing dcn
+framed transport, with zero-copy assembly into numpy views /
+``device_put`` batches on the receiving controller.
+
+Serving side (``serve``, reached through ``BucketServer._serve`` for
+any ``bulk_*`` request kind):
+
+* ``bulk_bucket`` — a map-output bucket.  Disk buckets stream the file
+  bytes; HBM-resident flat ``(k, v)`` buckets serve RAW COLUMN bytes
+  (``shuffle.HBM_COL_EXPORTERS`` — no per-row pickling, the pickled
+  bridge's dominant cost); anything else falls back to the exporter's
+  pickled payload, still chunk-framed on the bulk channel.
+* ``bulk_shard`` — ONE framed erasure shard (ISSUE 6), so
+  ``read_bucket_any``'s fastest-k-of-n decode race runs
+  process-to-process.  An empty stream is the miss sentinel.
+* ``bulk_bcast`` — one broadcast chunk file (the P2P fan-out rides the
+  same channel).
+
+Fetch side (``fetch`` + the typed helpers): pooled per-peer
+connections, a per-peer concurrency WINDOW
+(``conf.BULK_STREAMS_PER_PEER``), per-frame crc verification BEFORE
+any byte is interpreted, and bounded retry with the dcn connect path's
+exponential-full-jitter backoff (``dcn.backoff_delays`` — one
+implementation, two call sites).  A torn stream (peer death
+mid-transfer) or a crc-rejected frame costs a re-read on a fresh
+connection, then surfaces as the transport error the shuffle layer
+already translates into FetchFailed.  The ``dcn.transfer`` chaos site
+fires per chunk on BOTH sides, so mid-stream connection loss and frame
+corruption are deterministically injectable (tests/test_bulkplane.py).
+
+Observability: per-peer bytes sent/received counters, an
+active-stream gauge, and retry/corrupt/torn counters (``stats()`` —
+/metrics exports them); every fetch and serve is a ``dcn.bulk.*``
+trace span, DISTINCT from the plain protocol's ``dcn.transfer`` spans,
+which is how the 2-process parity suite asserts the hot path never
+touched the pickled bridge.
+
+With ``conf.BULK_PLANE`` off nothing here is imported on the hot path.
+"""
+
+import os
+import pickle
+import threading
+import time
+
+from dpark_tpu import dcn
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("bulkplane")
+
+
+class BulkUnsupported(Exception):
+    """The peer does not speak the bulk protocol (an old server's
+    'unknown request' error).  Callers fall back to the plain
+    single-frame protocol for this request — never retried here."""
+
+
+class BulkCorrupt(IOError):
+    """A bulk frame failed its crc32 (or the stream's advertised
+    geometry) — re-read on a fresh connection up to
+    conf.BULK_READ_ATTEMPTS times, then surfaced to the caller."""
+
+
+# ---------------------------------------------------------------------------
+# counters (per-process; /metrics and the per-stage remote-fetch bytes
+# accounting read them)
+# ---------------------------------------------------------------------------
+
+class _Counters:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.sent = {}              # peer host -> bytes served
+        self.received = {}          # peer uri -> bytes fetched
+        self.total_sent = 0
+        self.total_received = 0
+        self.streams = 0            # completed fetch streams
+        self.active = 0             # in-flight fetch streams (gauge)
+        self.retries = 0
+        self.corrupt_frames = 0
+        self.torn_streams = 0
+
+
+_C = _Counters()
+
+
+def _count_sent(peer, nbytes, nchunks):
+    with _C.lock:
+        _C.sent[peer] = _C.sent.get(peer, 0) + nbytes
+        _C.total_sent += nbytes
+
+
+def _count_received(uri, nbytes):
+    with _C.lock:
+        _C.received[uri] = _C.received.get(uri, 0) + nbytes
+        _C.total_received += nbytes
+        _C.streams += 1
+
+
+def total_received_bytes():
+    """Cumulative bulk bytes fetched by this process (cheap int read —
+    the scheduler diffs it around a stage to attribute remote-fetch
+    bytes per stage)."""
+    return _C.total_received
+
+
+def stats():
+    """Snapshot for /metrics and the bench artifact."""
+    with _C.lock:
+        return {"sent": dict(_C.sent), "received": dict(_C.received),
+                "total_sent": _C.total_sent,
+                "total_received": _C.total_received,
+                "streams": _C.streams, "active": _C.active,
+                "retries": _C.retries,
+                "corrupt_frames": _C.corrupt_frames,
+                "torn_streams": _C.torn_streams}
+
+
+def reset_counters():
+    global _C
+    _C = _Counters()
+
+
+# ---------------------------------------------------------------------------
+# per-peer connection pool + concurrency window
+# ---------------------------------------------------------------------------
+
+class _PeerPool:
+    """Pooled sockets per peer uri: concurrent streams each check out
+    their own socket (a bulk stream owns its connection until the last
+    advertised frame), idle sockets are reused — the shard fan-out
+    must not pay one TCP handshake per frame.  A socket that saw any
+    error is closed, never returned."""
+
+    IDLE_PER_PEER = 4
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.free = {}
+
+    def acquire(self, uri, timeout):
+        with self.lock:
+            socks = self.free.get(uri)
+            if socks:
+                return socks.pop()
+        return dcn._connect(uri, timeout)
+
+    def release(self, uri, sock, broken):
+        if broken:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        with self.lock:
+            idle = self.free.setdefault(uri, [])
+            idle.append(sock)
+            while len(idle) > self.IDLE_PER_PEER:
+                old = idle.pop(0)
+                try:
+                    old.close()
+                except OSError:
+                    pass
+
+    def close(self):
+        with self.lock:
+            for socks in self.free.values():
+                for s in socks:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self.free.clear()
+
+
+_POOL = _PeerPool()
+_windows = {}
+_windows_lock = threading.Lock()
+
+
+def _window(uri):
+    """The per-peer stream window (None = unbounded)."""
+    from dpark_tpu import conf
+    cap = int(getattr(conf, "BULK_STREAMS_PER_PEER", 0) or 0)
+    if cap <= 0:
+        return None
+    with _windows_lock:
+        sem = _windows.get(uri)
+        if sem is None:
+            sem = _windows[uri] = threading.BoundedSemaphore(cap)
+        return sem
+
+
+# ---------------------------------------------------------------------------
+# fetch side
+# ---------------------------------------------------------------------------
+
+def _recv_into(sock, mv):
+    """recv straight into the assembly buffer (zero-copy: the payload
+    lands exactly once, in its final position)."""
+    got = 0
+    n = len(mv)
+    while got < n:
+        r = sock.recv_into(mv[got:], n - got)
+        if not r:
+            raise ConnectionError("peer closed mid-stream")
+        got += r
+
+
+def _read_stream(sock, req):
+    """One bulk request/response on an open socket: returns
+    (meta, memoryview of the assembled payload).  Every frame crc is
+    verified before its bytes are interpreted; with DPARK_DCN_SECRET
+    set, the header and every chunk additionally carry an HMAC tag
+    verified before use (same contract as the plain protocol)."""
+    import hashlib
+    import hmac as hmac_mod
+    import struct
+    from dpark_tpu import faults
+    from dpark_tpu.utils import unframe_jsonl
+    blob = dcn._encode_req(req)
+    sock.sendall(struct.pack("!I", len(blob)) + blob)
+    status, n = struct.unpack("!BQ", dcn._recv_exact(sock, 9))
+    secret = dcn._secret()
+    if status != dcn.BULK_STATUS:
+        payload = dcn._recv_exact(sock, n)
+        if secret:
+            tag = dcn._recv_exact(sock, 32)
+            want = hmac_mod.new(secret, bytes([status]) + payload,
+                                hashlib.sha256).digest()
+            if not hmac_mod.compare_digest(tag, want):
+                raise dcn.ServerError("bulk peer: response MAC mismatch")
+        if status == 1:
+            msg = payload.decode("utf-8", "replace")
+            if msg.startswith("unknown request") \
+                    or msg.startswith("unknown service request"):
+                raise BulkUnsupported(msg)
+            raise dcn.ServerError("bulk peer: %s" % msg)
+        raise BulkCorrupt("expected a bulk stream, got status %d"
+                          % status)
+    header = dcn._recv_exact(sock, n)
+    if secret:
+        tag = dcn._recv_exact(sock, 32)
+        want = hmac_mod.new(secret, bytes([dcn.BULK_STATUS]) + header,
+                            hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(tag, want):
+            raise dcn.ServerError("bulk peer: header MAC mismatch")
+    recs, skipped = unframe_jsonl(header)
+    if skipped or not recs:
+        with _C.lock:
+            _C.corrupt_frames += 1
+        raise BulkCorrupt("bulk header failed its crc frame")
+    meta = recs[0]
+    total = int(meta.get("total_bytes", 0))
+    nchunks = int(meta.get("nchunks", 0))
+    buf = bytearray(total)
+    view = memoryview(buf)
+    off = 0
+    for i in range(nchunks):
+        crc, ln = dcn.BULK_FRAME.unpack(
+            dcn._recv_exact(sock, dcn.BULK_FRAME.size))
+        if off + ln > total:
+            with _C.lock:
+                _C.corrupt_frames += 1
+            raise BulkCorrupt(
+                "chunk %d overruns the advertised stream (%d + %d > %d)"
+                % (i, off, ln, total))
+        chunk = view[off:off + ln]
+        _recv_into(sock, chunk)
+        if faults._PLANE is not None:
+            # chaos site, fetch side: corrupt flips payload bytes the
+            # crc below must catch; raise simulates mid-stream loss
+            mutated = faults.hit("dcn.transfer", bytes(chunk))
+            if mutated is not None and len(mutated) == ln:
+                chunk[:] = mutated
+        if secret:
+            tag = dcn._recv_exact(sock, 32)
+            want = hmac_mod.new(secret, chunk,
+                                hashlib.sha256).digest()
+            if not hmac_mod.compare_digest(tag, want):
+                # a mid-stream chunk MAC mismatch is indistinguishable
+                # from line corruption, so it keeps the crc path's
+                # BOUNDED RETRY (a persistent attacker still exhausts
+                # the attempts and surfaces as FetchFailed) — unlike
+                # the pre-stream header/response MACs, where a
+                # mismatch means the peer itself is not ours
+                # (ServerError, never retried)
+                with _C.lock:
+                    _C.corrupt_frames += 1
+                raise BulkCorrupt("chunk %d of %s failed its MAC"
+                                  % (i, req[0]))
+        if dcn.wire_crc(chunk) != crc:
+            with _C.lock:
+                _C.corrupt_frames += 1
+            raise BulkCorrupt("chunk %d of %s failed its crc32"
+                              % (i, req[0]))
+        off += ln
+    if off != total:
+        with _C.lock:
+            _C.corrupt_frames += 1
+        raise BulkCorrupt("stream ended at %d of %d advertised bytes"
+                          % (off, total))
+    return meta, view
+
+
+def fetch(uri, req, timeout=30):
+    """One bulk request against a tcp:// peer with bounded retry +
+    backoff; returns (meta, payload memoryview).  ServerError (the
+    peer answered; asking again cannot help) and BulkUnsupported (the
+    peer predates the protocol; the caller falls back to the plain
+    path) pass through unretried — only transport errors and
+    crc-rejected frames re-read on a fresh connection."""
+    from dpark_tpu import conf, trace
+    attempts = max(1, int(getattr(conf, "BULK_READ_ATTEMPTS", 1) or 1))
+    delays = dcn.backoff_delays(attempts)
+    win = _window(uri)
+    if win is not None:
+        win.acquire()
+    with _C.lock:
+        _C.active += 1
+    last = None
+    try:
+        with trace.span("dcn.bulk.fetch", "dcn", kind=str(req[0]),
+                        uri=uri) as sp:
+            for k in range(attempts):
+                sock = _POOL.acquire(uri, timeout)
+                ok = False
+                try:
+                    meta, view = _read_stream(sock, req)
+                    ok = True
+                except (dcn.ServerError, BulkUnsupported):
+                    raise
+                except BulkCorrupt as e:
+                    last = e
+                except (ConnectionError, OSError) as e:
+                    with _C.lock:
+                        _C.torn_streams += 1
+                    last = e
+                finally:
+                    _POOL.release(uri, sock, broken=not ok)
+                if ok:
+                    _count_received(uri, len(view))
+                    if sp is not trace._NOOP:
+                        sp.args["bytes"] = len(view)
+                        sp.args["attempts"] = k + 1
+                    return meta, view
+                d = next(delays, None)
+                if d is None:
+                    break
+                with _C.lock:
+                    _C.retries += 1
+                logger.debug("bulk read from %s failed (%s); retry "
+                             "%d/%d in %.3fs", uri, last, k + 1,
+                             attempts - 1, d)
+                time.sleep(d)
+        raise last
+    finally:
+        with _C.lock:
+            _C.active -= 1
+        if win is not None:
+            win.release()
+
+
+# -- typed fetch helpers ----------------------------------------------------
+
+def cols_from_buf(meta, view):
+    """Assemble the advertised column leaves as ZERO-COPY numpy views
+    over the received buffer (np.frombuffer — the bytes are never
+    copied again after landing off the socket)."""
+    import numpy as np
+    cols = []
+    off = 0
+    for leaf in meta.get("leaves", ()):
+        dt = np.dtype(str(leaf["dtype"]))
+        cnt = int(leaf["count"])
+        cols.append(np.frombuffer(view, dtype=dt, count=cnt,
+                                  offset=off))
+        off += dt.itemsize * cnt
+    return cols
+
+
+def device_put_cols(meta, view, device=None):
+    """The receiving controller's device ingest: the zero-copy column
+    views go straight to jax.device_put — no host row materialization
+    anywhere between the socket and HBM."""
+    import jax
+    return [jax.device_put(c, device) if device is not None
+            else jax.device_put(c) for c in cols_from_buf(meta, view)]
+
+
+def _items_from_cols(meta, view):
+    cols = cols_from_buf(meta, view)
+    if not cols:
+        return []
+    ks, vs = cols[0].tolist(), cols[1].tolist()
+    if meta.get("no_combine"):
+        # the host merge contract expects (k, combiner=[v]) for
+        # no-combine rows — same wrap as executor._export_one
+        return [(k, [v]) for k, v in zip(ks, vs)]
+    return list(zip(ks, vs))
+
+
+def fetch_bucket_items(uri, shuffle_id, map_id, reduce_id):
+    """One map-output bucket over the bulk channel, as (k, combiner)
+    items — the drop-in for the pickled ``("bucket", ...)`` bridge.
+    Columnar streams reconstruct the identical rows the bridge would
+    have pickled (server and client both materialize via .tolist())."""
+    meta, view = fetch(uri, ("bulk_bucket", shuffle_id, map_id,
+                             reduce_id))
+    if meta.get("kind") == "cols":
+        return _items_from_cols(meta, view)
+    from dpark_tpu.utils import decompress
+    return pickle.loads(decompress(bytes(view)))
+
+
+def fetch_shard(uri, shuffle_id, map_id, reduce_id, idx):
+    """One framed erasure shard over the bulk channel (the remote unit
+    of the fastest-k-of-n decode race).  b'' is the miss sentinel,
+    exactly like the plain ``bucket_shard`` protocol."""
+    meta, view = fetch(uri, ("bulk_shard", shuffle_id, map_id,
+                             reduce_id, idx))
+    return bytes(view)
+
+
+def fetch_bcast(uri, bid, i, timeout=30):
+    """One broadcast chunk over the bulk channel (P2P fan-out rides
+    the same frames, counters, and retry schedule as shuffle data)."""
+    meta, view = fetch(uri, ("bulk_bcast", bid, i), timeout=timeout)
+    return bytes(view)
+
+
+# ---------------------------------------------------------------------------
+# serving side (reached through BucketServer._serve for bulk_* kinds)
+# ---------------------------------------------------------------------------
+
+def _blob(data, extra=None):
+    meta = {"kind": "blob"}
+    if extra:
+        meta.update(extra)
+    chunks = dcn.chunked(data) if len(data) else []
+    return dcn.BulkPayload(meta, chunks, on_sent=_count_sent)
+
+
+def _cols_payload(meta, cols):
+    """Raw column bytes, chunk-framed: the serving side never pickles
+    a row — the bridge's dominant per-byte cost is simply gone."""
+    import numpy as np
+    leaves = []
+    chunks = []
+    for a in cols:
+        a = np.ascontiguousarray(a)
+        leaves.append({"dtype": str(a.dtype), "count": int(a.shape[0])})
+        chunks.extend(dcn.chunked(a.data))
+    out = {"kind": "cols", "leaves": leaves,
+           "no_combine": bool(meta.get("no_combine"))}
+    return dcn.BulkPayload(out, chunks, on_sent=_count_sent)
+
+
+def serve(server, req):
+    """BucketServer delegate for ``bulk_*`` request kinds; returns a
+    dcn.BulkPayload (the handler writes the stream) or raises (the
+    handler answers status 1)."""
+    kind = req[0]
+    if kind == "bulk_bucket":
+        _, sid, map_id, reduce_id = req
+        return _serve_bucket(server.workdir, sid, map_id, reduce_id)
+    if kind == "bulk_shard":
+        _, sid, map_id, reduce_id, idx = req
+        return _serve_shard(server.workdir, sid, map_id, reduce_id,
+                            idx)
+    if kind == "bulk_bcast":
+        _, bid, i = req
+        path = os.path.join(server.workdir, "broadcast",
+                            "b%d.%d" % (bid, i))
+        with open(path, "rb") as f:
+            data = f.read()
+        with server._serves_lock:
+            server.bcast_serves[(bid, i)] = \
+                server.bcast_serves.get((bid, i), 0) + 1
+        return _blob(data)
+    raise ValueError("unknown request %r" % (kind,))
+
+
+def _serve_bucket(workdir, sid, map_id, reduce_id):
+    from dpark_tpu import shuffle as shuffle_mod
+    from dpark_tpu.utils import compress
+    path = os.path.join(workdir, "shuffle", str(sid), str(map_id),
+                        str(reduce_id))
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return _blob(f.read())
+    # HBM-resident: raw columns when the store's record shape allows
+    # (flat (k, v), unencoded keys) ...
+    for exporter in shuffle_mod.HBM_COL_EXPORTERS.values():
+        try:
+            meta, cols = exporter(sid, map_id, reduce_id)
+        except KeyError:
+            continue            # this exporter owns no such sid
+        except ValueError:
+            break               # owned, but not col-exportable
+        return _cols_payload(meta, cols)
+    # ... else the exporter's pickled payload, still chunk-framed
+    for exporter in shuffle_mod.HBM_EXPORTERS.values():
+        try:
+            items = exporter(sid, map_id, reduce_id)
+        except KeyError:
+            continue
+        return _blob(compress(pickle.dumps(items, -1)))
+    raise FileNotFoundError(path)
+
+
+def _serve_shard(workdir, sid, map_id, reduce_id, idx):
+    path = os.path.join(workdir, "shuffle", str(sid), str(map_id),
+                        "%d.shards" % reduce_id)
+    if os.path.exists(path):
+        from dpark_tpu import coding
+        with open(path, "rb") as f:
+            try:
+                return _blob(coding.extract_container_frame(f.read(),
+                                                            idx))
+            except KeyError:
+                return _blob(b"")       # container holds no such shard
+    from dpark_tpu import shuffle as shuffle_mod
+    for exporter in shuffle_mod.HBM_EXPORTERS.values():
+        try:
+            return _blob(exporter(sid, map_id, reduce_id, shard=idx))
+        except KeyError:
+            continue            # this exporter owns no such sid
+        except ValueError:
+            break               # no code active / bad shard index
+    return _blob(b"")           # miss sentinel: fall back to plain

@@ -196,6 +196,41 @@ DCN_CONNECT_BACKOFF = float(os.environ.get("DPARK_DCN_CONNECT_BACKOFF",
                                            "0.05"))
 
 # ---------------------------------------------------------------------------
+# multi-controller bulk data plane (dpark_tpu/bulkplane.py — ISSUE 12)
+# ---------------------------------------------------------------------------
+
+# Route cross-process (tcp://) shuffle buckets, coded shard frames,
+# broadcast chunks, and remote service results over the chunked,
+# crc-framed bulk streaming channel instead of the single-frame pickled
+# host bridge.  HBM-resident flat (k, v) buckets additionally serve RAW
+# COLUMN bytes that assemble zero-copy into numpy views / device_put
+# batches on the receiving controller.  "0" falls back to the plain
+# single-frame protocol everywhere (bisection aid); a peer that does
+# not speak the bulk protocol is fallen back to per request.
+BULK_PLANE = os.environ.get("DPARK_BULK_PLANE", "1") != "0"
+
+# payload bytes per bulk stream chunk frame (each frame carries its own
+# crc32, so corruption costs one re-read, not a silently wrong answer)
+BULK_CHUNK_BYTES = int(os.environ.get("DPARK_BULK_CHUNK_BYTES",
+                                      str(1 << 20)) or (1 << 20))
+
+# per-peer concurrency window: at most this many bulk streams in
+# flight against one peer (a reduce fan-out of n coded shard fetches
+# must not open n sockets to a single serving controller at once).
+# 0 = unbounded.
+BULK_STREAMS_PER_PEER = int(os.environ.get(
+    "DPARK_BULK_STREAMS_PER_PEER", "4") or 0)
+
+# bounded retry on bulk-channel reads (1 = no retry): a torn stream
+# (peer restarting mid-transfer) or a crc-rejected frame re-reads on a
+# FRESH connection with the same exponential-full-jitter backoff
+# schedule the dcn connect path uses (dcn.backoff_delays — one
+# implementation, two call sites).  Application-level ServerError
+# stays non-retryable.
+BULK_READ_ATTEMPTS = int(os.environ.get("DPARK_BULK_READ_ATTEMPTS",
+                                        "3") or 1)
+
+# ---------------------------------------------------------------------------
 # TPU-native knobs (no reference analog)
 # ---------------------------------------------------------------------------
 

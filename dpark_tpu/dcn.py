@@ -17,6 +17,18 @@ nothing).  Response: status byte + 8-byte length + raw payload bytes
 (already compressed on disk — the server never recompresses); error
 payloads are UTF-8 strings.
 
+Bulk streams (ISSUE 12): a serve callable may return a
+:class:`BulkPayload` instead of bytes — the handler then answers with
+status byte 2, a crc-framed JSON HEADER line (utils.frame_jsonl — the
+spill/adapt/trace framing, one home), and the advertised number of
+RAW chunk frames, each ``!IQ`` (crc32, length) + payload bytes.  The
+receiving side (dpark_tpu/bulkplane.py) verifies every frame crc
+before any byte is interpreted, assembles chunks zero-copy into one
+buffer, and translates a torn stream (peer death mid-transfer) into
+a bounded-backoff retry.  Wire-frame crcs use zlib.crc32 explicitly —
+unlike spill runs, bulk frames cross INSTALLATIONS, so both ends must
+agree on the polynomial regardless of who has the native crc32c lib.
+
 Response payloads can still be hostile: shuffle/broadcast clients
 unpickle the data they fetch, so a poisoned peer URI or a MITM could
 answer with a crafted pickle.  Setting DPARK_DCN_SECRET on every host
@@ -97,12 +109,105 @@ def _decode_req(blob):
     return tuple(json.loads(blob.decode("utf-8")))
 
 
+def wire_crc(blob):
+    """Frame checksum for BULK WIRE frames: zlib.crc32, always.  Spill
+    runs use the native crc32c when loaded (spill_crc) because they
+    never leave the installation that wrote them; wire frames cross
+    hosts, and a native-lib asymmetry between peers must not reject
+    every frame as corrupt."""
+    import zlib
+    return zlib.crc32(bytes(blob) if isinstance(blob, memoryview)
+                      else blob) & 0xFFFFFFFF
+
+
+# one chunk frame of a bulk stream: crc32 of the payload + its length
+BULK_FRAME = struct.Struct("!IQ")
+BULK_STATUS = 2
+
+
+class BulkPayload:
+    """A streaming response from a serve callable: `meta` (a JSON-able
+    dict; `nchunks`/`total_bytes` are filled in from `chunks` when the
+    chunks are a list) plus the payload chunk iterable.  `on_sent`
+    (peer_host, bytes, nchunks) fires after a fully written stream —
+    the bulkplane's per-peer sent counters."""
+
+    __slots__ = ("meta", "chunks", "on_sent")
+
+    def __init__(self, meta, chunks, on_sent=None):
+        self.meta = dict(meta)
+        if not isinstance(chunks, (list, tuple)) \
+                and ("nchunks" not in self.meta
+                     or "total_bytes" not in self.meta):
+            # the receiver reads EXACTLY the advertised geometry: a
+            # lazy iterable without it would stream frames the client
+            # never reads — an empty "successful" fetch plus a
+            # desynced pooled connection.  Materialize rather than
+            # trust the caller.
+            chunks = list(chunks)
+        if isinstance(chunks, (list, tuple)):
+            self.meta.setdefault("nchunks", len(chunks))
+            self.meta.setdefault("total_bytes",
+                                 sum(len(c) for c in chunks))
+        self.chunks = chunks
+        self.on_sent = on_sent
+
+
+def chunked(buf, chunk_bytes=None):
+    """Split one bytes-like payload into bulk chunk views (memoryview
+    slices — no copies server-side).  Typed buffers (numpy column
+    .data views) are cast to unsigned bytes FIRST: a memoryview slices
+    in elements, and an int64 column advertised as "5 bytes" while 40
+    went over the wire would desync every following frame."""
+    from dpark_tpu import conf
+    step = int(chunk_bytes or conf.BULK_CHUNK_BYTES) or (1 << 20)
+    mv = memoryview(buf)
+    if mv.format != "B":
+        mv = mv.cast("B")
+    return [mv[i:i + step] for i in range(0, len(mv), step)]
+
+
+def _send_bulk(sock, payload):
+    """Write one bulk stream: status 2 + framed header + chunk frames.
+    The chaos site `dcn.transfer` fires per chunk on the SERVING side
+    too, so a deterministic mid-stream peer death is one env var away
+    (kind=raise aborts the stream after the header went out — exactly
+    what a killed peer looks like to the fetcher)."""
+    from dpark_tpu import faults
+    from dpark_tpu.utils import frame_jsonl
+    header = frame_jsonl(payload.meta)
+    secret = _secret()
+    tag = hmac.new(secret, bytes([BULK_STATUS]) + header,
+                   hashlib.sha256).digest() if secret else b""
+    sock.sendall(struct.pack("!BQ", BULK_STATUS, len(header))
+                 + header + tag)
+    sent = 0
+    nchunks = 0
+    for chunk in payload.chunks:
+        # crc over the TRUE bytes, computed before the chaos site may
+        # corrupt them — exactly what in-flight corruption does, and
+        # exactly what the receiver's per-frame crc must catch (same
+        # contract as the spill-chunk framing).  kind=raise aborts the
+        # stream mid-transfer: a deterministic peer death.
+        crc = wire_crc(chunk)
+        body = faults.hit("dcn.transfer", chunk) \
+            if faults._PLANE is not None else chunk
+        sock.sendall(BULK_FRAME.pack(crc, len(chunk)))
+        sock.sendall(body)
+        if secret:
+            sock.sendall(hmac.new(secret, chunk,
+                                  hashlib.sha256).digest())
+        sent += len(chunk)
+        nchunks += 1
+    return sent, nchunks
+
+
 class FramedServer:
     """Threaded length-prefixed request/response TCP server shared by
     the bucket server and the chunk-server filesystem: requests are
     JSON arrays of ints/strings (optionally HMAC-tagged — see module
     docstring), responses raw payload bytes with a status byte
-    (1 = UTF-8 error string)."""
+    (1 = UTF-8 error string, 2 = bulk stream follows)."""
 
     def __init__(self, serve, host="0.0.0.0", port=0,
                  name="dpark-framed-server"):
@@ -128,6 +233,20 @@ class FramedServer:
                             payload = str(e).encode(
                                 "utf-8", "replace")
                             status = 1
+                        if isinstance(payload, BulkPayload):
+                            from dpark_tpu import trace
+                            with trace.span("dcn.bulk.serve", "dcn",
+                                            kind=str(req[0])) as sp:
+                                sent, nchunks = _send_bulk(
+                                    self.request, payload)
+                                if sp is not trace._NOOP:
+                                    sp.args["bytes"] = sent
+                                    sp.args["chunks"] = nchunks
+                            if payload.on_sent is not None:
+                                payload.on_sent(
+                                    self.client_address[0], sent,
+                                    nchunks)
+                            continue
                         secret = _secret()
                         tag = hmac.new(
                             secret, bytes([status]) + payload,
@@ -186,6 +305,12 @@ class BucketServer(FramedServer):
     # -- request handling ----------------------------------------------
     def _serve(self, req):
         kind = req[0]
+        if isinstance(kind, str) and kind.startswith("bulk_"):
+            # multi-controller bulk data plane (ISSUE 12): chunked
+            # crc-framed streams for buckets / coded shard frames /
+            # raw HBM columns / broadcast chunks
+            from dpark_tpu import bulkplane
+            return bulkplane.serve(self, req)
         if kind == "bucket":
             _, sid, map_id, reduce_id = req
             path = os.path.join(self.workdir, "shuffle", str(sid),

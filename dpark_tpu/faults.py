@@ -24,6 +24,11 @@ Sites (each a choke point the runtime already flows through):
     executor.dispatch    device program dispatch (per program / per wave)
     executor.compile     device program compile (per cache miss)
     dcn.connect          TCP connect to a peer bucket server
+    dcn.transfer         bulk-channel chunk transfer (per frame, BOTH
+                         sides: a server-side `raise` kills the stream
+                         mid-transfer — deterministic peer-death — and
+                         a client-side `corrupt` flips payload bytes
+                         the frame crc must catch)
     checkpoint.write     checkpoint / snapshot part-file write
 
 Per-site parameters:
@@ -59,7 +64,7 @@ __all__ = ["SITES", "FaultInjected", "configure", "active", "hit",
 
 SITES = ("shuffle.fetch", "shuffle.spill_write", "shuffle.spill_read",
          "executor.dispatch", "executor.compile", "dcn.connect",
-         "checkpoint.write")
+         "dcn.transfer", "checkpoint.write")
 
 KINDS = ("raise", "enospc", "oom", "corrupt", "delay")
 

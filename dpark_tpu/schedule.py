@@ -590,6 +590,30 @@ class DAGScheduler:
         if record is not None:
             self._stage_info(record, stage_id).update(kw)
 
+    def _note_remote_fetch(self, stage_id, rx0):
+        """Attribute bulk-channel bytes received while this stage's
+        tasks ran (cross-controller shuffle fetches, ISSUE 12) to its
+        stage record — the web UI's "remote fetch B" column.  Inline
+        masters only: multiprocess workers fetch in their own
+        processes (same per-process contract as the fault/decode
+        counters).  The delta is over a PROCESS-WIDE counter, so with
+        concurrent jobs on a resident service the stages that overlap
+        in time each see the combined bytes — same documented contract
+        as the per-job program_cache delta (ISSUE 9); fetches run on
+        fetcher worker threads, so thread-local attribution cannot
+        narrow it."""
+        try:
+            from dpark_tpu import bulkplane
+            rx = bulkplane.total_received_bytes() - rx0
+        except Exception:
+            return
+        if rx > 0:
+            record = getattr(self, "_current_record", None)
+            if record is not None:
+                info = self._stage_info(record, stage_id)
+                info["remote_fetch_bytes"] = \
+                    info.get("remote_fetch_bytes", 0) + rx
+
     def fallback_reasons(self):
         """Every recorded WHY-the-array-path-was-left reason across the
         job history (the tpu master notes one per declined stage; other
@@ -1183,9 +1207,12 @@ class LocalScheduler(DAGScheduler):
         super().__init__()
 
     def submit_tasks(self, stage, tasks, report):
+        from dpark_tpu import bulkplane
+        rx0 = bulkplane.total_received_bytes()
         for task in tasks:
             status, payload = _run_task_inline(task)
             report(task, status, payload)
+        self._note_remote_fetch(stage.id, rx0)
 
     def default_parallelism(self):
         return 2
@@ -1257,6 +1284,8 @@ class LocalFleetScheduler(DAGScheduler):
         return ex
 
     def submit_tasks(self, stage, tasks, report):
+        from dpark_tpu import bulkplane
+        rx0 = bulkplane.total_received_bytes()
         for task in tasks:
             ex = self._pick_executor(task)
             status, payload = ex.run(task)
@@ -1264,6 +1293,7 @@ class LocalFleetScheduler(DAGScheduler):
                     and getattr(task.rdd, "should_cache", False):
                 self.cache_locs[(task.rdd.id, task.partition)] = ex.host
             report(task, status, payload)
+        self._note_remote_fetch(stage.id, rx0)
 
     def default_parallelism(self):
         return len(self.executors)

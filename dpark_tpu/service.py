@@ -122,6 +122,8 @@ class JobServer:
         self._active_jobs = 0
         self._waiting_jobs = 0
         self._lock = threading.Lock()
+        # per-tenant bulk-stream bytes (ISSUE 12; see note_bulk)
+        self._bulk_bytes = {}
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -305,17 +307,28 @@ class JobServer:
             sched._current_record = None
 
     # -- observability ---------------------------------------------------
+    def note_bulk(self, client, nbytes):
+        """Per-tenant bulk-stream accounting (ISSUE 12): bytes of job
+        results streamed to each remote tenant over the bulk
+        channel."""
+        with self._cv:
+            bulk = getattr(self, "_bulk_bytes", None)
+            if bulk is None:
+                bulk = self._bulk_bytes = {}
+            bulk[client] = bulk.get(client, 0) + nbytes
+
     def service_stats(self):
         with self._cv:
             queued_items = sum(len(s.queue)
                                for s in self._jobs.values())
+            bulk = dict(getattr(self, "_bulk_bytes", None) or {})
         with self._adm_cv:
             waiting = self._waiting_jobs
             active = self._active_jobs
         out = {"master": self.master, "slots": self.slots,
                "jobs_running": active, "jobs_queued": waiting,
                "work_items_queued": queued_items,
-               "max_jobs": self.max_jobs}
+               "max_jobs": self.max_jobs, "bulk": bulk}
         ex = getattr(self.scheduler, "executor", None)
         if ex is not None:
             out["program_cache"] = ex.program_cache_stats()
@@ -425,6 +438,11 @@ def serve(addr="127.0.0.1:0", master=None, server=None):
 
     Request grammar (JSON array like every dcn request):
       ("job", client, b64(serialize.dumps(fn)))  -> pickled fn(ctx)
+      ("job_bulk", client, b64(...))  -> same result, streamed over
+          the chunk-framed bulk channel (ISSUE 12) — concurrent
+          tenants' result streams multiplex through the shared
+          per-peer windows, and per-tenant stream bytes land in
+          service_stats()["bulk"]
       ("stats",)                                 -> pickled stats dict
     """
     import os
@@ -437,15 +455,32 @@ def serve(addr="127.0.0.1:0", master=None, server=None):
             "serving WITHOUT DPARK_DCN_SECRET: any peer that can "
             "reach this port can submit arbitrary code")
 
+    def run_job(client, payload):
+        from dpark_tpu import serialize
+        fn = serialize.loads(base64.b64decode(payload))
+        ctx = _context_for(srv, "remote:%s" % client)
+        return compress(pickle.dumps(fn(ctx), -1))
+
     def handle(req):
         kind = req[0]
         if kind == "job":
             _, client, payload = req
-            from dpark_tpu import serialize
-            fn = serialize.loads(base64.b64decode(payload))
-            ctx = _context_for(srv, "remote:%s" % client)
-            result = fn(ctx)
-            return compress(pickle.dumps(result, -1))
+            return run_job(client, payload)
+        if kind == "job_bulk":
+            _, client, payload = req
+            blob = run_job(client, payload)
+
+            def note_sent(peer, nbytes, nchunks, _client=client):
+                srv.note_bulk(_client, nbytes)
+                # result streams count in the bulk plane's per-peer
+                # sent counters too — /metrics must see ALL bulk
+                # traffic, not just shuffle/broadcast payloads
+                from dpark_tpu import bulkplane
+                bulkplane._count_sent(peer, nbytes, nchunks)
+
+            return dcn.BulkPayload({"kind": "blob"},
+                                   dcn.chunked(blob),
+                                   on_sent=note_sent)
         if kind == "stats":
             return compress(pickle.dumps(srv.service_stats(), -1))
         raise ValueError("unknown service request %r" % (kind,))
@@ -474,9 +509,21 @@ class ServiceClient:
         self.timeout = timeout
 
     def run(self, fn):
-        from dpark_tpu import dcn, serialize
+        from dpark_tpu import conf, dcn, serialize
         from dpark_tpu.utils import decompress
         payload = base64.b64encode(serialize.dumps(fn)).decode("ascii")
+        if conf.BULK_PLANE:
+            # results stream back chunk-framed over the bulk channel
+            # (ISSUE 12); a pre-bulk server answers "unknown service
+            # request" and the plain single-frame path runs instead
+            from dpark_tpu import bulkplane
+            try:
+                _, view = bulkplane.fetch(
+                    self.uri, ("job_bulk", self.client, payload),
+                    timeout=self.timeout)
+                return pickle.loads(decompress(bytes(view)))
+            except bulkplane.BulkUnsupported:
+                pass
         resp = dcn.fetch(self.uri, ("job", self.client, payload),
                          timeout=self.timeout)
         return pickle.loads(decompress(resp))

@@ -110,6 +110,15 @@ class LocalFileShuffle:
 # here so host-path stages can read HBM buckets through the same protocol
 HBM_EXPORTERS = {}
 
+# columnar twin (ISSUE 12): exporters that answer with
+# (meta, [numpy column arrays]) instead of Python rows, so the bulk
+# data plane can serve RAW COLUMN BYTES to a peer controller — no
+# per-row pickling anywhere on the wire path.  KeyError = not my
+# shuffle (try the next exporter); ValueError = mine but the record
+# shape can't columnarize (the serving side falls back to the pickled
+# payload, still chunk-framed on the bulk channel).
+HBM_COL_EXPORTERS = {}
+
 
 def read_bucket(uri, shuffle_id, map_id, reduce_id):
     """Fetch one map output bucket, yielding (k, combiner) pairs."""
@@ -127,7 +136,16 @@ def read_bucket(uri, shuffle_id, map_id, reduce_id):
         with open(path, "rb") as f:
             return pickle.loads(decompress(f.read()))
     if uri.startswith("tcp://"):
-        # cross-host fetch from the serving worker's bucket server
+        # cross-host fetch from the serving worker's bucket server —
+        # over the chunked bulk data plane (ISSUE 12) unless disabled
+        # or the peer predates the protocol
+        if conf.BULK_PLANE:
+            from dpark_tpu import bulkplane
+            try:
+                return bulkplane.fetch_bucket_items(
+                    uri, shuffle_id, map_id, reduce_id)
+            except bulkplane.BulkUnsupported:
+                pass
         from dpark_tpu import dcn
         payload = dcn.fetch(
             uri, ("bucket", shuffle_id, map_id, reduce_id))
@@ -154,9 +172,24 @@ def read_bucket_shard(uri, shuffle_id, map_id, reduce_id, idx):
         with open(path, "rb") as f:
             return coding.extract_container_frame(f.read(), idx)
     if uri.startswith("tcp://"):
-        from dpark_tpu import dcn
-        payload = dcn.fetch(
-            uri, ("bucket_shard", shuffle_id, map_id, reduce_id, idx))
+        payload = None
+        fetched = False
+        if conf.BULK_PLANE:
+            # coded shard frames ride the bulk channel too (ISSUE 12):
+            # the fastest-k-of-n race runs process-to-process with the
+            # same framing/retry/counters as whole buckets
+            from dpark_tpu import bulkplane
+            try:
+                payload = bulkplane.fetch_shard(
+                    uri, shuffle_id, map_id, reduce_id, idx)
+                fetched = True
+            except bulkplane.BulkUnsupported:
+                pass
+        if not fetched:
+            from dpark_tpu import dcn
+            payload = dcn.fetch(
+                uri, ("bucket_shard", shuffle_id, map_id, reduce_id,
+                      idx))
         if not payload:
             # the peer's miss sentinel: that bucket has no shard files
             # (written uncoded) — the caller falls back to the plain

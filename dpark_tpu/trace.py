@@ -46,8 +46,17 @@ Span taxonomy (name / cat):
     fetch.bucket             "shuffle" one reduce-side bucket fetch
     spill.write, spill.read  "shuffle" spill-run / spill-chunk I/O
     decode.*                 "coding"  erasure-decode outcomes
-    dcn.connect,             "dcn"     peer connects / request bytes
-    dcn.transfer
+    dcn.connect,             "dcn"     peer connects / single-frame
+    dcn.transfer                       request bytes (the pickled
+                                       host bridge)
+    dcn.bulk.fetch,          "dcn"     bulk data plane (ISSUE 12):
+    dcn.bulk.serve                     chunk-framed streams, bytes +
+                                       attempt count in args.  KEPT
+                                       DISTINCT from dcn.transfer —
+                                       the 2-process parity suite
+                                       asserts the hot path emitted
+                                       ONLY dcn.bulk.* spans (the
+                                       pickled bridge never ran)
     adapt.decision           "adapt"   cost-model choices
     stream.batch             "stream"  one micro-batch tick of an
                                        output chain (driver side)
@@ -86,6 +95,10 @@ from collections import deque
 from dpark_tpu import conf
 
 MODES = ("off", "ring", "spool")
+
+# see TracePlane.run: disambiguates runs minted in the same millisecond
+import itertools
+_RUN_SEQ = itertools.count(1)
 
 # phase-span names, in scheduler.phase_table() order — the critical
 # path analyzer and the reconciliation test share this list
@@ -129,8 +142,12 @@ class TracePlane:
         # default /tmp location) would otherwise merge two runs'
         # "job 1" spans into one bogus timeline.  The driver generates
         # it; workers inherit it through the shipped task environment.
-        self.run = run or "%d-%x" % (self.pid,
-                                     int(time.time() * 1000))
+        # A process-local sequence joins the pid+millis stamp: two
+        # configure() calls inside one millisecond (fast boxes, tests)
+        # must still mint DISTINCT runs.
+        self.run = run or "%d-%x-%x" % (self.pid,
+                                        int(time.time() * 1000),
+                                        next(_RUN_SEQ))
         self.emitted = 0
         self.dropped = 0
         self.spool_path = None

@@ -130,6 +130,35 @@ def render_metrics(scheduler):
     metric("dpark_export_seconds_total", "counter",
            "cumulative host-bridge export wall seconds",
            [({}, round(float(snap.get("export_seconds", 0.0)), 6))])
+    # multi-controller bulk data plane (ISSUE 12): per-peer byte
+    # counters both directions, stream totals, and the live
+    # active-stream gauge
+    try:
+        from dpark_tpu import bulkplane
+        bstats = bulkplane.stats()
+    except Exception:
+        bstats = {"sent": {}, "received": {}, "streams": 0,
+                  "active": 0, "retries": 0, "corrupt_frames": 0,
+                  "torn_streams": 0}
+    metric("dpark_bulk_bytes_total", "counter",
+           "bulk data-plane payload bytes by peer and direction",
+           [({"peer": p, "direction": "received"}, v)
+            for p, v in sorted(bstats["received"].items())]
+           + [({"peer": p, "direction": "sent"}, v)
+              for p, v in sorted(bstats["sent"].items())]
+           or [({"peer": "none", "direction": "none"}, 0)])
+    metric("dpark_bulk_streams_total", "counter",
+           "completed bulk fetch streams", [({}, bstats["streams"])])
+    metric("dpark_bulk_streams_active", "gauge",
+           "bulk fetch streams currently in flight",
+           [({}, bstats["active"])])
+    for key, help_text in (
+            ("retries", "bulk reads retried after a torn stream or "
+                        "rejected frame"),
+            ("corrupt_frames", "bulk frames rejected by crc"),
+            ("torn_streams", "bulk streams cut mid-transfer")):
+        metric("dpark_bulk_%s_total" % key, "counter", help_text,
+               [({}, bstats[key])])
     # pane-plane stream gauges (ISSUE 10): live per-windowed-stream
     # state from the panes registry — resident pane partials, merge
     # activity, watermark lag, and late-record accounting
@@ -210,7 +239,8 @@ _PAGE = """<!doctype html>
 <h2>stages <small>(click a row for its tasks; DAG per job below)</small></h2>
 <table id="s"><tr><th>job</th><th>stage</th><th>rdd</th>
 <th>parts</th><th>kind</th><th>seconds</th><th>device run s</th>
-<th>HBM bytes</th><th>wire bytes</th><th>pad eff</th>
+<th>HBM bytes</th><th>wire bytes</th><th>remote fetch B</th>
+<th>pad eff</th>
 <th>waves</th><th>idle %</th><th>pipeline ms (in/cmp/xchg/spill)</th>
 <th>decodes</th>
 <th>stream</th>
@@ -320,9 +350,13 @@ async function tick() {
       const srole = sw.stream
         ? sw.stream + ' ' + (sw.role || '') +
           (sw.pane !== undefined ? ' #' + sw.pane : '') : '';
+      // cross-controller bytes this stage fetched over the bulk data
+      // plane (ISSUE 12) — nonzero only when a reduce read a remote
+      // peer's map outputs
       for (const v of [j.id, st.id, st.rdd, st.parts, st.kind,
                        st.seconds, st.run_seconds, st.hbm_bytes,
-                       st.wire_bytes, st.pad_efficiency,
+                       st.wire_bytes, st.remote_fetch_bytes,
+                       st.pad_efficiency,
                        p.waves, idle, pms, sdec, srole, why])
         sr.insertCell().textContent = v === undefined ? '' : v;
       // span timeline link (ISSUE 8): the stage's job timeline from
@@ -337,7 +371,7 @@ async function tick() {
       };
       if (open.has(key)) {
         const dr = s.insertRow();
-        const c = dr.insertCell(); c.colSpan = 16;
+        const c = dr.insertCell(); c.colSpan = 17;
         c.className = 'tasks'; c.innerHTML = taskRows(st);
       }
     }

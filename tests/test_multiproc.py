@@ -30,7 +30,13 @@ def test_spmd_full_job_two_processes():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import __graft_entry__ as g
-    g._dryrun_spmd_job(nprocs=2, local_devices=4)
+    reason = g._dryrun_spmd_job(nprocs=2, local_devices=4)
+    if reason:
+        # the known XLA:CPU multi-controller gap, recorded as the
+        # stage's fallback_reason: results were still asserted
+        # bit-identical on the object path (ISSUE 12 satellite —
+        # skip-with-reason, not a raw assert)
+        pytest.skip(reason)
 
 
 def test_spmd_full_job_four_processes():
@@ -39,7 +45,9 @@ def test_spmd_full_job_four_processes():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import __graft_entry__ as g
-    g._dryrun_spmd_job(nprocs=4, local_devices=2)
+    reason = g._dryrun_spmd_job(nprocs=4, local_devices=2)
+    if reason:
+        pytest.skip(reason)
 
 
 def test_host_read_and_put_sharded_single_process(tctx):

@@ -32,6 +32,10 @@ PHASE_FIELDS = ("ingest_tokenize_ms", "narrow_ms", "exchange_ms",
 def main():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
+    # children (bench.py spawns its own grandchildren, stream_rate.py
+    # runs from benchmarks/) must import dpark_tpu even when the repo
+    # is not pip-installed (containers run the smoke from a checkout)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     ndev = env.setdefault("BENCH_SMOKE_DEVICES", "2")
     # tiny sizes + an explicitly requested cpu mesh; the device count
     # stays small so the smoke runs on 2-CPU runners (8-device
@@ -41,6 +45,7 @@ def main():
     env.setdefault("BENCH_OOC_GB", "0.01")
     env.setdefault("BENCH_EXTRAS", "0")
     env.setdefault("BENCH_ADAPT_BASE_ROWS", "16384")
+    env.setdefault("BENCH_BULK_ROWS", "250000")
     env.setdefault("BENCH_PROBE_ATTEMPTS", "1")
     env.setdefault("BENCH_PROBE_TIMEOUT", "120")
     env.setdefault("BENCH_PLATFORM", "cpu")
@@ -147,6 +152,30 @@ def main():
     if cod["decode_failures"]:
         print("FAIL: coded A/B hit decode failures with no faults "
               "injected: %r" % cod)
+        return 1
+    # ISSUE 12: the bulk-channel vs pickled-bridge A/B line must be
+    # present with bit-parity between the two representations and the
+    # bulk side actually having streamed (the ratio itself is not
+    # graded here — CI boxes are too noisy; BENCH_*.json records the
+    # honest number against the >=2x acceptance bar)
+    bk = [p for p in parsed
+          if p.get("metric") == "bulk_channel_vs_bridge"]
+    if not bk:
+        print("FAIL: no bulk_channel_vs_bridge line")
+        return 1
+    for field in ("value", "bridge_MBps", "bulk_MBps",
+                  "p99_bridge_ms", "p99_bulk_ms", "parity",
+                  "bulk_streams"):
+        if field not in bk[0]:
+            print("FAIL: bulk line missing %r (got %r)"
+                  % (field, sorted(bk[0])))
+            return 1
+    if not bk[0]["parity"]:
+        print("FAIL: bulk channel and pickled bridge disagreed on "
+              "the data: %r" % bk[0])
+        return 1
+    if not bk[0]["bulk_streams"]:
+        print("FAIL: bulk A/B never opened a bulk stream: %r" % bk[0])
         return 1
     # ISSUE 7: adaptive-execution accounting must ride the ooc line
     # (mode + store/steer counters + decision list — empty decisions
@@ -345,7 +374,7 @@ def main():
           "(waves=%d idle=%.3f depth=%d donated=%s narrow=%.0fms "
           "fallbacks=%d groupmap=%.1fx coded=%.2fx adapt cold/warm "
           "ladder=%d/%d hits=%d/%d service warm=%.1fx compiles=%d/%d "
-          "conc=%.2fx)"
+          "conc=%.2fx bulk=%.1fx)"
           % (len(parsed), pipe["waves"], pipe["device_idle_frac"],
              pipe["pipeline_depth"], pipe["donated"],
              phases["narrow_ms"], len(ooc[0]["fallback_reasons"]),
@@ -354,7 +383,8 @@ def main():
              cold["store_hits"], warm["store_hits"],
              sv[0]["value"], sv[0]["cold"]["compiles"],
              sv[0]["warm"]["compiles"],
-             conc.get("ratio_vs_slower_solo", 0.0)))
+             conc.get("ratio_vs_slower_solo", 0.0),
+             bk[0]["value"]))
     return 0
 
 
