@@ -349,6 +349,76 @@ def _groupmap_phase():
          "device_array_path": array_path}), flush=True)
 
 
+def _table_phase():
+    """Child-process entry: columnar query plane A/B (ISSUE 13
+    acceptance) — the SAME select+filter+group-by SQL-shaped query
+    over the SAME tabular part files, once through the device query
+    plan (column-pruned vectorized scan + device group exchange,
+    DPARK_QUERY on) and once through the pre-plan host row path
+    (per-row Python eval + host dict aggregation, DPARK_QUERY=0).
+    Both sides pay the full query wall including the scan."""
+    import tempfile
+
+    import numpy as np
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import DparkContext, conf
+    from dpark_tpu.tabular import write_tabular
+    n = int(os.environ.get("BENCH_TABLE_ROWS", 2_000_000))
+    d = tempfile.mkdtemp(prefix="bench_table_")
+    i = np.arange(n, dtype=np.int64)
+    cols = [((i * 2654435761) % 1000).tolist(),      # k: group key
+            (i % 100).tolist(),                      # a: filter col
+            (i % 7).tolist(),                        # b: sum arg
+            ((i % 13) * 0.5).tolist(),               # f: avg arg
+            ["s%d" % (x % 5) for x in range(n)]]     # s: never read
+    write_tabular(os.path.join(d, "part-00000.tab"),
+                  ["k", "a", "b", "f", "s"], zip(*cols),
+                  chunk_rows=1 << 16)
+    del cols
+    ctx = DparkContext("tpu")
+    ctx.start()
+    ndev = ctx.scheduler.executor.ndev
+
+    def run():
+        t = ctx.tabular(d).asTable("t")
+        q = t.where("a >= 10").groupBy(
+            "k", "sum(b) as sb", "count(*) as c", "avg(f) as af")
+        t0 = time.perf_counter()
+        rows = sorted(q.collect())
+        return time.perf_counter() - t0, rows, q
+
+    conf.QUERY_PLAN = True
+    run()                               # warm-up compiles
+    n0 = len(ctx.scheduler.history)
+    best = None
+    for _ in range(2):
+        dt, rows_dev, q = run()
+        if best is None or dt < best[0]:
+            best = (dt, rows_dev, q)
+    t_dev, rows_dev, q = best
+    pq = q._planned()
+    recs = ctx.scheduler.history[n0:]
+    all_array = bool(recs) and all(
+        str(st.get("kind", "")).startswith("array")
+        and not st.get("fallback_reason")
+        for rec in recs for st in rec.get("stage_info", []))
+    scan = {k: (sorted(v) if isinstance(v, set) else v)
+            for k, v in (pq.scan_stats if pq else {}).items()}
+    conf.QUERY_PLAN = False
+    try:
+        t_host, rows_host, _ = run()
+    finally:
+        conf.QUERY_PLAN = True
+    ctx.stop()
+    print("TABLE_RESULT %s" % json.dumps(
+        {"t_device": t_dev, "t_host": t_host, "rows": n,
+         "ndev": ndev, "parity": rows_dev == rows_host,
+         "device_all_array": all_array, "scan": scan,
+         "columns_total": 5}), flush=True)
+
+
 # BASELINE config #2: join/cogroup of two keyed RDDs (TPC-H
 # lineitem⋈orders subset shape: big fact table, smaller key table,
 # every fact key hits).  Sizes are row counts; device default rises.
@@ -1052,6 +1122,9 @@ def main():
     if "--service-only" in sys.argv:
         _service_phase()
         return
+    if "--table-only" in sys.argv:
+        _table_phase()
+        return
     if "--probe" in sys.argv:
         _probe_phase()
         return
@@ -1229,6 +1302,30 @@ def main():
                     "pairs": c["pairs"],
                     "coding": c["decodes"]}
             print(json.dumps(cout))
+    # columnar query plane A/B (ISSUE 13 acceptance): the same
+    # select+filter+group-by query over the same tabular input — the
+    # scan-pruned device plan (vectorized column scan + device group
+    # exchange) vs the pre-plan host row path (per-row Python eval).
+    # >= 3x at 2M rows on the 2-dev CPU mesh with bit-identical rows.
+    if os.environ.get("BENCH_TABLE", "1") != "0":
+        got = _run_child("--table-only", child_timeout,
+                         env=extra_env, ok_prefix="TABLE_RESULT ")
+        if got is not None:
+            tb = json.loads(got)
+            tbo = {"metric": _suffix("table_query_device_vs_host"),
+                   "value": round(tb["t_host"]
+                                  / max(tb["t_device"], 1e-9), 3),
+                   "unit": "x (higher is better; >=3 passes)",
+                   "t_device_s": round(tb["t_device"], 3),
+                   "t_host_s": round(tb["t_host"], 3),
+                   "rows": tb["rows"], "chips": tb["ndev"],
+                   "parity": tb["parity"],
+                   "device_all_array": tb["device_all_array"],
+                   "columns_total": tb["columns_total"],
+                   "scan": tb["scan"]}
+            if emulated:
+                tbo["emulated_cpu_mesh"] = True
+            print(json.dumps(tbo))
     # bulk-channel vs pickled-bridge A/B (ISSUE 12 acceptance): the
     # same HBM-shaped bucket fetched cross-process over loopback both
     # ways — the chunked raw-column bulk stream must move >= 2x the

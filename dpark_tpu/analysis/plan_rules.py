@@ -754,6 +754,30 @@ def _rule_window_noninv(r, report):
              "O(log w); or supply invFunc for O(1) slides")
 
 
+def _rule_table_host_fallback(r, report):
+    """table-host-fallback (ISSUE 13 satellite): why a table/SQL query
+    operator left the array path.  The query planner
+    (dpark_tpu/query/planner.py) attaches its per-operator host
+    decisions — non-traceable UDA, unsupported column dtype (float
+    group key, string aggregate), int-overflow risk, priced object
+    path — to the host-chain lineage it falls back to
+    (`_query_fallbacks`); this rule surfaces them pre-flight, the
+    exact mirror of the per-stage `fallback_reason` the scheduler
+    records at run time."""
+    fallbacks = getattr(r, "_query_fallbacks", None)
+    if not fallbacks:
+        return
+    for fb in fallbacks:
+        report.add(
+            "table-host-fallback", "info", r.scope_name,
+            "query operator %r left the array path: %s"
+            % (fb.get("op"), fb.get("reason")),
+            "see the README Table/SQL plane section for the device "
+            "query support matrix (int/encoded-string keys, "
+            "sum/count/min/max/avg + traceable UDAs, equi-joins); "
+            "DPARK_QUERY=0 silences planning entirely")
+
+
 def lint_plan(rdd, master="local", report=None, lineage=None):
     """Run every plan rule over the lineage of `rdd`; returns a Report.
 
@@ -774,6 +798,7 @@ def lint_plan(rdd, master="local", report=None, lineage=None):
         _rule_adapt_stale_hint(r, report)
         _rule_trace_overhead_hint(r, report)
         _rule_window_noninv(r, report)
+        _rule_table_host_fallback(r, report)
     _rule_uncached_reshuffle(lineage, report)
     excess = _excess_wide_depth(rdd)
     _rule_wide_depth(rdd, report, excess)

@@ -517,6 +517,22 @@ TRACE_SPAN_WRITES_PER_TASK = int(os.environ.get(
     "DPARK_TRACE_SPAN_WRITES_PER_TASK", "64") or 64)
 
 # ---------------------------------------------------------------------------
+# columnar query plane (dpark_tpu/query/ — ISSUE 13)
+# ---------------------------------------------------------------------------
+
+# Lower table/SQL DSL actions through the rule-driven query planner:
+# column-pruned vectorized tabular scans (filters evaluate over column
+# batches before any row tuple materializes; chunks skip via footer
+# min/max stats), group-by aggregates onto the device exchange /
+# SegAggOp / SegMapOp, equi-joins onto the device join, string keys
+# dictionary-encoded.  "0" pins every table action to the host row
+# path (the pre-plan behavior — bisection aid and the bench A/B's
+# baseline side).  Operators the planner cannot PROVE equivalent keep
+# the host path per query, with the reason recorded
+# (`table-host-fallback` lint rule + the planner's decision log).
+QUERY_PLAN = os.environ.get("DPARK_QUERY", "1") != "0"
+
+# ---------------------------------------------------------------------------
 # pre-flight plan linter (dpark_tpu/analysis/)
 # ---------------------------------------------------------------------------
 

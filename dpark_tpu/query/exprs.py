@@ -1,0 +1,418 @@
+"""Expression layer of the columnar query plane: parse the table DSL's
+string expressions, discover referenced columns (the pruning substrate),
+and compile the supported subset into VECTORIZED array programs that
+evaluate over whole column batches before any row tuple materializes.
+
+Admission is exact, not optimistic — an expression only vectorizes when
+the array program provably computes what the host's per-row Python eval
+computes for every value the batch can contain:
+
+  * integer arithmetic is admitted through interval analysis over the
+    batch's actual per-column [min, max] ranges (the same idea as
+    fuse._IntInterval's ranged-int top-k probe): every intermediate
+    must fit int64, because the host computes exact Python ints while
+    the array path wraps;
+  * division requires a provably nonzero divisor (constant, or a
+    column whose range excludes 0) — the host raises ZeroDivisionError
+    where numpy would emit inf;
+  * ``min``/``max`` calls compile to ``np.where`` forms that reproduce
+    Python's comparison semantics exactly (``np.minimum`` propagates
+    NaN where Python ``min`` returns its first argument);
+  * ``and``/``or``/``not`` are admitted only in BOOLEAN (predicate)
+    context, where truthiness is all that survives — in value context
+    Python's and/or return an operand, which has no array twin here.
+
+Everything else declines with a recorded reason; the planner keeps the
+declining operator on the host row path and the `table-host-fallback`
+lint rule reports the same reason pre-flight.
+"""
+
+import ast
+
+import numpy as np
+
+_I64_MAX = 2 ** 63 - 1
+
+
+class ExprDecline(Exception):
+    """Why an expression cannot vectorize (carried as the reason)."""
+
+
+class ColumnExpr:
+    """One parsed DSL expression: its AST, referenced columns, and the
+    original text.  Vectorization is a separate, per-batch admission
+    (dtypes + value ranges in hand) via `vectorize`."""
+
+    __slots__ = ("expr", "tree", "columns", "parse_error")
+
+    def __init__(self, expr, fields):
+        self.expr = expr
+        self.tree = None
+        self.parse_error = None
+        self.columns = set()
+        try:
+            self.tree = ast.parse(expr, mode="eval")
+        except SyntaxError as e:
+            self.parse_error = "unparseable expression: %s" % e
+            return
+        fields = set(fields)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Name) and node.id in fields:
+                self.columns.add(node.id)
+
+    def __repr__(self):
+        return "<ColumnExpr %r cols=%s>" % (self.expr,
+                                            sorted(self.columns))
+
+
+def compile_expr(expr, fields):
+    return ColumnExpr(expr, fields)
+
+
+# ---------------------------------------------------------------------------
+# vectorization
+# ---------------------------------------------------------------------------
+
+def _py_min2(a, b):
+    """Python ``min(a, b)`` exactly: b if b < a else a (NaN-aware the
+    way the host is — a NaN b never compares less, so `a` wins)."""
+    return np.where(b < a, b, a)
+
+
+def _py_max2(a, b):
+    return np.where(b > a, b, a)
+
+
+class _V:
+    """One vectorized sub-expression: evaluator + static type facts.
+
+    kind: "i" int, "f" float, "b" bool (comparison output), "o" object
+    (string column / str literal).  bounds: exact (lo, hi) Python ints
+    for int-kind nodes (None once unknown — which declines any further
+    int arithmetic, keeping the no-wrap proof honest)."""
+
+    __slots__ = ("fn", "kind", "bounds", "const")
+
+    def __init__(self, fn, kind, bounds=None, const=None):
+        self.fn = fn
+        self.kind = kind
+        self.bounds = bounds
+        self.const = const
+
+
+def _chk(lo, hi, what):
+    if abs(lo) > _I64_MAX or abs(hi) > _I64_MAX:
+        raise ExprDecline(
+            "int expression may leave int64 (%s bounds [%d, %d]): the "
+            "host computes exact Python ints — host path" % (what, lo, hi))
+    return (lo, hi)
+
+
+def _const_v(value):
+    if isinstance(value, bool):
+        return _V(lambda env: value, "b", (int(value), int(value)),
+                  const=value)
+    if isinstance(value, int):
+        _chk(value, value, "literal")
+        return _V(lambda env: value, "i", (value, value), const=value)
+    if isinstance(value, float):
+        return _V(lambda env: value, "f", const=value)
+    if isinstance(value, str):
+        return _V(lambda env: value, "o", const=value)
+    raise ExprDecline("unsupported literal %r" % (value,))
+
+
+class _Vectorizer:
+    """AST -> vectorized evaluator, with per-node admission.
+
+    dtypes: {column: numpy dtype} of the scanned batch (object dtype
+    for string columns); ranges: {column: (lo, hi) exact ints} for
+    int columns (None entries decline int arithmetic over them)."""
+
+    def __init__(self, dtypes, ranges):
+        self.dtypes = dtypes
+        self.ranges = ranges or {}
+
+    def build(self, node, boolean):
+        meth = getattr(self, "_v_%s" % type(node).__name__, None)
+        if meth is None:
+            raise ExprDecline("unsupported syntax %s in a vectorized "
+                              "expression" % type(node).__name__)
+        return meth(node, boolean)
+
+    # -- leaves ---------------------------------------------------------
+    def _v_Expression(self, node, boolean):
+        return self.build(node.body, boolean)
+
+    def _v_Constant(self, node, boolean):
+        return _const_v(node.value)
+
+    def _v_Name(self, node, boolean):
+        name = node.id
+        if name == "True":
+            return _const_v(True)
+        if name == "False":
+            return _const_v(False)
+        if name not in self.dtypes:
+            raise ExprDecline("unknown name %r" % name)
+        dt = self.dtypes[name]
+        if dt == np.dtype(object) or dt.kind in "US":
+            return _V(lambda env: env[name], "o")
+        if dt.kind == "b":
+            raise ExprDecline("bool column %r stays on the host path"
+                              % name)
+        if dt.kind == "i":
+            rng = self.ranges.get(name)
+            if rng is None:
+                raise ExprDecline(
+                    "int column %r has no value range (needed for the "
+                    "no-overflow proof)" % name)
+            return _V(lambda env: env[name], "i",
+                      (int(rng[0]), int(rng[1])))
+        if dt.kind == "f":
+            return _V(lambda env: env[name], "f")
+        raise ExprDecline("unsupported column dtype %s for %r"
+                          % (dt, name))
+
+    # -- arithmetic -----------------------------------------------------
+    def _numeric(self, v, what):
+        if v.kind == "o":
+            raise ExprDecline("string operand in %s" % what)
+        if v.kind == "b":
+            # Python arithmetic treats bools as ints (True + True = 2);
+            # numpy bool arrays would logical-or under "+" — cast so
+            # the array program keeps the host's semantics
+            f = v.fn
+            return _V(lambda env: np.asarray(f(env)).astype(np.int64),
+                      "i", v.bounds or (0, 1), const=v.const)
+        return v
+
+    def _v_UnaryOp(self, node, boolean):
+        if isinstance(node.op, ast.Not):
+            v = self.build(node.operand, True)
+            f = v.fn
+            return _V(lambda env: ~_as_bool(f(env)), "b", (0, 1))
+        v = self._numeric(self.build(node.operand, False), "unary op")
+        f = v.fn
+        if isinstance(node.op, ast.USub):
+            bounds = None
+            if v.kind in "ib":
+                bounds = _chk(-v.bounds[1], -v.bounds[0], "negation")
+            return _V(lambda env: -f(env),
+                      "f" if v.kind == "f" else "i", bounds)
+        if isinstance(node.op, ast.UAdd):
+            return v
+        raise ExprDecline("unsupported unary op")
+
+    def _v_BinOp(self, node, boolean):
+        a = self._numeric(self.build(node.left, False), "arithmetic")
+        b = self._numeric(self.build(node.right, False), "arithmetic")
+        op = node.op
+        int_sides = a.kind in "ib" and b.kind in "ib"
+        kind = "i" if int_sides else "f"
+        if isinstance(op, ast.Add):
+            bounds = _chk(a.bounds[0] + b.bounds[0],
+                          a.bounds[1] + b.bounds[1], "+") \
+                if int_sides else None
+            return _V(lambda env: a.fn(env) + b.fn(env), kind, bounds)
+        if isinstance(op, ast.Sub):
+            bounds = _chk(a.bounds[0] - b.bounds[1],
+                          a.bounds[1] - b.bounds[0], "-") \
+                if int_sides else None
+            return _V(lambda env: a.fn(env) - b.fn(env), kind, bounds)
+        if isinstance(op, ast.Mult):
+            bounds = None
+            if int_sides:
+                corners = [x * y for x in a.bounds for y in b.bounds]
+                bounds = _chk(min(corners), max(corners), "*")
+            return _V(lambda env: a.fn(env) * b.fn(env), kind, bounds)
+        if isinstance(op, ast.Div):
+            self._nonzero(b, "/")
+            return _V(lambda env: a.fn(env) / b.fn(env), "f")
+        if isinstance(op, (ast.FloorDiv, ast.Mod)):
+            self._nonzero(b, "// or %")
+            if not int_sides:
+                # float // and % match numpy's floor conventions, but
+                # the host's exact-float corner cases (signed zeros)
+                # are not worth proving here
+                raise ExprDecline("float // and % stay on the host")
+            if b.bounds[0] <= 0 <= b.bounds[1]:
+                raise ExprDecline("divisor range crosses zero")
+            if isinstance(op, ast.FloorDiv):
+                corners = [x // y for x in a.bounds for y in b.bounds]
+                bounds = _chk(min(corners), max(corners), "//")
+                return _V(lambda env: a.fn(env) // b.fn(env), "i",
+                          bounds)
+            if b.bounds[0] > 0:
+                bounds = (0, b.bounds[1] - 1)
+            else:
+                bounds = (b.bounds[0] + 1, 0)
+            return _V(lambda env: a.fn(env) % b.fn(env), "i", bounds)
+        raise ExprDecline("unsupported operator %s"
+                          % type(op).__name__)
+
+    def _nonzero(self, v, what):
+        if v.kind == "f":
+            if v.const is not None and v.const != 0:
+                return
+            raise ExprDecline(
+                "divisor of %s not provably nonzero (the host raises "
+                "ZeroDivisionError where arrays emit inf)" % what)
+        if v.bounds[0] <= 0 <= v.bounds[1]:
+            raise ExprDecline("divisor of %s not provably nonzero"
+                              % what)
+
+    # -- comparisons / boolean ------------------------------------------
+    def _v_Compare(self, node, boolean):
+        parts = []
+        left = self.build(node.left, False)
+        for op, right_node in zip(node.ops, node.comparators):
+            right = self.build(right_node, False)
+            if (left.kind == "o") != (right.kind == "o"):
+                if not isinstance(op, (ast.Eq, ast.NotEq)):
+                    raise ExprDecline(
+                        "ordering comparison between string and "
+                        "numeric operands")
+            npop = {ast.Lt: np.less, ast.LtE: np.less_equal,
+                    ast.Gt: np.greater, ast.GtE: np.greater_equal,
+                    ast.Eq: np.equal, ast.NotEq: np.not_equal}.get(
+                        type(op))
+            if npop is None:
+                raise ExprDecline("unsupported comparison %s"
+                                  % type(op).__name__)
+            lf, rf = left.fn, right.fn
+            parts.append(lambda env, lf=lf, rf=rf, npop=npop:
+                         npop(lf(env), rf(env)))
+            left = right
+
+        def fn(env):
+            out = _as_bool(parts[0](env))
+            for p in parts[1:]:
+                out = out & _as_bool(p(env))
+            return out
+        return _V(fn, "b", (0, 1))
+
+    def _v_BoolOp(self, node, boolean):
+        if not boolean:
+            raise ExprDecline(
+                "and/or outside a predicate (Python's and/or return "
+                "an OPERAND, which has no array twin)")
+        vs = [self.build(v, True) for v in node.values]
+        fns = [v.fn for v in vs]
+        if isinstance(node.op, ast.And):
+            def fn(env):
+                out = _as_bool(fns[0](env))
+                for f in fns[1:]:
+                    out = out & _as_bool(f(env))
+                return out
+        else:
+            def fn(env):
+                out = _as_bool(fns[0](env))
+                for f in fns[1:]:
+                    out = out | _as_bool(f(env))
+                return out
+        return _V(fn, "b", (0, 1))
+
+    # -- calls ----------------------------------------------------------
+    def _v_Call(self, node, boolean):
+        if not isinstance(node.func, ast.Name) or node.keywords:
+            raise ExprDecline("unsupported call form")
+        name = node.func.id
+        args = [self.build(a, False) for a in node.args]
+        if name == "abs" and len(args) == 1:
+            (v,) = args
+            v = self._numeric(v, "abs")
+            bounds = None
+            if v.kind in "ib":
+                lo, hi = v.bounds
+                bounds = _chk(0 if lo <= 0 <= hi else min(abs(lo),
+                                                          abs(hi)),
+                              max(abs(lo), abs(hi)), "abs")
+            f = v.fn
+            return _V(lambda env: np.abs(f(env)),
+                      "f" if v.kind == "f" else "i", bounds)
+        if name in ("min", "max") and len(args) >= 2:
+            pair = _py_min2 if name == "min" else _py_max2
+            kinds = {self._numeric(a, name).kind for a in args}
+            kind = "f" if "f" in kinds else "i"
+            bounds = None
+            if kind == "i":
+                agg = min if name == "min" else max
+                bounds = (agg(a.bounds[0] for a in args),
+                          agg(a.bounds[1] for a in args))
+            fns = [a.fn for a in args]
+
+            def fn(env, fns=fns, pair=pair):
+                out = fns[0](env)
+                for f in fns[1:]:
+                    out = pair(out, f(env))
+                return out
+            return _V(fn, kind, bounds)
+        if name == "float" and len(args) == 1:
+            v = self._numeric(args[0], "float()")
+            f = v.fn
+            return _V(lambda env: np.asarray(f(env), np.float64), "f")
+        raise ExprDecline("unsupported function %r in a vectorized "
+                          "expression" % name)
+
+
+def _as_bool(arr):
+    a = np.asarray(arr)
+    if a.dtype == np.bool_:
+        return a
+    return a.astype(bool)
+
+
+class VecExpr:
+    """An admitted array program: fn({column: array}) -> value array
+    (bool array for predicates); kind in "ifb"; bounds the exact
+    (lo, hi) int interval for int-kind outputs (drives the no-overflow
+    proof of any DOWNSTREAM expression over this derived column)."""
+
+    __slots__ = ("fn", "kind", "bounds")
+
+    def __init__(self, fn, kind, bounds=None):
+        self.fn = fn
+        self.kind = kind
+        self.bounds = bounds
+
+
+def vectorize(colexpr, dtypes, ranges=None, boolean=False):
+    """Compile a ColumnExpr into an array program, or explain why not.
+
+    Returns (VecExpr, None) on admission or (None, reason) on decline.
+    `ranges` supplies exact (lo, hi) per int column for the
+    no-overflow interval proof."""
+    if colexpr.parse_error:
+        return None, colexpr.parse_error
+    try:
+        dts = {k: np.dtype(v) for k, v in dtypes.items()}
+        v = _Vectorizer(dts, ranges).build(colexpr.tree, boolean)
+        if boolean:
+            f = v.fn
+            return VecExpr(lambda env: _as_bool(f(env)), "b"), None
+        if v.kind == "o":
+            return None, ("string-valued expressions have no "
+                          "device column form")
+        if v.kind == "b":
+            return None, ("bool-valued projection stays on the "
+                          "host (predicate context only)")
+        return VecExpr(v.fn, v.kind, v.bounds), None
+    except ExprDecline as e:
+        return None, str(e)
+    except Exception as e:          # never let admission kill a query
+        return None, "vectorize failed: %s" % e
+
+
+def int_ranges(cols):
+    """Exact (lo, hi) per int column of a batch dict — the interval
+    proof's inputs.  Empty columns map to (0, 0)."""
+    out = {}
+    for name, arr in cols.items():
+        a = np.asarray(arr)
+        if a.dtype.kind == "i":
+            out[name] = ((int(a.min()), int(a.max())) if a.size
+                         else (0, 0))
+        elif a.dtype.kind == "b":
+            out[name] = (0, 1)
+    return out

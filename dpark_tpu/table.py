@@ -8,9 +8,23 @@ approximate distinct count (HyperLogLog, dpark/hyperloglog.py analog in
 dpark_tpu/hyperloglog.py).  Exact method shapes follow this framework's
 conventions; the surface (select/where/groupBy/sort/top/join/collect) is
 the reference's.
+
+Columnar query plane (ISSUE 13): every DSL call ALSO lowers into a
+logical plan (dpark_tpu/query/) when its source is a columnar scan
+(tabular part files, parallelize slices) and its expressions parse.
+Actions (collect/take/count/top) then ask the rule-driven physical
+planner to compile the plan onto the device path — pruned vectorized
+scans, device exchanges for group-by/join, egest-side result finishing
+— and fall back to the eager host RDD chain below (which is always
+built, lazily, alongside) whenever any operator declines; the decline
+reasons ride `_query_fallbacks` for the `table-host-fallback` lint
+rule and the planner's decision log.  `DPARK_QUERY=0` pins every
+action to the host chain (the pre-plan behavior, and the bench A/B's
+baseline side).
 """
 
 import re
+import time
 from collections import namedtuple
 
 from dpark_tpu.utils.log import get_logger
@@ -181,14 +195,140 @@ def _parse_column(col, fields, index):
     return (name or ("col%d" % index)), _compile_expr(col, fields)
 
 
+class _UDA:
+    """User-defined aggregate marker for groupBy: a traceable
+    per-group function over one argument column's value list.  On the
+    host path the values fold as a Python list; on the device plan the
+    same function rides the SegMapOp segmented apply (admission:
+    traceable + padding-invariant, see fuse.classify_seg_map)."""
+
+    def __init__(self, expr, fn, name=None):
+        self.expr = expr
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", "uda")
+
+
+def uda(expr, fn, name=None):
+    """A groupBy aggregate column computed by `fn(values_list)` over
+    the per-group values of `expr` — e.g.
+    ``t.groupBy("k", uda("v", lambda vs: sum(x * x for x in vs),
+    "sumsq"))``."""
+    return _UDA(expr, fn, name)
+
+
 class TableRDD:
-    def __init__(self, rdd, fields, name="table"):
+    def __init__(self, rdd, fields, name="table", plan=None,
+                 plan_fallbacks=None):
         if isinstance(fields, str):
             fields = [f.strip() for f in fields.replace(",", " ").split()]
         self.rdd = rdd
         self.fields = list(fields)
         self.name = name
         self._row_type = namedtuple("Row", self.fields, rename=True)
+        self._plan_fallbacks = list(plan_fallbacks or ())
+        self.plan = plan if plan is not None else self._scan_plan()
+        self._planned_q = False     # False = not planned yet
+
+    # -- query-plane lowering -------------------------------------------
+    def _scan_plan(self):
+        """A Scan node when this table's source is columnar (tabular
+        part files / driver-resident parallelize slices), else None —
+        the host chain then serves every action."""
+        try:
+            from dpark_tpu.query.logical import Scan
+            from dpark_tpu.rdd import ParallelCollection
+            from dpark_tpu.tabular import TabularRDD
+            if isinstance(self.rdd, TabularRDD):
+                if list(self.fields) == list(self.rdd.wanted):
+                    return Scan(self.rdd, self.fields, self.name)
+                self._note_fallback(
+                    "scan", "table fields rename the tabular columns")
+            elif isinstance(self.rdd, ParallelCollection) \
+                    and self.rdd._slices is not None:
+                return Scan(self.rdd, self.fields, self.name)
+        except Exception as e:
+            logger.debug("no scan plan: %s", e)
+        return None
+
+    def _note_fallback(self, op, reason):
+        self._plan_fallbacks.append({"op": op, "reason": reason})
+
+    def _qexprs(self, texts):
+        """Compile expression texts for the logical plan.  Returns
+        (exprs, None) or (None, reason) — the caller threads the
+        reason into the DERIVED table's fallback provenance (mutating
+        self here would stamp one query's decline onto every sibling
+        query built from the same base table)."""
+        from dpark_tpu.query.exprs import compile_expr
+        out = []
+        for t in texts:
+            ce = compile_expr(t, self.fields)
+            if ce.parse_error:
+                return None, ce.parse_error
+            out.append(ce)
+        return out, None
+
+    def _derive(self, rdd, fields, plan, op=None, reason=None):
+        """A downstream TableRDD carrying plan + fallback provenance."""
+        fb = list(self._plan_fallbacks)
+        if plan is None and reason is not None:
+            fb.append({"op": op or "plan", "reason": reason})
+        return TableRDD(rdd, fields, self.name, plan=plan,
+                        plan_fallbacks=fb)
+
+    def _planned(self):
+        """The PlannedQuery serving this table's actions, or None (host
+        chain).  Planned once; the physical RDD pipeline and scan
+        results are reused across repeated actions — like any cached
+        RDD lineage."""
+        if self._planned_q is not False:
+            return self._planned_q
+        self._planned_q = None
+        from dpark_tpu import conf
+        if not getattr(conf, "QUERY_PLAN", True) or self.plan is None:
+            if self.plan is None and self._plan_fallbacks:
+                self.rdd._query_fallbacks = list(self._plan_fallbacks)
+            return None
+        try:
+            from dpark_tpu.query.planner import plan_query
+            pq = plan_query(self.plan, self.rdd.ctx)
+        except Exception as e:
+            logger.debug("query planning unavailable: %s", e)
+            return None
+        if pq.ok:
+            self._planned_q = pq
+        else:
+            # host path serves the query; the planner's reasons ride
+            # the lineage for the table-host-fallback lint rule (the
+            # pre-flight twin of the runtime fallback_reason)
+            self.rdd._query_fallbacks = (list(self._plan_fallbacks)
+                                         + list(pq.fallbacks))
+            self._host_sig = pq.adapt_sig
+        return self._planned_q
+
+    def _host_observe(self, t0):
+        """Feed the cost model the host side's observed wall ms when a
+        priced/declined plan ran the object path (adapt decision
+        point 2 at query granularity)."""
+        sig = getattr(self, "_host_sig", None)
+        if sig is None:
+            return
+        try:
+            from dpark_tpu import adapt
+            adapt.observe_path(sig, "host", (time.time() - t0) * 1e3)
+        except Exception:
+            pass
+
+    def explain(self):
+        """The logical plan + every planner rule decision (device or
+        host, with reasons) — '' when no plan lowered."""
+        pq = self._planned()
+        if pq is not None:
+            return pq.explain()
+        lines = ["plan: host object path"]
+        for f in self._plan_fallbacks:
+            lines.append("  [%s] %s" % (f["op"], f["reason"]))
+        return "\n".join(lines)
 
     # -- basic relational ops -------------------------------------------
     def select(self, *cols):
@@ -200,19 +340,37 @@ class TableRDD:
         names = [n for n, _ in parsed]
         fns = [fn for _, fn in parsed]
         out = self.rdd.map(_SelectFn(fns))
-        return TableRDD(out, names, self.name)
+        plan = None
+        err = None
+        if self.plan is not None:
+            from dpark_tpu.query.logical import Project
+            ces, err = self._qexprs([fn.expr for fn in fns])
+            if ces is not None:
+                plan = Project(self.plan, list(zip(names, ces)))
+        return self._derive(out, names, plan, op="select", reason=err)
 
     def where(self, *conditions):
-        conds = [_compile_expr(c, self.fields)
-                 for c in _split_cols(conditions)]
+        texts = _split_cols(conditions)
+        conds = [_compile_expr(c, self.fields) for c in texts]
         out = self.rdd.filter(_WhereFn(conds))
-        return TableRDD(out, self.fields, self.name)
+        plan = None
+        err = None
+        if self.plan is not None:
+            from dpark_tpu.query.logical import Filter
+            ces, err = self._qexprs(texts)
+            if ces is not None:
+                plan = Filter(self.plan, ces)
+        return self._derive(out, self.fields, plan, op="where",
+                            reason=err)
 
     filter = where
 
     def groupBy(self, keys, *aggs, **named_aggs):
         key_cols = _split_cols((keys,) if isinstance(keys, str) else keys)
         key_fns = [_compile_expr(k, self.fields) for k in key_cols]
+        udas = [a for a in aggs if isinstance(a, _UDA)]
+        if udas:
+            return self._group_uda(key_cols, key_fns, aggs, named_aggs)
         parsed = [_parse_column(a, self.fields, i)
                   for i, a in enumerate(_split_cols(aggs))]
         for name, expr in sorted(named_aggs.items()):
@@ -231,7 +389,64 @@ class TableRDD:
         names = [re.sub(r"\W+", "_", k).strip("_") or ("k%d" % i)
                  for i, k in enumerate(key_cols)]
         names += [n for n, _ in parsed]
-        return TableRDD(out, names, self.name)
+        plan, err = self._group_plan(key_cols, names[:len(key_cols)],
+                                     parsed)
+        return self._derive(out, names, plan, op="group-agg",
+                            reason=err)
+
+    def _group_plan(self, key_cols, key_names, parsed):
+        """(GroupAgg node, None) or (None, decline reason)."""
+        if self.plan is None:
+            return None, None
+        from dpark_tpu.query.logical import GroupAgg
+        kces, err = self._qexprs(key_cols)
+        if kces is None:
+            return None, err
+        agg_specs = []
+        for name, agg in parsed:
+            arg_ce = None
+            if agg.arg_fn is not None:
+                ces, err = self._qexprs([agg.arg_fn.expr])
+                if ces is None:
+                    return None, err
+                arg_ce = ces[0]
+            elif agg.func != "count":
+                return None, ("aggregate %s(*) needs an argument "
+                              "column for the device plan" % agg.func)
+            agg_specs.append((name, agg.func, arg_ce, None))
+        return GroupAgg(self.plan, list(zip(key_names, kces)),
+                        agg_specs), None
+
+    def _group_uda(self, key_cols, key_fns, aggs, named_aggs):
+        """groupBy with a user-defined aggregate: the per-group value
+        list of ONE argument column folds through fn(values) — host
+        via groupByKey().mapValues, device via the SegMapOp segmented
+        apply over the same graph."""
+        if named_aggs or len(aggs) != 1:
+            raise ValueError("a uda() must be the only groupBy "
+                             "aggregate")
+        (u,) = aggs
+        arg_fn = _compile_expr(u.expr, self.fields)
+        keyed = self.rdd.map(_UDAPairFn(key_fns, arg_fn))
+        out = keyed.groupByKey().mapValues(u.fn) \
+            .map(_UDAFlatten(len(key_cols)))
+        names = [re.sub(r"\W+", "_", k).strip("_") or ("k%d" % i)
+                 for i, k in enumerate(key_cols)]
+        names += [u.name]
+        plan = None
+        err = None
+        if self.plan is not None:
+            from dpark_tpu.query.logical import GroupAgg
+            kces, e1 = self._qexprs(key_cols)
+            aces, e2 = self._qexprs([u.expr])
+            err = e1 or e2
+            if kces is not None and aces is not None:
+                plan = GroupAgg(
+                    self.plan,
+                    list(zip(names[:len(key_cols)], kces)),
+                    [(u.name, "uda", aces[0], u.fn)])
+        return self._derive(out, names, plan, op="group-agg",
+                            reason=err)
 
     def _aggregate_all(self, parsed):
         aggs = [fn for _, fn in parsed]
@@ -254,12 +469,19 @@ class TableRDD:
         return TableRDD(out, [n for n, _ in parsed], self.name)
 
     def sort(self, key, reverse=False, numSplits=None):
-        fns = [_compile_expr(k, self.fields)
-               for k in _split_cols((key,) if isinstance(key, str)
-                                    else key)]
+        texts = _split_cols((key,) if isinstance(key, str) else key)
+        fns = [_compile_expr(k, self.fields) for k in texts]
         out = self.rdd.sort(key=_GroupKeyFn(fns), reverse=reverse,
                             numSplits=numSplits)
-        return TableRDD(out, self.fields, self.name)
+        plan = None
+        err = None
+        if self.plan is not None:
+            from dpark_tpu.query.logical import Sort
+            ces, err = self._qexprs(texts)
+            if ces is not None:
+                plan = Sort(self.plan, ces, reverse=reverse)
+        return self._derive(out, self.fields, plan, op="sort",
+                            reason=err)
 
     def top(self, n=10, key=None, reverse=False):
         if key is None:
@@ -269,8 +491,16 @@ class TableRDD:
                    for k in _split_cols((key,) if isinstance(key, str)
                                         else key)]
             key_fn = _GroupKeyFn(fns)
-        return [self._row_type(*r)
-                for r in self.rdd.top(n, key=key_fn, reverse=reverse)]
+        rows = self._plan_rows()
+        if rows is not None:
+            import heapq
+            pick = heapq.nsmallest if reverse else heapq.nlargest
+            return [self._row_type(*r) for r in pick(n, rows, key_fn)]
+        t0 = time.time()
+        out = [self._row_type(*r)
+               for r in self.rdd.top(n, key=key_fn, reverse=reverse)]
+        self._host_observe(t0)
+        return out
 
     def join(self, other, on, numSplits=None):
         """Equi-join on a column name present in both tables."""
@@ -287,25 +517,83 @@ class TableRDD:
         fields = ([on] + [f for f in self.fields if f != on]
                   + [f if f not in self.fields else other.name + "_" + f
                      for f in other.fields if f != on])
-        # ensure uniqueness
+        # ensure uniqueness, tracking which source column each output
+        # name came from (the plan's join column map)
+        srcs = ([("on", on)]
+                + [("l", f) for f in self.fields if f != on]
+                + [("r", f) for f in other.fields if f != on])
         seen, uniq = set(), []
         for f in fields:
             while f in seen:
                 f = f + "_"
             seen.add(f)
             uniq.append(f)
-        return TableRDD(out, uniq, self.name)
+        plan = None
+        if self.plan is not None and other.plan is not None:
+            from dpark_tpu.query.logical import Join
+            colmap = [(out_name, side, src) for out_name, (side, src)
+                      in zip(uniq, srcs)]
+            plan = Join(self.plan, other.plan, on, uniq)
+            plan.colmap = colmap
+            return self._derive(out, uniq, plan)
+        reason = None
+        if self.plan is not None and other.plan is None:
+            reason = ("join input %r has no columnar plan"
+                      % other.name)
+        return self._derive(out, uniq, None, op="join", reason=reason)
 
     # -- actions ---------------------------------------------------------
+    def _plan_call(self, method, *args):
+        """(result, served) via the physical plan; (None, False) means
+        the host path serves.  EVERY plan action funnels through here
+        so a run-time plan failure (mixed-type column, missing file)
+        records its reason on the lineage for the table-host-fallback
+        lint rule regardless of which action tripped it."""
+        pq = self._planned()
+        if pq is None:
+            return None, False
+        try:
+            return getattr(pq, method)(*args), True
+        except Exception as e:
+            # the host chain is always correct — serve from it and
+            # record why
+            logger.warning("query plan failed at run time (%s); "
+                           "host path", e)
+            self._note_fallback("run", "plan execution failed: %s"
+                                % str(e)[:160])
+            self._planned_q = None
+            self.rdd._query_fallbacks = list(self._plan_fallbacks)
+            return None, False
+
+    def _plan_rows(self):
+        """Rows via the physical plan, or None (host path serves)."""
+        rows, served = self._plan_call("rows")
+        return rows if served else None
+
     def collect(self):
-        return [self._row_type(*r) if isinstance(r, tuple)
-                else self._row_type(r) for r in self.rdd.collect()]
+        rows = self._plan_rows()
+        if rows is not None:
+            return [self._row_type(*r) for r in rows]
+        t0 = time.time()
+        out = [self._row_type(*r) if isinstance(r, tuple)
+               else self._row_type(r) for r in self.rdd.collect()]
+        self._host_observe(t0)
+        return out
 
     def take(self, n):
+        rows = self._plan_rows()
+        if rows is not None:
+            return [self._row_type(*r) for r in rows[:n]]
         return [self._row_type(*r) for r in self.rdd.take(n)]
 
     def count(self):
-        return self.rdd.count()
+        got, served = self._plan_call("count")
+        if served:
+            return got
+        t0 = time.time()
+        out = self.rdd.count()
+        self._host_observe(t0)
+        return out
 
     def save(self, path):
         return self.rdd.saveAsCSVFile(path)
@@ -356,22 +644,71 @@ def _sub_aggs(expr, add_agg):
 
 def _mask_literals(sql):
     """Same-length copy of `sql` with quoted-string contents blanked, so
-    clause keywords inside literals don't split the query."""
+    clause keywords inside literals don't split the query.  Handles
+    BOTH escape spellings inside a literal: backslash (``'don\\'t'``)
+    and the SQL doubled quote (``'don''t'``) — a doubled quote
+    continues the literal instead of closing and reopening it, so an
+    expression like ``item == 'don''t, group by'`` masks as ONE
+    literal and its embedded clause keywords/commas never split the
+    query."""
     out = list(sql)
     i = 0
     while i < len(out):
         q = out[i]
         if q in "'\"":
             i += 1
-            while i < len(out) and out[i] != q:
+            while i < len(out):
                 if out[i] == "\\" and i + 1 < len(out):
                     out[i] = "x"
                     out[i + 1] = "x"    # escaped char incl. quote
                     i += 2
                     continue
+                if out[i] == q:
+                    if i + 1 < len(out) and out[i + 1] == q:
+                        out[i] = "x"    # SQL '' escape: still inside
+                        out[i + 1] = "x"
+                        i += 2
+                        continue
+                    break
                 out[i] = "x"
                 i += 1
         i += 1
+    return "".join(out)
+
+
+def _sql_quote_escapes(text):
+    """SQL doubled-quote escapes translated to Python backslash form,
+    so an extracted clause like ``item == 'don''t'`` compiles with
+    eval to the string ``don't`` instead of the implicit concatenation
+    ``dont``.  Backslash escapes pass through untouched."""
+    out = []
+    i = 0
+    n = len(text)
+    while i < n:
+        ch = text[i]
+        out.append(ch)
+        i += 1
+        if ch not in "'\"":
+            continue
+        q = ch
+        while i < n:
+            c2 = text[i]
+            if c2 == "\\" and i + 1 < n:
+                out.append(c2)
+                out.append(text[i + 1])
+                i += 2
+                continue
+            if c2 == q:
+                if i + 1 < n and text[i + 1] == q:
+                    out.append("\\")
+                    out.append(q)
+                    i += 2
+                    continue
+                out.append(q)
+                i += 1
+                break
+            out.append(c2)
+            i += 1
     return "".join(out)
 
 
@@ -394,7 +731,12 @@ def execute(sql, tables):
 
     def part(name):
         span = m.span(name)
-        return sql[span[0]:span[1]] if span != (-1, -1) else None
+        if span == (-1, -1):
+            return None
+        # clause text is extracted from the ORIGINAL sql (the masked
+        # copy only guides the split); SQL '' escapes inside string
+        # literals translate to Python form before any eval/compile
+        return _sql_quote_escapes(sql[span[0]:span[1]])
 
     t = tables.get(m.group("table"))
     if t is None:
@@ -502,11 +844,33 @@ def _split_cols(cols):
     for c in cols:
         if isinstance(c, (list, tuple)):
             out.extend(_split_cols(c))
+        elif isinstance(c, _UDA):
+            out.append(c)
         else:
-            # split on top-level commas (not inside parens)
-            depth, cur = 0, ""
-            for ch in c:
-                if ch == "(":
+            # split on top-level commas — not inside parens and not
+            # inside string literals (a comma embedded in 'a, b' or a
+            # ''-escaped literal must not split the expression)
+            depth, cur, q = 0, "", None
+            i = 0
+            while i < len(c):
+                ch = c[i]
+                if q is not None:
+                    cur += ch
+                    if ch == "\\" and i + 1 < len(c):
+                        cur += c[i + 1]
+                        i += 2
+                        continue
+                    if ch == q:
+                        if i + 1 < len(c) and c[i + 1] == q:
+                            cur += c[i + 1]     # '' escape
+                            i += 2
+                            continue
+                        q = None
+                    i += 1
+                    continue
+                if ch in "'\"":
+                    q = ch
+                elif ch == "(":
                     depth += 1
                 elif ch == ")":
                     depth -= 1
@@ -515,9 +879,32 @@ def _split_cols(cols):
                     cur = ""
                 else:
                     cur += ch
+                i += 1
             if cur.strip():
                 out.append(cur.strip())
     return out
+
+
+class _UDAPairFn:
+    def __init__(self, key_fns, arg_fn):
+        self.key_fns = key_fns
+        self.arg_fn = arg_fn
+
+    def __call__(self, row):
+        if len(self.key_fns) == 1:
+            return (self.key_fns[0](row), self.arg_fn(row))
+        return (tuple(fn(row) for fn in self.key_fns),
+                self.arg_fn(row))
+
+
+class _UDAFlatten:
+    def __init__(self, n_keys):
+        self.n_keys = n_keys
+
+    def __call__(self, kv):
+        k, v = kv
+        keys = k if isinstance(k, tuple) and self.n_keys > 1 else (k,)
+        return tuple(keys) + (v,)
 
 
 class _SelectFn:

@@ -5,12 +5,22 @@ Reference parity: dpark/tabular.py + dpark/bitindex.py (SURVEY.md section
 2.3) — column chunks with per-column compression and an optional index
 enabling predicate-pruned scans.  Format here is an original design with
 the same capabilities, numpy-friendly so ingestion to device columns is a
-memcpy:
+memcpy.
 
-  file := header_json_len(4) header_json chunk*
-  chunk: per-column compressed numpy buffers (or pickled object columns),
-         with min/max statistics per numeric column in the header for
-         chunk pruning (the bitmap-index analog).
+Two on-disk versions, one reader:
+
+  v1 (magic ``DTB1``, read-only compat):
+      magic(4) header_json_len(4) header_json chunk-payload*
+  v2 (magic ``DTB2``, what write_tabular emits):
+      magic(4) chunk-payload* footer_json footer_len(4) magic(4)
+
+v2 moved the metadata to a FOOTER so the writer streams chunks to disk
+as they fill instead of buffering every compressed payload in memory,
+and extended the per-chunk per-column statistics: min/max for every
+numeric column (exact ints via .item()) plus a null count (``None``
+entries of object columns, NaNs of float columns).  The query planner's
+chunk-skip pushdown (dpark_tpu/query/) reads these stats; old v1 files
+still read (their headers carry min/max but no null counts).
 """
 
 import json
@@ -24,35 +34,103 @@ import numpy as np
 from dpark_tpu.rdd import RDD, Split, DerivedRDD
 from dpark_tpu.utils import atomic_file
 
-MAGIC = b"DTB1"
+MAGIC = b"DTB1"            # v1: header at the front (read-only compat)
+MAGIC2 = b"DTB2"           # v2: streamed chunks + stats footer
+FOOTER_VERSION = 2
 
 
 def _pack_column(arr):
     arr = np.asarray(arr)
     if arr.dtype == object or arr.dtype.kind in "US":
-        payload = zlib.compress(pickle.dumps(list(arr), -1))
-        return {"kind": "object"}, payload
+        # tolist() (not list()) so '<U' string arrays pickle PYTHON
+        # strs, not np.str_ scalars — readers feed these to
+        # partitioners/joins, where a np.str_ twin of an equal str
+        # must not exist on disk at all
+        vals = arr.tolist()
+        payload = zlib.compress(pickle.dumps(vals, -1))
+        meta = {"kind": "object",
+                "nulls": sum(1 for v in vals if v is None)}
+        return meta, payload
     payload = zlib.compress(np.ascontiguousarray(arr).tobytes())
     meta = {"kind": "numpy", "dtype": str(arr.dtype),
             "shape": list(arr.shape)}
     if arr.size and arr.dtype.kind in "if":
-        # .item() keeps integers exact (floats above 2**53 would make
-        # chunk pruning skip matching data)
-        meta["min"] = arr.min().item()
-        meta["max"] = arr.max().item()
+        if arr.dtype.kind == "f":
+            nulls = int(np.count_nonzero(np.isnan(arr)))
+            meta["nulls"] = nulls
+            finite = arr[~np.isnan(arr)] if nulls else arr
+        else:
+            meta["nulls"] = 0
+            finite = arr
+        if finite.size:
+            # .item() keeps integers exact (floats above 2**53 would
+            # make chunk pruning skip matching data)
+            meta["min"] = finite.min().item()
+            meta["max"] = finite.max().item()
     return meta, payload
 
 
 def _unpack_column(meta, payload):
     if meta["kind"] == "object":
-        return pickle.loads(zlib.decompress(payload))
+        vals = pickle.loads(zlib.decompress(payload))
+        # files written before the tolist() fix carry np.str_ scalars;
+        # normalize on read so equal keys hash/compare as one type
+        if vals and isinstance(vals[0], np.generic):
+            vals = [v.item() if isinstance(v, np.generic) else v
+                    for v in vals]
+        return vals
     buf = zlib.decompress(payload)
     arr = np.frombuffer(buf, dtype=np.dtype(meta["dtype"]))
     return arr.reshape(meta["shape"])
 
 
-def write_tabular(path, fields, rows, chunk_rows=65536):
-    """rows: iterable of tuples matching `fields`."""
+def write_tabular(path, fields, rows, chunk_rows=65536,
+                  version=FOOTER_VERSION):
+    """rows: iterable of tuples matching `fields`.  Writes the v2
+    footer format: chunk payloads stream to disk as they fill, the
+    stats footer (per-chunk per-column min/max + null counts, version
+    byte) lands at the end.  version=1 emits the legacy front-header
+    layout (compat regression tests; real writers keep the default)."""
+    if version == 1:
+        return _write_tabular_v1(path, fields, rows, chunk_rows)
+    chunks = []
+    buf = []
+
+    with atomic_file(path) as f:
+        f.write(MAGIC2)
+
+        def flush():
+            if not buf:
+                return
+            cols = list(zip(*buf))
+            metas = []
+            offs = []
+            for col in cols:
+                meta, payload = _pack_column(np.asarray(col))
+                offs.append(len(payload))
+                metas.append(meta)
+                f.write(payload)
+            chunks.append({"rows": len(buf), "columns": metas,
+                           "sizes": offs})
+            buf.clear()
+
+        for row in rows:
+            buf.append(tuple(row))
+            if len(buf) >= chunk_rows:
+                flush()
+        flush()
+        footer = json.dumps({"version": FOOTER_VERSION,
+                             "fields": list(fields),
+                             "chunks": chunks}).encode("utf-8")
+        f.write(footer)
+        f.write(struct.pack("<I", len(footer)))
+        f.write(MAGIC2)
+    return path
+
+
+def _write_tabular_v1(path, fields, rows, chunk_rows):
+    """Legacy layout (front header, payloads buffered): exists so the
+    old-files-still-read contract stays pinned by a real v1 writer."""
     chunks = []
     payloads = []
     buf = []
@@ -65,10 +143,13 @@ def write_tabular(path, fields, rows, chunk_rows=65536):
         offs = []
         for col in cols:
             meta, payload = _pack_column(np.asarray(col))
+            # v1 headers never carried null counts
+            meta.pop("nulls", None)
             offs.append(len(payload))
             metas.append(meta)
             payloads.append(payload)
-        chunks.append({"rows": len(buf), "columns": metas, "sizes": offs})
+        chunks.append({"rows": len(buf), "columns": metas,
+                       "sizes": offs})
         buf.clear()
 
     for row in rows:
@@ -88,30 +169,78 @@ def write_tabular(path, fields, rows, chunk_rows=65536):
 
 
 def read_header(path):
+    """Format-version-dispatching metadata read: v2 footers and v1
+    front headers both come back as the same dict shape ({"version",
+    "fields", "chunks", "data_offset"})."""
     with open(path, "rb") as f:
-        if f.read(4) != MAGIC:
+        magic = f.read(4)
+        if magic == MAGIC2:
+            f.seek(-8, os.SEEK_END)
+            tail = f.read(8)
+            (n,) = struct.unpack("<I", tail[:4])
+            if tail[4:] != MAGIC2:
+                raise IOError("truncated tabular v2 file: %s" % path)
+            f.seek(-(8 + n), os.SEEK_END)
+            header = json.loads(f.read(n).decode("utf-8"))
+            header["data_offset"] = 4
+            header.setdefault("version", FOOTER_VERSION)
+            return header
+        if magic != MAGIC:
             raise IOError("not a tabular file: %s" % path)
         (n,) = struct.unpack("<I", f.read(4))
         header = json.loads(f.read(n).decode("utf-8"))
         header["data_offset"] = f.tell()
+        header["version"] = 1
     return header
 
 
-def read_chunks(path, wanted_fields=None, predicate_ranges=None):
+def chunk_stats(path):
+    """Per-chunk, per-column statistics: a list (one entry per chunk)
+    of {"rows": n, "columns": {field: {"min", "max", "nulls"}}} — the
+    chunk-skip substrate the query planner's pushdown rule reads.
+    Fields whose column kind carries no stats map to {} (v1 object
+    columns); v1 numeric columns have min/max but no null counts."""
+    header = read_header(path)
+    out = []
+    for chunk in header["chunks"]:
+        cols = {}
+        for name, meta in zip(header["fields"], chunk["columns"]):
+            st = {}
+            for k in ("min", "max", "nulls"):
+                if k in meta:
+                    st[k] = meta[k]
+            cols[name] = st
+        out.append({"rows": chunk["rows"], "columns": cols})
+    return out
+
+
+def read_chunks(path, wanted_fields=None, predicate_ranges=None,
+                stats=None):
     """Yield dicts of column-name -> array per chunk.
 
     wanted_fields: subset of columns to materialize (column pruning).
     predicate_ranges: {field: (lo, hi)} — chunks whose min/max statistics
     cannot intersect are skipped without reading their bytes.
+    stats: optional dict the reader fills with scan accounting
+    (chunks_total / chunks_skipped / columns_read / bytes_read) — the
+    observability the query plane's "reads only referenced columns"
+    acceptance asserts against.
     """
     header = read_header(path)
     fields = header["fields"]
     want = wanted_fields or fields
+    if stats is not None:
+        stats.setdefault("chunks_total", 0)
+        stats.setdefault("chunks_skipped", 0)
+        stats.setdefault("bytes_read", 0)
+        cols_read = stats.setdefault("columns_read", set())
     with open(path, "rb") as f:
         off = header["data_offset"]
         for chunk in header["chunks"]:
             sizes = chunk["sizes"]
             metas = chunk["columns"]
+            if stats is not None:
+                stats["chunks_total"] += 1
             # chunk pruning via column stats
             skip = False
             if predicate_ranges:
@@ -126,6 +255,8 @@ def read_chunks(path, wanted_fields=None, predicate_ranges=None):
                             break
             if skip:
                 off += sum(sizes)
+                if stats is not None:
+                    stats["chunks_skipped"] += 1
                 continue
             out = {}
             coff = off
@@ -134,6 +265,9 @@ def read_chunks(path, wanted_fields=None, predicate_ranges=None):
                     f.seek(coff)
                     payload = f.read(sizes[fi])
                     out[name] = _unpack_column(metas[fi], payload)
+                    if stats is not None:
+                        stats["bytes_read"] += sizes[fi]
+                        cols_read.add(name)
                 coff += sizes[fi]
             off += sum(sizes)
             yield chunk["rows"], out

@@ -31,6 +31,8 @@ _FNV_PRIME = 0x01000193
 _MASK = 0xFFFFFFFF
 TUPLE_SEED = 0x345678
 TUPLE_MULT = 0x9E3779B1
+_INF = float("inf")
+_NINF = float("-inf")
 
 
 def fmix32(h):
@@ -67,6 +69,10 @@ def portable_hash(obj):
     if t is int:
         return _hash_int(obj)
     if t is float:
+        # NaN/inf first: int(obj) raises on them (== int(obj) crashed
+        # any NaN-keyed partition before this guard)
+        if obj != obj or obj == _INF or obj == _NINF:
+            return _hash_bytes(struct.pack("<d", obj))
         if obj == int(obj) and abs(obj) < 2 ** 62:
             return _hash_int(int(obj))     # hash(1.0) == hash(1)
         return _hash_bytes(struct.pack("<d", obj))
@@ -79,6 +85,32 @@ def portable_hash(obj):
         for item in obj:
             h = ((h ^ portable_hash(item)) * TUPLE_MULT) & _MASK
         return fmix32(h ^ len(obj))
+    # subclasses and numpy scalars hash AS THEIR VALUE: dict/partition
+    # semantics treat np.str_('w') == 'w' and np.int64(3) == 3 as the
+    # same key, so the partitioner must agree — the exact-type pickle
+    # fallback silently routed equal keys to different partitions
+    # (found by the query parity fuzzer joining a tabular string
+    # column against parallelize'd python strs)
+    if isinstance(obj, str):
+        return _hash_bytes(obj.encode("utf-8"))
+    if isinstance(obj, bytes):
+        return _hash_bytes(bytes(obj))
+    if isinstance(obj, bool):
+        return _hash_int(int(obj))
+    if isinstance(obj, int):
+        return _hash_int(int(obj))
+    try:
+        import numpy as _np
+        if isinstance(obj, _np.bool_):
+            return _hash_int(int(obj))
+        if isinstance(obj, _np.integer):
+            return _hash_int(int(obj))
+        if isinstance(obj, _np.floating):
+            return portable_hash(float(obj))
+    except ImportError:
+        pass
+    if isinstance(obj, float):
+        return portable_hash(float(obj))
     # fallback: structural hash via pickled bytes (deterministic for the
     # value types that reach partitioners in practice)
     import pickle

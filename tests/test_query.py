@@ -1,0 +1,566 @@
+"""Columnar query plane (ISSUE 13): tabular v2 footer stats, the
+vectorizing expression compiler's exact admissions, planner rules
+(pruning / pushdown / chunk skip / group + join lowering / pricing),
+the table-host-fallback lint rule, and the SQL literal-escape
+regressions."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# tabular v2 footer (satellite 1)
+# ---------------------------------------------------------------------------
+
+def _write(tmp_path, rows, fields, name="t.tab", chunk_rows=1000,
+           version=2):
+    from dpark_tpu.tabular import write_tabular
+    p = str(tmp_path / name)
+    write_tabular(p, fields, rows, chunk_rows=chunk_rows,
+                  version=version)
+    return p
+
+
+def test_tabular_v2_footer_stats(tmp_path):
+    from dpark_tpu.tabular import chunk_stats, read_header
+    rows = [(i, float(i) if i % 10 else float("nan"),
+             None if i % 7 == 0 else "s%d" % i)
+            for i in range(2500)]
+    p = _write(tmp_path, rows, ["a", "f", "s"], chunk_rows=1000)
+    h = read_header(p)
+    assert h["version"] == 2
+    st = chunk_stats(p)
+    assert len(st) == 3 and st[0]["rows"] == 1000
+    a0 = st[0]["columns"]["a"]
+    assert a0["min"] == 0 and a0["max"] == 999 and a0["nulls"] == 0
+    # float NaNs count as nulls and stay out of min/max
+    f0 = st[0]["columns"]["f"]
+    assert f0["nulls"] == 100
+    assert f0["min"] == 1.0
+    # object columns count None entries
+    s0 = st[0]["columns"]["s"]
+    assert s0["nulls"] == sum(1 for i in range(1000) if i % 7 == 0)
+
+
+def test_tabular_v1_files_still_read(tmp_path):
+    from dpark_tpu.tabular import chunk_stats, read_chunks, read_header
+    rows = [(i, i * 2) for i in range(500)]
+    p = _write(tmp_path, rows, ["a", "b"], chunk_rows=200, version=1)
+    h = read_header(p)
+    assert h["version"] == 1
+    got = []
+    for n, cols in read_chunks(p):
+        got.extend(zip(cols["a"].tolist(), cols["b"].tolist()))
+    assert got == rows
+    # v1 numeric headers carry min/max (no null counts)
+    st = chunk_stats(p)
+    assert st[0]["columns"]["a"]["min"] == 0
+    assert "nulls" not in st[0]["columns"]["a"]
+
+
+def test_tabular_v1_v2_same_rows(tmp_path):
+    from dpark_tpu.tabular import read_chunks
+    rows = [(i, "w%d" % (i % 3)) for i in range(700)]
+    p1 = _write(tmp_path, rows, ["a", "s"], "v1.tab", 300, version=1)
+    p2 = _write(tmp_path, rows, ["a", "s"], "v2.tab", 300, version=2)
+
+    def all_rows(p):
+        out = []
+        for n, cols in read_chunks(p):
+            out.extend(zip(cols["a"].tolist(), list(cols["s"])))
+        return out
+    assert all_rows(p1) == all_rows(p2) == rows
+
+
+def test_read_chunks_stats_accounting(tmp_path):
+    from dpark_tpu.tabular import read_chunks
+    rows = [(i, i % 5, i * 3) for i in range(4000)]
+    p = _write(tmp_path, rows, ["x", "y", "z"], chunk_rows=1000)
+    stats = {}
+    chunks = list(read_chunks(p, wanted_fields=["x"],
+                              predicate_ranges={"x": (2500, 2600)},
+                              stats=stats))
+    assert len(chunks) == 1
+    assert stats["chunks_total"] == 4
+    assert stats["chunks_skipped"] == 3
+    assert stats["columns_read"] == {"x"}
+
+
+# ---------------------------------------------------------------------------
+# expression vectorizer: exact admissions
+# ---------------------------------------------------------------------------
+
+def _vec(expr, dtypes, ranges=None, boolean=False):
+    from dpark_tpu.query.exprs import compile_expr, vectorize
+    ce = compile_expr(expr, list(dtypes))
+    return vectorize(ce, dtypes, ranges, boolean=boolean)
+
+
+def test_vectorize_arithmetic_matches_host():
+    env = {"a": np.array([3, -7, 0, 12], np.int64),
+           "f": np.array([1.5, -2.0, 0.25, 9.0], np.float64)}
+    dt = {"a": np.int64, "f": np.float64}
+    rg = {"a": (-7, 12)}
+    for expr in ("a * 2 + 1", "a % 5", "a // 3", "a / 2",
+                 "f * a - 1", "abs(a)", "min(a, 4)", "max(a, f)",
+                 "-a + 7", "float(a)"):
+        ve, reason = _vec(expr, dt, rg)
+        assert ve is not None, (expr, reason)
+        got = ve.fn(env)
+        code = compile(expr, "<t>", "eval")
+        for i in range(4):
+            exp = eval(code, {"__builtins__": {
+                "abs": abs, "min": min, "max": max, "float": float}},
+                {"a": int(env["a"][i]), "f": float(env["f"][i])})
+            g = got[i] if np.ndim(got) else got
+            assert float(g) == float(exp), (expr, i, g, exp)
+
+
+def test_vectorize_predicates_and_bool_ops():
+    env = {"a": np.array([1, 5, 9], np.int64),
+           "s": np.array(["x", "y", "x"], object)}
+    dt = {"a": np.int64, "s": object}
+    ve, _ = _vec("a > 2 and s == 'x'", dt, {"a": (1, 9)}, boolean=True)
+    assert ve.fn(env).tolist() == [False, False, True]
+    ve, _ = _vec("not (a > 2) or a == 9", dt, {"a": (1, 9)},
+                 boolean=True)
+    assert ve.fn(env).tolist() == [True, False, True]
+    ve, _ = _vec("2 < a < 9", dt, {"a": (1, 9)}, boolean=True)
+    assert ve.fn(env).tolist() == [False, True, False]
+
+
+def test_vectorize_exact_declines():
+    dt = {"a": np.int64, "b": np.int64, "s": object}
+    rg = {"a": (0, 2 ** 40), "b": (-5, 5)}
+    # int overflow: the host computes exact Python ints
+    ve, reason = _vec("a * a", dt, rg)
+    assert ve is None and "int64" in reason
+    # division by a maybe-zero column: the host raises
+    ve, reason = _vec("a / b", dt, rg)
+    assert ve is None and "nonzero" in reason
+    # and/or outside a predicate returns an operand on the host
+    ve, reason = _vec("a and b", dt, rg)
+    assert ve is None and "and/or" in reason
+    # string arithmetic has no device form
+    ve, reason = _vec("s + s", dt, rg)
+    assert ve is None
+    # unknown int range: no no-wrap proof
+    ve, reason = _vec("a + 1", {"a": np.int64}, {})
+    assert ve is None and "range" in reason
+
+
+def test_vectorize_min_nan_semantics_match_python():
+    # Python min(a, b) returns a when b is NaN (NaN never compares
+    # less); np.minimum would propagate the NaN
+    env = {"f": np.array([3.0, float("nan")], np.float64)}
+    ve, _ = _vec("min(f, 5.0)", {"f": np.float64})
+    got = ve.fn(env)
+    assert got[0] == 3.0
+    assert math.isnan(got[1]) == math.isnan(min(float("nan"), 5.0))
+    ve, _ = _vec("max(f, 5.0)", {"f": np.float64})
+    assert ve.fn(env)[0] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# planner rules
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def tab(ctx, tmp_path):
+    rows = [(i % 97, i % 50, i % 7, (i % 13) * 0.5,
+             "s%d" % (i % 5)) for i in range(20000)]
+    path = str(tmp_path / "tab")
+    os.makedirs(path)
+    _write(tmp_path / "tab", rows, ["k", "a", "b", "f", "s"],
+           "part-00000.tab", chunk_rows=2000)
+    return ctx.tabular(path).asTable("t"), rows
+
+
+def _decisions(t, rule):
+    pq = t._planned()
+    assert pq is not None, t.explain()
+    return [d for d in pq.decisions if d["rule"] == rule]
+
+
+def test_planner_prunes_and_pushes(tab):
+    t, rows = tab
+    q = t.where("a > 44").groupBy("k", "sum(b) as sb")
+    got = {r.k: r.sb for r in q.collect()}
+    exp = {}
+    for k, a, b, f, s in rows:
+        if a > 44:
+            exp[k] = exp.get(k, 0) + b
+    assert got == exp
+    pq = q._planned()
+    assert pq.ok and pq.scan_stats["columns_read"] == {"k", "a", "b"}
+    # chunk-skip: a is i%50 per 2000-row chunk, so no chunk can be
+    # skipped on a>44 — but the ranges must have been extracted
+    assert any("chunk-skip" in d["reason"] for d in
+               _decisions(q, "pushdown-predicate"))
+
+
+def test_planner_chunk_skip_actually_skips(ctx, tmp_path):
+    # monotone column: most chunks provably cannot match
+    rows = [(i, i % 3) for i in range(10000)]
+    path = str(tmp_path / "mono")
+    os.makedirs(path)
+    _write(tmp_path / "mono", rows, ["x", "y"], "part-00000.tab",
+           chunk_rows=1000)
+    t = ctx.tabular(path).asTable("t")
+    q = t.where("x >= 7500", "x < 7600")
+    got = q.collect()
+    assert len(got) == 100 and got[0].x == 7500
+    pq = q._planned()
+    assert pq.scan_stats["chunks_skipped"] >= 8, pq.scan_stats
+
+
+def test_chunk_skip_int_literal_over_float_column(ctx, tmp_path):
+    """Review regression: `f > 10` with an INT literal over a FLOAT
+    column must not tighten the skip bound to 11 — a chunk whose max
+    is 10.5 still matches."""
+    from dpark_tpu.tabular import write_tabular
+    rows = [(10.5, 1), (10.2, 2)]
+    path = str(tmp_path / "fskip")
+    os.makedirs(path)
+    write_tabular(os.path.join(path, "part-00000.tab"), ["f", "v"],
+                  rows, chunk_rows=10)
+    t = ctx.tabular(path).asTable("t")
+    q = t.where("f > 10")
+    got = sorted((r.f, r.v) for r in q.collect())
+    assert got == [(10.2, 2), (10.5, 1)]
+    pq = q._planned()
+    assert pq is not None and pq.scan_stats["chunks_skipped"] == 0
+    # host parity
+    from dpark_tpu import conf
+    conf.QUERY_PLAN = False
+    try:
+        t2 = ctx.tabular(path).asTable("t")
+        assert sorted((r.f, r.v)
+                      for r in t2.where("f > 10").collect()) == got
+    finally:
+        conf.QUERY_PLAN = True
+
+
+def test_runtime_fallback_recorded_from_count(ctx):
+    """Review regression: a run-time plan failure via count()/take()
+    records its reason for the lint rule, same as collect()."""
+    t = ctx.parallelize([(True, 1), (False, 2)], 2).asTable("b v")
+    q = t.groupBy("b", "sum(v) as sv")      # bool key fails at encode
+    assert q.count() == 2                   # host path serves
+    assert any("plan execution failed" in fb["reason"]
+               for fb in getattr(q.rdd, "_query_fallbacks", ())), \
+        getattr(q.rdd, "_query_fallbacks", None)
+
+
+def test_scan_only_runs_no_job(ctx):
+    ctx.start()
+    t = ctx.parallelize([(i, i * 2) for i in range(1000)], 4) \
+        .asTable("a b")
+    before = len(ctx.scheduler.history)
+    got = t.where("a % 2 == 0").select("b").collect()
+    assert len(got) == 500 and got[1].b == 4
+    assert t.where("a % 2 == 0").count() == 500
+    # the scan-only query answered from the columnar scan: no job ran
+    assert len(ctx.scheduler.history) == before
+
+
+def test_planner_decline_reasons(ctx):
+    t = ctx.parallelize([(1.5, 2, "x")] * 10, 2).asTable("f a s")
+    # float group key: no device hash semantics
+    q = t.groupBy("f", "sum(a) as sa")
+    assert q._planned() is None
+    assert any("float group" in fb["reason"]
+               for fb in q.rdd._query_fallbacks)
+    # string aggregate column
+    q2 = t.groupBy("a", "min(s) as ms")
+    assert q2._planned() is None
+    assert any("string aggregate" in fb["reason"]
+               for fb in q2.rdd._query_fallbacks)
+    # non-device aggregate (adcount) keeps the host path, with reason
+    q3 = t.groupBy("a", "adcount(s) as ds")
+    assert q3._planned() is None
+    assert any("non-device aggregate" in fb["reason"]
+               for fb in q3.rdd._query_fallbacks)
+    # results still correct through the host path
+    assert q3.collect()[0].ds >= 1
+
+
+def test_planner_int_sum_overflow_declines(ctx):
+    big = 2 ** 55
+    t = ctx.parallelize([(1, big), (1, big), (2, big)] * 200, 2) \
+        .asTable("k v")
+    q = t.groupBy("k", "sum(v) as sv")
+    assert q._planned() is None
+    assert any("overflow" in fb["reason"]
+               for fb in q.rdd._query_fallbacks)
+    got = {r.k: r.sv for r in q.collect()}      # host path: exact
+    assert got[1] == 400 * big
+
+
+def test_table_host_fallback_lint_rule(ctx):
+    from dpark_tpu.analysis import lint_plan
+    t = ctx.parallelize([(1.5, 2)] * 10, 2).asTable("f a")
+    q = t.groupBy("f", "sum(a) as sa")
+    assert q._planned() is None         # attaches _query_fallbacks
+    report = lint_plan(q.rdd)
+    finds = [x for x in report if x.rule == "table-host-fallback"]
+    assert finds and "float group" in finds[0].message
+
+
+def test_explain_text(ctx):
+    t = ctx.parallelize([(i % 5, i) for i in range(100)], 2) \
+        .asTable("k v")
+    q = t.where("v > 10").groupBy("k", "sum(v) as sv")
+    text = q.explain()
+    assert "GroupAgg" in text and "prune-columns" in text
+    assert "pushdown-predicate" in text
+
+
+def test_count_only_group(ctx):
+    """count(*)-only group-bys have no aggregate argument column —
+    the planner synthesizes the value leaf."""
+    t = ctx.parallelize([(i % 4, "u%d" % (i % 3)) for i in range(200)],
+                        2).asTable("k s")
+    q = t.groupBy("k", "count(*) as c")
+    assert q._planned() is not None, q.explain()
+    assert sorted((r.k, r.c) for r in q.collect()) == [
+        (0, 50), (1, 50), (2, 50), (3, 50)]
+    q2 = t.groupBy(["k", "s"], "count(*) as c")
+    got = sorted(tuple(r) for r in q2.collect())
+    exp = {}
+    for i in range(200):
+        exp[(i % 4, "u%d" % (i % 3))] = \
+            exp.get((i % 4, "u%d" % (i % 3)), 0) + 1
+    assert got == sorted((k, s, c) for (k, s), c in exp.items())
+
+
+def test_bool_and_none_keys_keep_host_values(ctx):
+    """Review regression: bool/None group keys must come back as their
+    ORIGINAL values, not TokenDict-stringified 'True'/'None' — the
+    encoder refuses non-str objects and the host path serves."""
+    t = ctx.parallelize([(True, 1), (False, 2), (True, 3)], 2) \
+        .asTable("flag v")
+    got = sorted((r.flag, r.sv)
+                 for r in t.groupBy("flag", "sum(v) as sv").collect())
+    assert got == [(False, 2), (True, 4)]
+    assert all(isinstance(k, bool) for k, _ in got)
+    t2 = ctx.parallelize([("a", 1), (None, 2), ("a", 3)], 2) \
+        .asTable("s v")
+    got2 = {r.s: r.sv
+            for r in t2.groupBy("s", "sum(v) as sv").collect()}
+    assert got2 == {"a": 4, None: 2}
+    assert None in got2
+
+
+def test_count_col_null_semantics(ctx):
+    """Review regression: count(col) skips None on the host — the
+    device plan must decline object-column counts, not count rows."""
+    t = ctx.parallelize([(1, "a"), (1, None), (2, "b")], 2) \
+        .asTable("k s")
+    q = t.groupBy("k", "count(s) as c")
+    got = {r.k: r.c for r in q.collect()}
+    assert got == {1: 1, 2: 1}
+    assert q._planned() is None
+    assert any("non-null" in fb["reason"]
+               for fb in q.rdd._query_fallbacks)
+    # numeric-argument counts can never see None: device plan rides
+    q2 = t.groupBy("k", "count(k) as c")
+    assert q2._planned() is not None
+    assert {r.k: r.c for r in q2.collect()} == {1: 2, 2: 1}
+
+
+def test_portable_hash_nan_inf_no_crash():
+    import numpy as np
+    from dpark_tpu.utils.phash import portable_hash
+    for v in (float("nan"), float("inf"), float("-inf"),
+              np.float64("nan"), np.float64("inf")):
+        assert isinstance(portable_hash(v), int)
+    assert portable_hash(float("nan")) == portable_hash(
+        np.float64("nan"))
+
+
+def test_mixed_chunk_dtypes_promote(ctx, tmp_path):
+    """Review regression: a column whose chunks mix int and float
+    resolves float64 for the whole scan (not the first chunk's int),
+    so values match the host's numerically for every row."""
+    from dpark_tpu.tabular import write_tabular
+    rows = [(1, 10), (2, 20), (2.5, 30), (3.5, 40)]
+    path = str(tmp_path / "mix")
+    os.makedirs(path)
+    write_tabular(os.path.join(path, "part-00000.tab"), ["x", "y"],
+                  rows, chunk_rows=2)
+    t = ctx.tabular(path).asTable("t")
+    got = [r.q for r in t.select("x * 2 as q").collect()]
+    assert got == [2.0, 4.0, 5.0, 7.0]
+    # int-only expressions over the promoted column are FLOAT now —
+    # // over floats declines, host path serves exactly
+    q2 = t.select("x // 1 as q")
+    assert [r.q for r in q2.collect()] == [1, 2, 2.0, 3.0]
+
+
+def test_fallback_provenance_not_shared(ctx):
+    """Review regression: one query's decline reason must not leak
+    into sibling queries built from the same base table."""
+    t = ctx.parallelize([(1.5, 2)] * 4, 2).asTable("f a")
+    q1 = t.groupBy("f", "sum(a) as sa")     # float key: declines
+    assert q1._planned() is None
+    q2 = t.select("a")
+    pq2 = q2._planned()
+    assert pq2 is not None and not q2._plan_fallbacks
+
+
+def test_query_knob_off_pins_host(ctx):
+    from dpark_tpu import conf
+    t = ctx.parallelize([(i % 3, i) for i in range(100)], 2) \
+        .asTable("k v")
+    q = t.groupBy("k", "sum(v) as sv")
+    old = conf.QUERY_PLAN
+    conf.QUERY_PLAN = False
+    try:
+        assert q._planned() is None
+        assert sorted((r.k, r.sv) for r in q.collect()) == [
+            (0, 1683), (1, 1617), (2, 1650)]
+    finally:
+        conf.QUERY_PLAN = old
+
+
+def test_adapt_observes_query_path(ctx, tmp_path, monkeypatch):
+    """Device runs of a planned query feed adapt decision point 2 with
+    observed ms under the query-level signature."""
+    from dpark_tpu import adapt
+    monkeypatch.setenv("DPARK_ADAPT_DIR", str(tmp_path / "adapt"))
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "adapt"))
+    try:
+        t = ctx.parallelize([(i % 5, i) for i in range(2000)], 2) \
+            .asTable("k v")
+        q = t.groupBy("k", "sum(v) as sv")
+        q.collect()
+        pq = q._planned()
+        assert pq is not None and pq.adapt_sig is not None
+        hist = adapt.stage_history()
+        key = "%s|%s" % pq.adapt_sig
+        assert key in hist and hist[key].get("device_ms") is not None
+    finally:
+        adapt.configure(mode=None, store_dir=None)
+
+
+# ---------------------------------------------------------------------------
+# SQL literal escapes (satellite 6)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def quoted(ctx):
+    rows = [("don't group by", 1), ("plain", 2), ("a, b", 3),
+            ("don't", 4)]
+    return ctx.parallelize(rows, 2).asTable("s v", name="q")
+
+
+def test_sql_doubled_quote_escape_matches(ctx, quoted):
+    got = ctx.sql("select * from q where s == 'don''t group by'",
+                  q=quoted).collect()
+    assert [(r.s, r.v) for r in got] == [("don't group by", 1)]
+    got = ctx.sql("select v from q where s == 'don''t'",
+                  q=quoted).collect()
+    assert [r.v for r in got] == [4]
+
+
+def test_sql_backslash_escape_still_works(ctx, quoted):
+    got = ctx.sql(r"select v from q where s == 'don\'t'",
+                  q=quoted).collect()
+    assert [r.v for r in got] == [4]
+
+
+def test_sql_comma_inside_literal_does_not_split(ctx, quoted):
+    got = ctx.sql("select v from q where s == 'a, b'",
+                  q=quoted).collect()
+    assert [r.v for r in got] == [3]
+    # and in a select list: the literal comma must not split columns
+    t = quoted.where("s == 'a, b'")
+    assert [r.v for r in t.collect()] == [3]
+
+
+def test_split_cols_quote_aware():
+    from dpark_tpu.table import _split_cols
+    assert _split_cols(("a, b",)) == ["a", "b"]
+    assert _split_cols(("s == 'x, y', v",)) == ["s == 'x, y'", "v"]
+    assert _split_cols(("s == 'it''s, fine', v",)) == \
+        ["s == 'it''s, fine'", "v"]
+
+
+def test_mask_literals_doubled_quotes():
+    from dpark_tpu.table import _mask_literals
+    masked = _mask_literals("where s == 'don''t group by' limit 3")
+    assert "group by" not in masked.replace("x", "")
+    assert len(masked) == len("where s == 'don''t group by' limit 3")
+    assert masked.endswith("limit 3")
+
+
+# ---------------------------------------------------------------------------
+# device acceptance (2-device mesh: runs anywhere)
+# ---------------------------------------------------------------------------
+
+def test_select_filter_group_all_array_tpu(tmp_path):
+    """ISSUE 13 acceptance shape: a select+filter+group-by query over
+    tabular input runs all-array end to end — every stage kind
+    "array", no fallback_reason, and the scan read only the referenced
+    columns."""
+    from dpark_tpu import DparkContext
+    rows = [(i % 53, i % 50, i % 7, (i % 13) * 0.5, "s%d" % (i % 5))
+            for i in range(30000)]
+    path = str(tmp_path / "tab")
+    os.makedirs(path)
+    _write(tmp_path / "tab", rows, ["k", "a", "b", "f", "s"],
+           "part-00000.tab", chunk_rows=4000)
+    tctx = DparkContext("tpu:2")
+    tctx.start()
+    try:
+        t = tctx.tabular(path).asTable("t")
+        q = t.where("a > 10").groupBy(
+            "k", "sum(b) as sb", "count(*) as c", "avg(f) as af")
+        n0 = len(tctx.scheduler.history)
+        got = sorted(q.collect())
+        exp = {}
+        for k, a, b, f, s in rows:
+            if a > 10:
+                sb, c, sf = exp.get(k, (0, 0, 0))
+                exp[k] = (sb + b, c + 1, sf + f)
+        assert got == sorted((k, sb, c, sf / c)
+                             for k, (sb, c, sf) in exp.items())
+        pq = q._planned()
+        assert pq.ok and pq.scan_stats["columns_read"] == \
+            {"k", "a", "b", "f"}
+        recs = tctx.scheduler.history[n0:]
+        assert recs, "planned query ran no job"
+        for rec in recs:
+            for st in rec.get("stage_info", []):
+                assert str(st.get("kind", "")).startswith("array"), st
+                assert not st.get("fallback_reason"), st
+    finally:
+        tctx.stop()
+
+
+def test_string_group_key_rides_encoded_tpu():
+    from dpark_tpu import DparkContext
+    rows = [("g%d" % (i % 11), i % 100) for i in range(20000)]
+    tctx = DparkContext("tpu:2")
+    tctx.start()
+    try:
+        t = tctx.parallelize(rows, 2).asTable("s v")
+        q = t.groupBy("s", "sum(v) as sv", "count(*) as c")
+        got = sorted(q.collect())
+        exp = {}
+        for s, v in rows:
+            sv, c = exp.get(s, (0, 0))
+            exp[s] = (sv + v, c + 1)
+        assert got == sorted((s, sv, c)
+                             for s, (sv, c) in exp.items())
+        rec = tctx.scheduler.history[-1]
+        for st in rec.get("stage_info", []):
+            assert str(st.get("kind", "")).startswith("array"), st
+        assert any(d["rule"] == "encode-strings"
+                   for d in q._planned().decisions)
+    finally:
+        tctx.stop()

@@ -46,6 +46,7 @@ def main():
     env.setdefault("BENCH_EXTRAS", "0")
     env.setdefault("BENCH_ADAPT_BASE_ROWS", "16384")
     env.setdefault("BENCH_BULK_ROWS", "250000")
+    env.setdefault("BENCH_TABLE_ROWS", "200000")
     env.setdefault("BENCH_PROBE_ATTEMPTS", "1")
     env.setdefault("BENCH_PROBE_TIMEOUT", "120")
     env.setdefault("BENCH_PLATFORM", "cpu")
@@ -299,6 +300,41 @@ def main():
         print("FAIL: groupmap device side left the array path: %r"
               % gm[0])
         return 1
+    # ISSUE 13: the columnar query plane A/B must be present with
+    # bit-parity between the device plan and the host row path, the
+    # device side fully on the array path (no fallback_reason on any
+    # stage — else the metric measures the very fallback it exists to
+    # catch), and the scan PRUNED (fewer columns read than the table
+    # has; the query references 4 of 5).  The ratio itself is not
+    # graded here (CI boxes are too noisy; BENCH_*.json records the
+    # honest number against the >=3x acceptance bar).
+    tq = [p for p in parsed
+          if str(p.get("metric", "")).startswith(
+              "table_query_device_vs_host")]
+    if not tq:
+        print("FAIL: no table_query_device_vs_host line")
+        return 1
+    for field in ("value", "t_device_s", "t_host_s", "parity",
+                  "device_all_array", "scan", "columns_total"):
+        if field not in tq[0]:
+            print("FAIL: table line missing %r (got %r)"
+                  % (field, sorted(tq[0])))
+            return 1
+    if not tq[0]["parity"]:
+        print("FAIL: table query device plan and host row path "
+              "disagreed: %r" % tq[0])
+        return 1
+    if not tq[0]["device_all_array"]:
+        print("FAIL: table query device side left the array path: %r"
+              % tq[0])
+        return 1
+    tscan = tq[0]["scan"]
+    if not isinstance(tscan, dict) \
+            or "columns_read" not in tscan \
+            or len(tscan["columns_read"]) >= tq[0]["columns_total"]:
+        print("FAIL: table query scan did not prune columns: %r"
+              % (tscan,))
+        return 1
     # ISSUE 10: the pane-plane stream section — the dstream window
     # line (when the child ran) must carry pane accounting, and
     # benchmarks/stream_rate.py --smoke must emit both the sustained-
@@ -374,7 +410,7 @@ def main():
           "(waves=%d idle=%.3f depth=%d donated=%s narrow=%.0fms "
           "fallbacks=%d groupmap=%.1fx coded=%.2fx adapt cold/warm "
           "ladder=%d/%d hits=%d/%d service warm=%.1fx compiles=%d/%d "
-          "conc=%.2fx bulk=%.1fx)"
+          "conc=%.2fx bulk=%.1fx table=%.1fx cols=%d/%d)"
           % (len(parsed), pipe["waves"], pipe["device_idle_frac"],
              pipe["pipeline_depth"], pipe["donated"],
              phases["narrow_ms"], len(ooc[0]["fallback_reasons"]),
@@ -384,7 +420,8 @@ def main():
              sv[0]["value"], sv[0]["cold"]["compiles"],
              sv[0]["warm"]["compiles"],
              conc.get("ratio_vs_slower_solo", 0.0),
-             bk[0]["value"]))
+             bk[0]["value"], tq[0]["value"],
+             len(tscan["columns_read"]), tq[0]["columns_total"]))
     return 0
 
 
