@@ -211,6 +211,11 @@ def main():
     # the longest traced job, same shape as the bench.py OOC line
     from dpark_tpu import trace
     out["trace"] = trace.summary()
+    # health plane (ISSUE 14): per-site latency-tail summaries + event
+    # rates, same shape as the bench.py OOC line (empty sites when
+    # nothing was traced — the sketches fold off the trace plane)
+    from dpark_tpu import health
+    out["health"] = health.summary()
     ctx.stop()
     print(json.dumps(out), flush=True)
 

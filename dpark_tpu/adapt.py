@@ -73,7 +73,7 @@ _mode = None                  # resolved mode, or None = read conf lazily
 _dir = None                   # resolved store dir, or None = read conf
 _loaded = False
 _agg = {"wave_budget": {}, "stage": {}, "skew": {}, "combine": {},
-        "pane": {}}
+        "pane": {}, "site": {}}
 _counters = {"store_hits": 0, "store_misses": 0, "steered": 0,
              "recorded": 0, "skipped_lines": 0}
 _decisions = []
@@ -252,6 +252,8 @@ def _compact_locked(path):
                 recs.append({"k": "pane", "key": key, "mode": mode,
                              "ms": round(ent[mode + "_ms"], 2),
                              "w": int(ent.get("w", 0))})
+    for key, ent in _agg["site"].items():
+        recs.append({"k": "site", "key": key, "digest": dict(ent)})
     try:
         from dpark_tpu.utils import frame_jsonl
         tmp = path + ".compact.%d" % os.getpid()
@@ -324,6 +326,16 @@ def _apply(rec):
         ent["ratio"] = ratio if cur is None \
             else cur * (1 - _EMA) + ratio * _EMA
         ent["n"] += 1
+    elif kind == "site":
+        # per-site latency-tail digest delta (health plane, ISSUE 14):
+        # the log-bucketed sketch shape health.Sketch.to_dict writes —
+        # folding is bucket-wise addition, so deltas from any number
+        # of processes/persists accumulate into one honest histogram
+        # (the ROADMAP item 5 handoff: straggler-adaptive coding will
+        # price (k, m) per exchange from these)
+        from dpark_tpu import health
+        _agg["site"][key] = health.merge_digests(
+            _agg["site"].get(key), rec.get("digest"))
     elif kind == "pane":
         # per-(stream signature) windowed-emit tick cost by pane
         # strategy ("tree" | "flat" | "inv"): the split-point pricing
@@ -429,14 +441,20 @@ def decisions_since(pos, job=None):
 
 def summary():
     """The `adapt` section for bench artifacts / job records: mode,
-    store location, hit/steer counters, recent decisions with
-    predicted-vs-observed ms."""
+    store location, hit/steer counters, persisted site-tail keys,
+    recent decisions with predicted-vs-observed ms."""
+    if enabled():
+        _ensure_loaded()        # a fresh process reports STORED sites
     with _lock:
         return {"mode": mode(), "store": _store_path(),
                 "store_hits": _counters["store_hits"],
                 "store_misses": _counters["store_misses"],
                 "steered": _counters["steered"],
                 "recorded": _counters["recorded"],
+                # per-site latency-tail keys the health plane has
+                # persisted (ISSUE 14): the item-5 handoff's proof a
+                # fresh process sees what earlier ones observed
+                "sites": sorted(_agg["site"]),
                 "decisions": [dict(d) for d in _decisions[-32:]]}
 
 
@@ -814,3 +832,36 @@ def pane_history():
     _ensure_loaded()
     with _lock:
         return {k: dict(v) for k, v in _agg["pane"].items()}
+
+
+# ---------------------------------------------------------------------------
+# per-site latency tails (health plane, ISSUE 14 — the item-5 handoff)
+# ---------------------------------------------------------------------------
+
+def record_site_tail(site, digest):
+    """Persist one per-site latency-sketch DELTA (the health plane's
+    log-bucketed histogram shape).  The store folds deltas by bucket
+    addition, so repeated persists from any process accumulate into
+    one distribution per site — the observed straggler/tail data
+    ROADMAP item 5's adaptive coder reads back."""
+    try:
+        if not enabled() or not site or not digest:
+            return
+        _append({"k": "site", "key": str(site),
+                 "digest": dict(digest)})
+    except Exception as e:
+        logger.debug("record_site_tail failed: %s", e)
+
+
+def site_tails():
+    """{site: digest} — every persisted per-site latency sketch
+    (folded across all recorded deltas).  A fresh process calling
+    this reads back what earlier processes observed."""
+    try:
+        if not enabled():
+            return {}
+        _ensure_loaded()
+        with _lock:
+            return {k: dict(v) for k, v in _agg["site"].items()}
+    except Exception:
+        return {}

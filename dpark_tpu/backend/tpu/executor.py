@@ -30,6 +30,16 @@ from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("tpu.executor")
 
+
+def _plan_sig(plan):
+    """Short stable program signature for health-plane site keys
+    (ISSUE 14): the adapt store's cross-process program id, memoized
+    on the plan by fuse.plan_adapt_signature."""
+    try:
+        return fuse.plan_adapt_signature(plan)[0]
+    except Exception:
+        return "?"
+
 AXIS = conf.MESH_AXIS
 
 
@@ -732,7 +742,8 @@ class JAXExecutor:
             return self._compiled[key]
         faults.hit("executor.compile")     # chaos site: per cache miss
         if trace._PLANE is not None:
-            trace.event("compile", "exec", program="narrow", cap=cap)
+            trace.event("compile", "exec", program="narrow", cap=cap,
+                        sig=_plan_sig(plan))
         ops = plan.ops
         epilogue = plan.epilogue
         n_dst = self.ndev
@@ -1030,7 +1041,8 @@ class JAXExecutor:
         dead after this call and XLA may reuse them in place."""
         faults.hit("executor.dispatch")    # chaos site: per dispatch
         if trace._PLANE is not None:
-            trace.event("dispatch", "exec", program="narrow")
+            trace.event("dispatch", "exec", program="narrow",
+                        sig=_plan_sig(plan))
         jitted = self._compile_narrow(
             plan, batch.cap, len(batch.cols),
             tuple(str(c.dtype) for c in batch.cols), donate=donate,
@@ -2192,7 +2204,8 @@ class JAXExecutor:
                 self._note_pipeline(stats)
                 if trace._PLANE is not None:
                     trace.emit("wave", "exec", t_wall,
-                               time.time() - t_wall, wave=c)
+                               time.time() - t_wall, wave=c,
+                               sig=_plan_sig(plan))
                 logger.debug("streamed wave %d", c + 1)
         finally:
             close = getattr(batches, "close", None)
@@ -2223,7 +2236,8 @@ class JAXExecutor:
             return self._compiled[key]
         faults.hit("executor.compile")     # chaos site: per cache miss
         if trace._PLANE is not None:
-            trace.event("compile", "exec", program="snc", cap=cap)
+            trace.event("compile", "exec", program="snc", cap=cap,
+                        sig=_plan_sig(plan))
         ops = plan.ops
         ndev = self.ndev
         has_bounds = plan.epi_bounds is not None
@@ -2438,7 +2452,8 @@ class JAXExecutor:
                 self._note_pipeline(stats)
                 if trace._PLANE is not None:
                     trace.emit("wave", "exec", t_wall,
-                               time.time() - t_wall, wave=c)
+                               time.time() - t_wall, wave=c,
+                               sig=_plan_sig(plan))
                 logger.debug("streamed no-combine wave %d", c + 1)
             if pending is not None:
                 pw, pb, pd = pending
@@ -2589,8 +2604,10 @@ class JAXExecutor:
         from dpark_tpu.shuffle import SpillWriteError, spill_crc
         from dpark_tpu.utils import atomic_file, compress
         blob = compress(pickle.dumps(rows, -1))
-        if trace._PLANE is not None:
-            trace.event("spill.write", "shuffle", bytes=len(blob))
+        # a SPAN with the measured write wall (was an instant event):
+        # the health plane's spill.write latency sketch needs real
+        # durations (ISSUE 14)
+        t_w0 = time.time() if trace._PLANE is not None else 0.0
         code = coding.active_code()
         try:
             if code is not None:
@@ -2601,6 +2618,9 @@ class JAXExecutor:
                     blob, code, fault_site="shuffle.spill_write")
                 with atomic_file(path) as f:
                     f.write(body)
+                if trace._PLANE is not None:
+                    trace.emit("spill.write", "shuffle", t_w0,
+                               time.time() - t_w0, bytes=len(body))
                 return
             # over the TRUE bytes, pre-corruption
             crc = spill_crc(blob)
@@ -2610,6 +2630,9 @@ class JAXExecutor:
             with atomic_file(path) as f:
                 f.write(struct.pack("<I", crc))
                 f.write(blob)
+            if trace._PLANE is not None:
+                trace.emit("spill.write", "shuffle", t_w0,
+                           time.time() - t_w0, bytes=len(blob))
         except OSError as e:
             raise SpillWriteError(
                 "spill run %s write failed: %s" % (path, e)) from e
@@ -2621,10 +2644,12 @@ class JAXExecutor:
         from dpark_tpu import coding, faults
         from dpark_tpu.shuffle import SpillCorruption, spill_crc
         from dpark_tpu.utils import decompress
+        t_r0 = time.time() if trace._PLANE is not None else 0.0
         with open(path, "rb") as f:
             raw = f.read()
         if trace._PLANE is not None:
-            trace.event("spill.read", "shuffle", bytes=len(raw))
+            trace.emit("spill.read", "shuffle", t_r0,
+                       time.time() - t_r0, bytes=len(raw))
         if coding.is_container(raw):
             # coded run: per-shard crcs; corruption repairs by decode,
             # and only a sub-k survivor count escalates to lineage

@@ -360,6 +360,11 @@ def fetch(uri, req, timeout=30):
                              "%d/%d in %.3fs", uri, last, k + 1,
                              attempts - 1, d)
                 time.sleep(d)
+        # flight recorder (ISSUE 14): every retry burned — a
+        # warning-and-above event, armed even with DPARK_TRACE=off
+        trace.flight("dcn.bulk.failed", "dcn", uri=uri,
+                     kind=str(req[0]), attempts=attempts,
+                     error=type(last).__name__ if last else "?")
         raise last
     finally:
         with _C.lock:

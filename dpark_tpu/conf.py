@@ -509,6 +509,95 @@ TRACE_RING_SPANS = int(os.environ.get("DPARK_TRACE_RING", "4096")
 TRACE_SPOOL_MAX_BYTES = int(os.environ.get(
     "DPARK_TRACE_SPOOL_MAX_BYTES", str(32 << 20)) or 0)
 
+# ---------------------------------------------------------------------------
+# online health plane (dpark_tpu/health.py — ISSUE 14)
+# ---------------------------------------------------------------------------
+
+# off | on.  "on" (the default) installs the streaming health sink:
+# every record the TRACE plane emits additionally folds into bounded
+# per-site latency sketches (log2 buckets, p50/p95/p99 estimates) and
+# event-rate counters — /api/health, the bench `health` section, and
+# the adapt-store site-tail handoff read them.  With DPARK_TRACE=off
+# nothing is emitted and the sink is inert either way; "off" removes
+# even the per-record `is None` check's target (the faults/trace
+# contract: off-mode job results are bit-identical to on).
+DPARK_HEALTH = os.environ.get("DPARK_HEALTH", "on")
+
+# bounded sketch registries: at most this many per-site sketches (past
+# the cap, new sites fold into their base site name) and this many
+# per-(job, stage) fetch sketches (oldest evicts) — streaming
+# aggregation must hold bounded memory no matter how long the process
+# serves
+HEALTH_MAX_SITES = int(os.environ.get("DPARK_HEALTH_MAX_SITES",
+                                      "256") or 256)
+HEALTH_STAGE_SKETCHES = int(os.environ.get(
+    "DPARK_HEALTH_STAGE_SKETCHES", "256") or 256)
+
+# minimum seconds between site-tail persists into the adapt store
+# (health.persist_site_tails runs at job finish; a streaming job
+# finishing one tick-job per second must not append per tick).
+# Deltas are persisted, so the throttle trades freshness, not truth.
+HEALTH_PERSIST_MIN_S = float(os.environ.get(
+    "DPARK_HEALTH_PERSIST_S", "30") or 0)
+
+# /api/health grading thresholds (yellow, red) — evidence ships with
+# every verdict so an operator sees the number AND the bar it crossed
+HEALTH_FETCH_P99_YELLOW_MS = float(os.environ.get(
+    "DPARK_HEALTH_FETCH_P99_YELLOW_MS", "250"))
+HEALTH_FETCH_P99_RED_MS = float(os.environ.get(
+    "DPARK_HEALTH_FETCH_P99_RED_MS", "1000"))
+HEALTH_DCN_P99_YELLOW_MS = float(os.environ.get(
+    "DPARK_HEALTH_DCN_P99_YELLOW_MS", "500"))
+HEALTH_DCN_P99_RED_MS = float(os.environ.get(
+    "DPARK_HEALTH_DCN_P99_RED_MS", "2000"))
+HEALTH_WAVE_P99_YELLOW_MS = float(os.environ.get(
+    "DPARK_HEALTH_WAVE_P99_YELLOW_MS", "5000"))
+HEALTH_WAVE_P99_RED_MS = float(os.environ.get(
+    "DPARK_HEALTH_WAVE_P99_RED_MS", "30000"))
+HEALTH_SPILL_P99_YELLOW_MS = float(os.environ.get(
+    "DPARK_HEALTH_SPILL_P99_YELLOW_MS", "500"))
+HEALTH_SPILL_P99_RED_MS = float(os.environ.get(
+    "DPARK_HEALTH_SPILL_P99_RED_MS", "5000"))
+HEALTH_ERROR_RATE_YELLOW = float(os.environ.get(
+    "DPARK_HEALTH_ERROR_RATE_YELLOW", "0.01"))
+HEALTH_ERROR_RATE_RED = float(os.environ.get(
+    "DPARK_HEALTH_ERROR_RATE_RED", "0.10"))
+
+# per-tenant SLO accounting (service.py — ISSUE 14): the default
+# per-job latency target in ms for tenants that declare none
+# explicitly (ServiceClient(..., slo_ms=) / ClientScheduler slo_ms).
+# 0 = no SLO tracked for undeclared tenants.
+SERVICE_SLO_MS = float(os.environ.get("DPARK_SERVICE_SLO", "0") or 0)
+
+# attainment target backing the burn-rate math: a burn of 1.0 means
+# violations are consuming the (1 - target) error budget exactly as
+# fast as allowed; 2.0 means twice as fast (the classic multi-window
+# burn alert).  Windows are the short/long burn horizons in seconds.
+SERVICE_SLO_TARGET = float(os.environ.get("DPARK_SERVICE_SLO_TARGET",
+                                          "0.99"))
+SERVICE_SLO_WINDOWS = tuple(
+    float(w) for w in os.environ.get("DPARK_SERVICE_SLO_WINDOWS",
+                                     "60,600").split(",") if w)
+SERVICE_SLO_BURN_YELLOW = float(os.environ.get(
+    "DPARK_SERVICE_SLO_BURN_YELLOW", "1.0"))
+SERVICE_SLO_BURN_RED = float(os.environ.get(
+    "DPARK_SERVICE_SLO_BURN_RED", "2.0"))
+
+# flight recorder (ISSUE 14): warning-and-above events ALWAYS land in
+# a bounded in-memory ring (even with DPARK_TRACE=off); setting this
+# directory additionally dumps a crc-framed snapshot (ring + health
+# sketches + recovery summary + adapt decisions) there on job abort,
+# stage degrade, or SIGUSR2 — post-mortem via tools/dtrace --flight.
+# "" (the default) keeps the ring armed but writes nothing.
+DPARK_FLIGHT_DIR = os.environ.get("DPARK_FLIGHT_DIR", "")
+
+# flight ring capacity and the per-process dump cap (a crash loop
+# must not fill the disk with snapshots)
+FLIGHT_RING_EVENTS = int(os.environ.get("DPARK_FLIGHT_RING", "512")
+                         or 512)
+FLIGHT_MAX_DUMPS = int(os.environ.get("DPARK_FLIGHT_MAX_DUMPS", "16")
+                       or 0)
+
 # trace-overhead-hint lint rule: warn when DPARK_TRACE=spool and a
 # reduce task's estimated spool writes (one fetch span per parent map
 # bucket + the task spans) exceed this — tiny-task jobs then spend

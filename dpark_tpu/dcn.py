@@ -235,8 +235,10 @@ class FramedServer:
                             status = 1
                         if isinstance(payload, BulkPayload):
                             from dpark_tpu import trace
-                            with trace.span("dcn.bulk.serve", "dcn",
-                                            kind=str(req[0])) as sp:
+                            with trace.span(
+                                    "dcn.bulk.serve", "dcn",
+                                    kind=str(req[0]),
+                                    peer=self.client_address[0]) as sp:
                                 sent, nchunks = _send_bulk(
                                     self.request, payload)
                                 if sp is not trace._NOOP:
@@ -385,7 +387,13 @@ def _request(sock, req):
     from dpark_tpu import trace
     if trace._PLANE is None:
         return _request_impl(sock, req)
-    with trace.span("dcn.transfer", "dcn", kind=str(req[0])) as sp:
+    try:
+        # per-peer health sketches (ISSUE 14) key on this
+        peer = sock.getpeername()[0]
+    except OSError:
+        peer = "?"
+    with trace.span("dcn.transfer", "dcn", kind=str(req[0]),
+                    peer=peer) as sp:
         payload = _request_impl(sock, req)
         sp.args["bytes"] = len(payload)
         return payload

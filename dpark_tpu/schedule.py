@@ -234,6 +234,7 @@ class DAGScheduler:
                 record.pop("_t_submit", None)
                 self._finalize_decodes(record)
                 self._trace_job_span(record, t0)
+                self._finalize_health(record)
                 self._job_finished(record)
             return
 
@@ -358,6 +359,7 @@ class DAGScheduler:
             self._finalize_decodes(record)
             self._finalize_adapt(record)
             self._trace_job_span(record, job_t0)
+            self._finalize_health(record)
             self._job_finished(record)
 
     def _new_job_record(self, final_rdd, parts, stages=1):
@@ -441,6 +443,14 @@ class DAGScheduler:
     def _job_finished(self, record):
         """Hook: the job finalized (counters attributed, pins
         released)."""
+
+    def _finalize_health(self, record):
+        """Health-plane job hook (ISSUE 14): per-tenant SLO accounting
+        (resident service), flight-recorder dump on abort, throttled
+        site-tail persistence into the adapt store.  One call per job;
+        every branch inside is a cheap predicate and never raises."""
+        from dpark_tpu import health
+        health.job_finished(self, record)
 
     def _trace_job_span(self, record, t0):
         """Emit the job's span (trace plane, ISSUE 8) — the root of
@@ -589,6 +599,16 @@ class DAGScheduler:
         record = getattr(self, "_current_record", None)
         if record is not None:
             self._stage_info(record, stage_id).update(kw)
+        if "degrade_reason" in kw:
+            # flight recorder (ISSUE 14): a runtime degrade is a
+            # warning-and-above event — it lands in the always-armed
+            # ring regardless of trace mode, and dumps a snapshot
+            # when DPARK_FLIGHT_DIR is set.  Degrades are rare by
+            # definition (each one already cost a retry or fallback).
+            from dpark_tpu import health
+            trace.flight("stage.degrade", "exec", stage=stage_id,
+                         reason=str(kw["degrade_reason"])[:200])
+            health.flight_dump("stage-degrade", scheduler=self)
 
     def _note_remote_fetch(self, stage_id, rx0):
         """Attribute bulk-channel bytes received while this stage's

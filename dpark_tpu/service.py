@@ -79,6 +79,48 @@ class _JobState:
         self.record = record
 
 
+class _Tenant:
+    """Per-tenant SLO accounting (ISSUE 14): tenants declare a per-job
+    latency target (``ServiceClient(..., slo_ms=)`` /
+    ``DPARK_SERVICE_SLO``); the server tracks lifetime attainment and
+    a multi-window burn rate — how fast violations consume the
+    ``1 - SERVICE_SLO_TARGET`` error budget (burn 1.0 = exactly as
+    fast as allowed; 2.0 = twice as fast, the classic paging
+    threshold).  The window deque is bounded by the longest burn
+    horizon, so a resident server's memory stays flat."""
+    __slots__ = ("slo_ms", "jobs", "violations", "window")
+
+    def __init__(self, slo_ms):
+        self.slo_ms = float(slo_ms)
+        self.jobs = 0
+        self.violations = 0
+        self.window = deque()           # (ts, ok)
+
+    def note(self, now, ok):
+        self.jobs += 1
+        if not ok:
+            self.violations += 1
+        self.window.append((now, ok))
+        horizon = max(conf.SERVICE_SLO_WINDOWS or (600.0,))
+        while self.window and self.window[0][0] < now - horizon:
+            self.window.popleft()
+
+    def stats(self, now):
+        budget = max(1e-9, 1.0 - float(conf.SERVICE_SLO_TARGET))
+        burn = {}
+        for w in (conf.SERVICE_SLO_WINDOWS or (600.0,)):
+            recent = [ok for ts, ok in self.window if ts >= now - w]
+            rate = (sum(1 for ok in recent if not ok) / len(recent)
+                    if recent else 0.0)
+            burn["%ds" % int(w)] = round(rate / budget, 3)
+        return {"slo_ms": self.slo_ms, "jobs": self.jobs,
+                "violations_total": self.violations,
+                "attainment": round(1.0 - self.violations
+                                    / self.jobs, 4)
+                if self.jobs else 1.0,
+                "burn": burn}
+
+
 def _make_scheduler(spec):
     """The job server's INNER scheduler — the actual mesh owner.
     Accepts the same master grammar as DparkContext."""
@@ -124,6 +166,8 @@ class JobServer:
         self._lock = threading.Lock()
         # per-tenant bulk-stream bytes (ISSUE 12; see note_bulk)
         self._bulk_bytes = {}
+        # per-tenant SLO accounting (ISSUE 14; see note_job_done)
+        self._tenants = {}
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -167,8 +211,57 @@ class JobServer:
             sched.stop()
 
     # -- submission (driver side) ---------------------------------------
+    def declare_slo(self, client, slo_ms):
+        """Register (or update) a tenant's per-job latency target in
+        ms.  0/None clears nothing — an SLO once declared sticks for
+        the life of the server (the accounting history must not reset
+        because one submission omitted the knob)."""
+        if not client or not slo_ms:
+            return
+        with self._cv:
+            t = self._tenants.get(client)
+            if t is None:
+                self._tenants[client] = _Tenant(slo_ms)
+            else:
+                t.slo_ms = float(slo_ms)
+
+    def note_job_done(self, record):
+        """SLO accounting hook (called once per finished service job
+        via health.job_finished): grade the job's submit-to-done
+        latency against its tenant's declared target and attach the
+        verdict to the record (the web UI's SLO column)."""
+        client = record.get("client")
+        if not client:
+            return
+        import time as _time
+        now = _time.time()
+        with self._cv:
+            t = self._tenants.get(client)
+            if t is None or not t.slo_ms:
+                return
+            # submit-to-done: record["seconds"] measures from record
+            # mint (post-admission submit) through the last yielded
+            # partition, so it already contains queue_wait_ms and
+            # first_wave_ms — the evidence fields ride the record
+            lat = float(record.get("seconds", 0.0) or 0.0) * 1e3
+            ok = lat <= t.slo_ms
+            t.note(now, ok)
+            record["slo"] = {"slo_ms": t.slo_ms,
+                             "latency_ms": round(lat, 1),
+                             "ok": bool(ok)}
+
+    def tenant_slo_stats(self):
+        """{client: {slo_ms, jobs, violations_total, attainment,
+        burn: {window: rate}}} for every tenant with a declared SLO
+        — /metrics and /api/health read this."""
+        import time as _time
+        now = _time.time()
+        with self._cv:
+            return {c: t.stats(now)
+                    for c, t in self._tenants.items()}
+
     def submit(self, rdd, func, partitions=None, allow_local=False,
-               client=None, weight=None):
+               client=None, weight=None, slo_ms=None):
         """Generator over per-partition results, like run_job — but
         admission-controlled and driven through the fair dispatcher.
         The generator body runs on the CALLING thread: that thread IS
@@ -202,6 +295,11 @@ class JobServer:
             # run_job reads these thread-locals when minting the record
             sched._tls.client = client or getattr(
                 self._tls, "client", None)
+            # tenant SLO declaration (ISSUE 14): an explicit slo_ms
+            # wins; otherwise the process default (DPARK_SERVICE_SLO)
+            # applies to every tenant that declared nothing
+            self.declare_slo(sched._tls.client,
+                             slo_ms or conf.SERVICE_SLO_MS)
             self._tls.weight = weight or getattr(
                 self._tls, "weight", None) or conf.SERVICE_WEIGHT
             yield from sched.run_job(rdd, func, partitions,
@@ -328,7 +426,8 @@ class JobServer:
         out = {"master": self.master, "slots": self.slots,
                "jobs_running": active, "jobs_queued": waiting,
                "work_items_queued": queued_items,
-               "max_jobs": self.max_jobs, "bulk": bulk}
+               "max_jobs": self.max_jobs, "bulk": bulk,
+               "tenants": self.tenant_slo_stats()}
         ex = getattr(self.scheduler, "executor", None)
         if ex is not None:
             out["program_cache"] = ex.program_cache_stats()
@@ -377,10 +476,14 @@ class ClientScheduler:
 
     is_service_client = True     # DparkContext.stop: leave env alive
 
-    def __init__(self, server, client=None, weight=None):
+    def __init__(self, server, client=None, weight=None, slo_ms=None):
         self.server = server
         self.client = client or "client-%d" % next(_client_ids)
         self.weight = weight or conf.SERVICE_WEIGHT
+        # per-tenant SLO (ISSUE 14): explicit target, else the
+        # process default (DPARK_SERVICE_SLO); 0 = untracked
+        self.slo_ms = slo_ms if slo_ms is not None \
+            else conf.SERVICE_SLO_MS
 
     def start(self):
         self.server.start()
@@ -392,7 +495,8 @@ class ClientScheduler:
     def run_job(self, rdd, func, partitions=None, allow_local=False):
         return self.server.submit(rdd, func, partitions, allow_local,
                                   client=self.client,
-                                  weight=self.weight)
+                                  weight=self.weight,
+                                  slo_ms=self.slo_ms)
 
     def default_parallelism(self):
         self.start()
@@ -417,14 +521,15 @@ def client_scheduler(master=None, client=None):
 # remote transport: job FUNCTIONS over the dcn framed channel
 # ---------------------------------------------------------------------------
 
-def _context_for(server, client):
+def _context_for(server, client, slo_ms=None):
     """A DparkContext whose scheduler is a service client — what a
     remote job function receives as its `ctx`."""
     from dpark_tpu.context import DparkContext
     from dpark_tpu.env import env
     env.start(is_master=True)
     ctx = DparkContext("local")
-    ctx.scheduler = ClientScheduler(server, client=client)
+    ctx.scheduler = ClientScheduler(server, client=client,
+                                    slo_ms=slo_ms)
     ctx.started = True           # scheduler is live; skip start()
     # a remote fn calling ctx.stop() must not tear down the SERVER's
     # env/scheduler — the context is a per-request facade
@@ -437,8 +542,11 @@ def serve(addr="127.0.0.1:0", master=None, server=None):
     channel; returns the FramedServer (bind_address tells the port).
 
     Request grammar (JSON array like every dcn request):
-      ("job", client, b64(serialize.dumps(fn)))  -> pickled fn(ctx)
-      ("job_bulk", client, b64(...))  -> same result, streamed over
+      ("job", client, b64(serialize.dumps(fn))[, slo_ms])
+          -> pickled fn(ctx); the optional 4th element declares the
+          tenant's per-job latency SLO in ms (ISSUE 14) — a pre-SLO
+          server simply never receives it (clients omit it when unset)
+      ("job_bulk", client, b64(...)[, slo_ms])  -> same result, streamed over
           the chunk-framed bulk channel (ISSUE 12) — concurrent
           tenants' result streams multiplex through the shared
           per-peer windows, and per-tenant stream bytes land in
@@ -455,20 +563,22 @@ def serve(addr="127.0.0.1:0", master=None, server=None):
             "serving WITHOUT DPARK_DCN_SECRET: any peer that can "
             "reach this port can submit arbitrary code")
 
-    def run_job(client, payload):
+    def run_job(client, payload, slo_ms=None):
         from dpark_tpu import serialize
         fn = serialize.loads(base64.b64decode(payload))
-        ctx = _context_for(srv, "remote:%s" % client)
+        ctx = _context_for(srv, "remote:%s" % client, slo_ms=slo_ms)
         return compress(pickle.dumps(fn(ctx), -1))
 
     def handle(req):
         kind = req[0]
         if kind == "job":
-            _, client, payload = req
-            return run_job(client, payload)
+            client, payload = req[1], req[2]
+            return run_job(client, payload,
+                           req[3] if len(req) > 3 else None)
         if kind == "job_bulk":
-            _, client, payload = req
-            blob = run_job(client, payload)
+            client, payload = req[1], req[2]
+            blob = run_job(client, payload,
+                           req[3] if len(req) > 3 else None)
 
             def note_sent(peer, nbytes, nchunks, _client=client):
                 srv.note_bulk(_client, nbytes)
@@ -500,18 +610,23 @@ class ServiceClient:
     driver thread inside the server — `fn(ctx)` builds its DAG there,
     in the server's id namespace."""
 
-    def __init__(self, addr, client=None, timeout=600):
+    def __init__(self, addr, client=None, timeout=600, slo_ms=None):
         addr = str(addr)
         if not addr.startswith("tcp://"):
             addr = "tcp://" + addr
         self.uri = addr
         self.client = client or "client-%d" % next(_client_ids)
         self.timeout = timeout
+        # per-tenant SLO declaration (ISSUE 14): rides each job
+        # request as an optional 4th element, so a pre-SLO server
+        # never sees an unknown shape when the knob is unset
+        self.slo_ms = slo_ms
 
     def run(self, fn):
         from dpark_tpu import conf, dcn, serialize
         from dpark_tpu.utils import decompress
         payload = base64.b64encode(serialize.dumps(fn)).decode("ascii")
+        extra = (float(self.slo_ms),) if self.slo_ms else ()
         if conf.BULK_PLANE:
             # results stream back chunk-framed over the bulk channel
             # (ISSUE 12); a pre-bulk server answers "unknown service
@@ -519,12 +634,14 @@ class ServiceClient:
             from dpark_tpu import bulkplane
             try:
                 _, view = bulkplane.fetch(
-                    self.uri, ("job_bulk", self.client, payload),
+                    self.uri,
+                    ("job_bulk", self.client, payload) + extra,
                     timeout=self.timeout)
                 return pickle.loads(decompress(bytes(view)))
             except bulkplane.BulkUnsupported:
                 pass
-        resp = dcn.fetch(self.uri, ("job", self.client, payload),
+        resp = dcn.fetch(self.uri,
+                         ("job", self.client, payload) + extra,
                          timeout=self.timeout)
         return pickle.loads(decompress(resp))
 

@@ -17,6 +17,7 @@ import os
 import pickle
 import struct
 import threading
+import time
 from queue import Empty, Queue
 
 from dpark_tpu import coding, conf, faults, trace
@@ -208,6 +209,19 @@ def uri_host(uri):
     if uri.startswith("tcp://"):
         return uri[len("tcp://"):].rpartition(":")[0]
     return uri
+
+
+def peer_label(uri):
+    """BOUNDED peer identity for health-plane site keys (ISSUE 14):
+    remote uris key by their serving host, every local scheme
+    collapses to "local" — a per-path key would grow one sketch per
+    spill file and blow the site cap."""
+    if uri.startswith("tcp://"):
+        return uri[len("tcp://"):].rpartition(":")[0] or "local"
+    if uri.startswith("hbm://"):
+        host = uri[len("hbm://"):].split("/", 1)[0].rpartition(":")[0]
+        return host or "local"
+    return "local"
 
 
 class _Uncoded(Exception):
@@ -487,6 +501,10 @@ def _fetch_coded_local(ordered, shuffle_id, map_id, reduce_id):
         failed = still
     if not frames or len(good) < k:
         coding.note("decode_failures", shuffle_id)
+        trace.flight("fetch.failed", "shuffle", shuffle=shuffle_id,
+                     map=map_id, reduce=reduce_id, coded=True,
+                     shards_found=len(good), shards_needed=k,
+                     error="ShardShortfall")
         raise FetchFailed(ordered[0], shuffle_id, map_id, reduce_id,
                           shards_found=len(good), shards_needed=k)
     code = coding.Code(frames[0].algo, frames[0].k, frames[0].m)
@@ -515,8 +533,13 @@ def read_bucket_any(uris, shuffle_id, map_id, reduce_id):
     of FetchFailed).  Raises FetchFailed when every replica fails."""
     if trace._PLANE is None:
         return _read_bucket_any(uris, shuffle_id, map_id, reduce_id)
+    first = uris if isinstance(uris, str) else (uris[0] if uris else "")
+    # the peer arg keys the health plane's per-site fetch-latency
+    # sketches (ISSUE 14) — the serving host, not the full uri, so
+    # site cardinality stays bounded
     with trace.span("fetch.bucket", "shuffle", shuffle=shuffle_id,
-                    map=map_id, reduce=reduce_id):
+                    map=map_id, reduce=reduce_id,
+                    peer=peer_label(first) if first else "local"):
         return _read_bucket_any(uris, shuffle_id, map_id, reduce_id)
 
 
@@ -562,6 +585,12 @@ def _read_bucket_any(uris, shuffle_id, map_id, reduce_id):
         if uri.startswith("tcp://"):
             hm.task_succeed_on(uri_host(uri))
         return items
+    # flight recorder (ISSUE 14): every replica failed — a
+    # warning-and-above event, armed even with DPARK_TRACE=off
+    trace.flight("fetch.failed", "shuffle", shuffle=shuffle_id,
+                 map=map_id, reduce=reduce_id,
+                 replicas=len(ordered),
+                 error=type(last_err).__name__ if last_err else "?")
     if isinstance(last_err, FetchFailed):
         raise last_err
     err = FetchFailed(ordered[0] if ordered else None, shuffle_id,
@@ -630,8 +659,17 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
         permits = threading.Semaphore(3 * nthreads)
         results = Queue()
         stop = threading.Event()
+        # fetch workers are POOL threads: the task's thread-local
+        # trace context (job/stage/task) doesn't reach them, so
+        # capture it here and re-install per worker — fetch.bucket
+        # spans then parent correctly and the health plane's
+        # per-stage fetch sketches attribute (ISSUE 14)
+        span_ctx = trace.current_ctx() if trace._PLANE is not None \
+            else None
 
         def worker():
+            if span_ctx:
+                trace._tls.ctx = dict(span_ctx)
             while not stop.is_set():
                 if not permits.acquire(timeout=0.5):
                     continue
@@ -796,8 +834,7 @@ class DiskSpillMerger(Merger):
         items = sorted(self.combined.items(), key=lambda kv: kv[0])
         chunk = conf.SHUFFLE_CHUNK_RECORDS
         code = coding.active_code()
-        if trace._PLANE is not None:
-            trace.event("spill.write", "shuffle", records=len(items))
+        t_w0 = time.time() if trace._PLANE is not None else 0.0
         with atomic_file(path) as f:
             for i in range(0, len(items), chunk):
                 blob = compress(pickle.dumps(items[i:i + chunk], -1))
@@ -819,6 +856,12 @@ class DiskSpillMerger(Merger):
                 # key's list) must not overflow a 4 GiB prefix
                 f.write(struct.pack("<QI", len(blob), crc))
                 f.write(blob)
+        if trace._PLANE is not None:
+            # a SPAN with the measured write wall (was an instant
+            # event): the health plane's spill.write latency sketch
+            # needs real durations (ISSUE 14)
+            trace.emit("spill.write", "shuffle", t_w0,
+                       time.time() - t_w0, records=len(items))
         self.spills.append(path)
         self.combined = {}
 
@@ -826,15 +869,27 @@ class DiskSpillMerger(Merger):
         """Stream one spill run back chunk by chunk (sorted within and
         across chunks: the run was sorted before chunking), verifying
         each chunk's crc32c before unpickling."""
-        if trace._PLANE is not None:
-            trace.event("spill.read", "shuffle")
+        # accumulated I/O wall only (the generator interleaves with
+        # consumer merge time, which must not pollute the health
+        # plane's spill.read latency sketch — ISSUE 14)
+        traced = trace._PLANE is not None
+        t_r0 = time.time() if traced else 0.0
+        t_io = 0.0
+        nbytes = 0
         with open(path, "rb") as f:
             while True:
+                t0 = time.time() if traced else 0.0
                 hdr = f.read(12)
                 if not hdr:
+                    if traced:
+                        trace.emit("spill.read", "shuffle", t_r0,
+                                   t_io, bytes=nbytes)
                     return
                 n, crc = struct.unpack("<QI", hdr)
                 raw = f.read(n)
+                if traced:
+                    t_io += time.time() - t0
+                    nbytes += len(raw) + 12
                 if coding.is_container(raw):
                     # coded chunk (ISSUE 6): per-shard crcs inside the
                     # container; corruption is decoded around, and only

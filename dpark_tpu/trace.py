@@ -25,6 +25,11 @@ Modes (``DPARK_TRACE`` env var / conf knob):
             never re-parses the span spool), which is how
             multiprocess fault/decode counters merge back into the
             driver's job records (the per-process caveat of PRs 5-7).
+            The health plane's per-site latency digests (ISSUE 14)
+            get their own ``health-<host>-<pid>.jsonl`` — ONE record,
+            atomically rewritten latest-wins, because the cumulative
+            digests change with nearly every task and would grow the
+            append-only counters file one full-digest line per task.
 
 Span taxonomy (name / cat):
 
@@ -93,8 +98,17 @@ import time
 from collections import deque
 
 from dpark_tpu import conf
+from dpark_tpu import health as _health
 
 MODES = ("off", "ring", "spool")
+
+# always-armed flight ring (ISSUE 14): warning-and-above events land
+# here EVEN IN OFF MODE (a bounded in-memory deque — the cost is one
+# append at failure sites, which are rare by definition), so a
+# post-mortem flight dump has the recent warning context no matter
+# what DPARK_TRACE was.  health.flight_dump snapshots it.
+_FLIGHT = deque(maxlen=max(16, int(
+    getattr(conf, "FLIGHT_RING_EVENTS", 512) or 512)))
 
 # see TracePlane.run: disambiguates runs minted in the same millisecond
 import itertools
@@ -191,6 +205,21 @@ class TracePlane:
         Counter events (`cat == "counters"`) are the cross-process
         merge substrate: they route to the separate counters file,
         bypass the span byte cap, and must never be dropped."""
+        sink = _health._SINK
+        if sink is not None:
+            # health plane (ISSUE 14): fold the record into the
+            # streaming sketches as it is emitted — no spool
+            # re-parsing, bounded memory, and a fold failure never
+            # perturbs the traced job
+            try:
+                sink.fold(rec)
+            except Exception:
+                pass
+        args = rec.get("args")
+        if args is not None and "error" in args:
+            # error-carrying spans mirror into the always-armed flight
+            # ring so a later dump has the failure context
+            _FLIGHT.append(rec)
         counters = always or rec.get("cat") == "counters"
         with self.lock:
             self.ring.append(rec)
@@ -363,6 +392,52 @@ def ctx(**fields):
     return _Ctx({k: v for k, v in fields.items() if v is not None})
 
 
+def current_ctx():
+    """The calling thread's span-context fields (job/stage/task), or
+    None — pool-thread spawners capture this and re-install it in
+    their workers so nested spans parent across the thread hop."""
+    return getattr(_tls, "ctx", None)
+
+
+def flight(name, cat="", **args):
+    """Warning-and-above instant event: ALWAYS lands in the bounded
+    flight ring (even with DPARK_TRACE=off — the ISSUE 14 flight
+    recorder contract), and additionally rides the normal plane when
+    one is installed.  Only failure sites call this (job abort, stage
+    degrade, exhausted fetch replicas, bulk stream give-up), so the
+    off-mode cost is one append per rare bad event."""
+    plane = _PLANE
+    if plane is not None:
+        rec = plane.make(name, cat, time.time(), 0.0, dict(args))
+        rec["sev"] = "warn"
+        # record() already mirrors error-carrying records into the
+        # flight ring — only append here when it won't, so one
+        # failure never occupies two ring slots
+        plane.record(rec)
+        if "error" not in args:
+            _FLIGHT.append(rec)
+    else:
+        rec = {"name": name, "cat": cat,
+               "ts": round(time.time(), 6), "dur": 0.0,
+               "pid": os.getpid(), "host": socket.gethostname(),
+               "tid": threading.get_ident() & 0xFFFFFFFF,
+               "sev": "warn"}
+        if args:
+            rec["args"] = args
+        sink = _health._SINK
+        if sink is not None:
+            try:
+                sink.fold(rec)
+            except Exception:
+                pass
+        _FLIGHT.append(rec)
+
+
+def flight_snapshot():
+    """The always-armed warning ring's contents (oldest first)."""
+    return list(_FLIGHT)
+
+
 def emit_process_counters():
     """Append this process's CUMULATIVE fault/decode counters as a
     `counters` event (spool mode only).  Workers call this at task
@@ -377,6 +452,7 @@ def emit_process_counters():
         args = {"faults": faults.stats(),
                 "decodes": snap["totals"],
                 "decodes_per_shuffle": snap["per_shuffle"]}
+        _write_process_health(plane)
         # cumulative counters only change when a fault fires or a
         # decode happens — skip the write when nothing did, so a
         # long-lived worker running many tasks doesn't grow the
@@ -388,6 +464,40 @@ def emit_process_counters():
                          0.0, args)
         plane.record(rec, always=True)
         plane._last_counters = key
+    except Exception:
+        pass
+
+
+def _write_process_health(plane):
+    """Health plane (ISSUE 14): rewrite this process's per-site
+    latency digests as ONE crc-framed record in its own
+    ``health-<host>-<pid>.jsonl`` (tmp+rename, latest-wins), so the
+    driver's merged tails include MULTIPROC fetches — the worker-tail
+    half of the ROADMAP item 5 handoff.  Digests are cumulative and
+    change with nearly every task, so they must NOT ride the
+    append-only counters file (it would grow one full-digest line per
+    task and is deliberately uncapped); an atomic rewrite keeps the
+    on-disk cost O(1) per process no matter how many tasks run."""
+    sink = _health._SINK
+    if sink is None:
+        return
+    try:
+        digests = sink.site_digests()
+        if not digests:
+            return
+        key = json.dumps(digests, sort_keys=True)
+        if key == getattr(plane, "_last_health", None):
+            return
+        from dpark_tpu.utils import frame_jsonl
+        rec = plane.make("process.health", "counters", time.time(),
+                         0.0, {"health": digests})
+        path = os.path.join(plane.dir, "health-%s-%d.jsonl"
+                            % (plane.host, plane.pid))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(frame_jsonl(rec))
+        os.replace(tmp, path)
+        plane._last_health = key
     except Exception:
         pass
 
@@ -479,7 +589,9 @@ def merged_worker_counters(trace_dir=None, include_self=False,
         run = _PLANE.run
     me = os.getpid()
     latest = {}
-    for rec in read_spool(trace_dir, prefixes=("counters-",)):
+    latest_health = {}
+    for rec in read_spool(trace_dir, prefixes=("counters-",
+                                               "health-")):
         if rec.get("cat") != "counters":
             continue
         if run and rec.get("run") != run:
@@ -488,9 +600,21 @@ def merged_worker_counters(trace_dir=None, include_self=False,
         if not include_self and pid == me \
                 and rec.get("host") == socket.gethostname():
             continue
-        latest[(rec.get("host"), pid)] = rec.get("args") or {}
+        args = rec.get("args") or {}
+        if rec.get("name") == "process.health":
+            # the per-process health digest file (latest-wins
+            # rewrite, one record per process — see
+            # _write_process_health)
+            latest_health[(rec.get("host"), pid)] = \
+                args.get("health") or {}
+        else:
+            latest[(rec.get("host"), pid)] = args
     out = {"faults": {}, "decodes": {}, "decodes_per_shuffle": {},
-           "processes": len(latest)}
+           "health": {}, "processes": len(latest)}
+    for digests in latest_health.values():
+        for site, digest in digests.items():
+            out["health"][site] = _health.merge_digests(
+                out["health"].get(site), digest)
     for args in latest.values():
         for site, st in (args.get("faults") or {}).items():
             ent = out["faults"].setdefault(site,

@@ -71,6 +71,26 @@ def render_metrics(scheduler):
             ("evictions", "compiled-program cache LRU evictions")):
         metric("dpark_program_cache_%s_total" % key, "counter",
                help_text, [({}, pc.get(key, 0))])
+    # per-tenant SLO accounting (ISSUE 14): attainment + multi-window
+    # burn gauges and the monotonic violation counter, one series per
+    # tenant that declared a target
+    tenants = svc.get("tenants") or {}
+    rows = sorted(tenants.items())
+    metric("dpark_tenant_slo_attainment", "gauge",
+           "fraction of a tenant's jobs inside its declared SLO",
+           [({"tenant": c}, t.get("attainment", 1.0))
+            for c, t in rows] or [({"tenant": "none"}, 1.0)])
+    metric("dpark_tenant_slo_burn", "gauge",
+           "SLO error-budget burn rate per window (1.0 = budget "
+           "consumed exactly as fast as allowed)",
+           [({"tenant": c, "window": w}, b)
+            for c, t in rows
+            for w, b in sorted((t.get("burn") or {}).items())]
+           or [({"tenant": "none", "window": "none"}, 0.0)])
+    metric("dpark_tenant_slo_violations_total", "counter",
+           "jobs that finished outside their tenant's SLO",
+           [({"tenant": c}, t.get("violations_total", 0))
+            for c, t in rows] or [({"tenant": "none"}, 0)])
     metric("dpark_stages_total", "counter", "stages by execution kind",
            [({"kind": k}, n) for k, n in sorted(snap["stages"].items())]
            or [({"kind": "none"}, 0)])
@@ -232,7 +252,8 @@ _PAGE = """<!doctype html>
 <h2>dpark_tpu jobs</h2>
 <table id="t"><tr><th>job</th><th>scope</th><th>parts</th>
 <th>finished</th><th>stages</th><th>seconds</th><th>state</th>
-<th>client</th><th>queue ms</th><th>cache (hit/miss)</th>
+<th>client</th><th>queue ms</th><th>SLO (attain %)</th>
+<th>cache (hit/miss)</th>
 <th>recovery (resubmit/recompute/retry)</th>
 <th>decodes (repair/straggler/fail)</th>
 <th>adapt (steered/logged)</th></tr></table>
@@ -243,6 +264,7 @@ _PAGE = """<!doctype html>
 <th>pad eff</th>
 <th>waves</th><th>idle %</th><th>pipeline ms (in/cmp/xchg/spill)</th>
 <th>decodes</th>
+<th>fetch p99 ms</th>
 <th>stream</th>
 <th>fallback / degrade</th>
 </tr></table>
@@ -279,6 +301,11 @@ function taskRows(st) {
   return h + '</table>';
 }
 async function tick() {
+  // health registry feed (ISSUE 14): per-stage fetch p99s, tenant SLO
+  // attainment/burn — one defensive snapshot per tick
+  let hd = {};
+  try { hd = await (await fetch('/api/health')).json(); }
+  catch (e) { hd = {}; }
   const r = await fetch('/api/jobs'); const jobs = await r.json();
   const t = document.getElementById('t');
   while (t.rows.length > 1) t.deleteRow(1);
@@ -314,10 +341,26 @@ async function tick() {
     const cache = pc.hits !== undefined
       ? pc.hits + '/' + pc.misses : '';
     const qw = j.queue_wait_ms !== undefined ? j.queue_wait_ms : '';
+    // per-tenant SLO column (ISSUE 14): this job's latency vs its
+    // tenant's target, plus the tenant's lifetime attainment from
+    // the health registry — red when the tenant is burning budget
+    const ten = ((hd.tenants || {})[j.client]) || null;
+    const burning = ten &&
+      Math.max(...Object.values(ten.burn || {0: 0})) >= 1.0;
+    let slo = '';
+    if (j.slo)
+      slo = j.slo.latency_ms + '/' + j.slo.slo_ms + 'ms' +
+            (j.slo.ok ? '' : ' VIOLATED');
+    if (ten)
+      slo += (slo ? ' ' : '') +
+             '(' + (100 * ten.attainment).toFixed(1) + '%)';
     for (const v of [j.id, j.scope, j.parts, j.finished, j.stages,
-                     j.seconds, j.state, j.client || '', qw, cache,
-                     rec, dec, adp])
+                     j.seconds, j.state, j.client || '', qw, slo,
+                     cache, rec, dec, adp])
       row.insertCell().textContent = v;
+    if (slo)
+      row.cells[9].className =
+        burning || (j.slo && !j.slo.ok) ? 'fail' : 'done';
     row.className = j.state === 'done' ? 'done' : 'run';
     const d = document.createElement('div');
     d.className = 'dag'; d.textContent = dagText(j);
@@ -353,11 +396,15 @@ async function tick() {
       // cross-controller bytes this stage fetched over the bulk data
       // plane (ISSUE 12) — nonzero only when a reduce read a remote
       // peer's map outputs
+      // per-stage fetch p99 from the health registry's streaming
+      // sketches (ISSUE 14) — live while the stage fetches
+      const sf = ((hd.stage_fetch || {})[j.id + ':' + st.id]) || {};
+      const fp99 = sf.p99_ms !== undefined ? sf.p99_ms : '';
       for (const v of [j.id, st.id, st.rdd, st.parts, st.kind,
                        st.seconds, st.run_seconds, st.hbm_bytes,
                        st.wire_bytes, st.remote_fetch_bytes,
                        st.pad_efficiency,
-                       p.waves, idle, pms, sdec, srole, why])
+                       p.waves, idle, pms, sdec, fp99, srole, why])
         sr.insertCell().textContent = v === undefined ? '' : v;
       // span timeline link (ISSUE 8): the stage's job timeline from
       // the trace plane ring/spool via /api/trace
@@ -371,7 +418,7 @@ async function tick() {
       };
       if (open.has(key)) {
         const dr = s.insertRow();
-        const c = dr.insertCell(); c.colSpan = 17;
+        const c = dr.insertCell(); c.colSpan = 18;
         c.className = 'tasks'; c.innerHTML = taskRows(st);
       }
     }
@@ -441,6 +488,21 @@ def start_ui(scheduler, host="127.0.0.1", port=0):
                 body = json.dumps(
                     {"mode": trace_mod.mode(), "job": job,
                      "spans": recs}).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/health"):
+                # online health plane (ISSUE 14): graded subsystems
+                # with evidence, per-site tail summaries, per-tenant
+                # SLO stats, per-stage fetch p99s — built from
+                # defensive snapshots under the registry locks (same
+                # discipline as /metrics: a scrape racing a running
+                # job returns valid JSON, never an error)
+                try:
+                    from dpark_tpu import health as health_mod
+                    body = json.dumps(
+                        health_mod.api_health(scheduler)).encode()
+                except Exception as e:
+                    body = json.dumps(
+                        {"mode": "error", "error": str(e)}).encode()
                 ctype = "application/json"
             elif self.path.startswith("/api/streams"):
                 # pane-plane live stats (ISSUE 10): one row per

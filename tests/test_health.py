@@ -1,0 +1,626 @@
+"""Online health plane (ISSUE 14): streaming sketches, SLO
+accounting, flight recorder.
+
+The contracts under test:
+
+* PARITY — the health sink OBSERVES, it never perturbs: on/off runs
+  are bit-identical across the chaos matrix (the PR 8 bar), and off
+  mode is one `is None` check per trace record.
+* SKETCHES — log-bucketed merges are associative/commutative, memory
+  stays bounded past the site cap, and quantile estimates land in the
+  right bucket.
+* CROSS-PROCESS — a worker's injected-delay fetch tail surfaces in
+  the DRIVER's merged per-site view (the process.counters digest
+  ride-along), and lands in the adapt store keyed by site — the
+  ROADMAP item 5 handoff, proven end to end.
+* SLO — a 2-tenant JobServer cell counts one tenant's violations and
+  exports them on /metrics while the other tenant stays at 100%
+  attainment, and /api/health grades the subsystem with evidence.
+* FLIGHT — warning events land in the always-armed ring even with
+  DPARK_TRACE=off; job abort and SIGUSR2 dump crc-framed snapshots
+  that tools/dtrace --flight reads back.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dpark_tpu import conf, faults, health, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(tmp_path):
+    """Every test starts and ends with a fresh sink, no trace/chaos
+    planes, no process-global service, and flight state reset."""
+    from dpark_tpu import service
+    trace.configure("off")
+    faults.configure(None)
+    health.configure("on")
+    trace._FLIGHT.clear()
+    health._flight_dumps = 0
+    old_flight = conf.DPARK_FLIGHT_DIR
+    conf.DPARK_FLIGHT_DIR = ""
+    yield
+    service.shutdown()
+    trace.configure("off")
+    faults.configure(None)
+    health.configure("on")
+    trace._FLIGHT.clear()
+    health._flight_dumps = 0
+    conf.DPARK_FLIGHT_DIR = old_flight
+
+
+def _reduce_job(c, n=500, parts=4, reduce_parts=3):
+    return dict(c.parallelize([(i % 5, 1) for i in range(n)], parts)
+                .reduceByKey(lambda a, b: a + b,
+                             reduce_parts).collect())
+
+
+# ---------------------------------------------------------------------------
+# sketches
+# ---------------------------------------------------------------------------
+
+def test_sketch_buckets_and_quantiles():
+    sk = health.Sketch()
+    for _ in range(97):
+        sk.add(0.001)               # 1 ms
+    for _ in range(3):
+        sk.add(1.0)                 # 1 s stragglers (3% tail)
+    assert sk.n == 100
+    p50 = sk.quantile(0.50)
+    p99 = sk.quantile(0.99)
+    # p50 sits in the ~1 ms bucket; p99 reaches the stragglers' bucket
+    assert 0.0004 < p50 < 0.004, p50
+    assert p99 > 0.25, p99
+    s = sk.summary()
+    assert s["n"] == 100 and s["p99_ms"] > 250
+
+
+def test_sketch_merge_associative_and_commutative():
+    import random
+    rng = random.Random(7)
+    parts = []
+    for _ in range(4):
+        sk = health.Sketch()
+        for _ in range(200):
+            sk.add(rng.random() ** 4)
+        parts.append(sk)
+
+    def fold(order):
+        acc = health.Sketch()
+        for i in order:
+            acc.merge(health.Sketch.from_dict(parts[i].to_dict()))
+        return acc.to_dict()
+
+    a = fold([0, 1, 2, 3])
+    b = fold([3, 1, 0, 2])
+    # ((0+1)+(2+3)) via digest round-trips
+    left = health.merge_digests(
+        health.merge_digests(parts[0].to_dict(), parts[1].to_dict()),
+        health.merge_digests(parts[2].to_dict(), parts[3].to_dict()))
+    assert a == b == left
+    assert health.Sketch.from_dict(a).n == 800
+
+
+def test_sketch_digest_roundtrip_ignores_garbage():
+    sk = health.Sketch.from_dict({"b": {"3": 5, "999": 7, "x": 1},
+                                  "n": "not-an-int"})
+    assert sk.buckets[3] == 5
+    assert sum(sk.buckets) == 5          # out-of-range/garbage skipped
+
+
+def test_sink_bounded_past_site_cap(monkeypatch):
+    monkeypatch.setattr(conf, "HEALTH_MAX_SITES", 8)
+    s = health.HealthSink()
+    for i in range(1000):
+        s.fold({"name": "fetch.bucket", "dur": 0.001,
+                "args": {"peer": "host-%d" % i}})
+    # memory bounded: the cap plus a few base-site overflow slots
+    assert len(s.sites) <= 8 + 16
+    assert s.dropped_sites > 0
+    # no observation was lost: total count across sites is exact
+    assert sum(sk.n for sk in s.sites.values()) == 1000
+
+
+def test_off_mode_is_one_predicate():
+    health.configure("off")
+    assert health._SINK is None
+    assert health.mode() == "off"
+    assert health.summary() == {"mode": "off", "sites": {},
+                                "rates": {}}
+    with pytest.raises(ValueError):
+        health.configure("loud")
+
+
+# ---------------------------------------------------------------------------
+# parity: the sink observes, never perturbs (chaos matrix)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec", [
+    None,
+    "shuffle.fetch:p=0.3,seed=11,times=3",
+    "shuffle.spill_write:nth=1,kind=corrupt",
+])
+def test_health_on_off_parity_chaos_matrix(ctx, tmp_path, spec):
+    pairs = [(i % 11, i) for i in range(500)]
+
+    def run():
+        faults.configure(spec)
+        try:
+            return dict(ctx.parallelize(pairs, 4)
+                        .groupByKey(3)
+                        .mapValues(sorted).collect())
+        finally:
+            faults.configure(None)
+
+    health.configure("off")
+    expected = run()                     # health off, trace off
+    for mode in ("ring", "spool"):
+        trace.configure(mode, str(tmp_path / mode))
+        health.configure("on")
+        try:
+            assert run() == expected, (mode, spec)
+            assert health.snapshot()["folded"] > 0
+            assert any(k.startswith("fetch.bucket")
+                       for k in health.snapshot()["sites"])
+        finally:
+            trace.configure("off")
+        # off side under the same trace mode: zero folds
+        trace.configure(mode, str(tmp_path / (mode + "-off")))
+        health.configure("off")
+        try:
+            assert run() == expected, (mode, spec)
+        finally:
+            trace.configure("off")
+        health.configure("on")
+
+
+@pytest.fixture()
+def tiny_waves():
+    old = conf.STREAM_CHUNK_ROWS
+    conf.STREAM_CHUNK_ROWS = 500
+    yield
+    conf.STREAM_CHUNK_ROWS = old
+
+
+@pytest.mark.parametrize("spec", [
+    None,
+    "shuffle.fetch:p=0.3,seed=11,times=3",
+])
+def test_health_parity_device(tctx2, tiny_waves, tmp_path, spec):
+    import numpy as np
+    from dpark_tpu import Columns
+    i = np.arange(4000, dtype=np.int64)
+    data = Columns(i % 37, i & 0xFF)
+
+    def run():
+        faults.configure(spec)
+        try:
+            return dict(tctx2.parallelize(data, 2)
+                        .reduceByKey(lambda a, b: a + b, 2).collect())
+        finally:
+            faults.configure(None)
+
+    health.configure("off")
+    expected = run()
+    trace.configure("spool", str(tmp_path / "dev"))
+    health.configure("on")
+    try:
+        assert run() == expected
+        sites = health.snapshot()["sites"]
+        # device execution landed in the sketches, keyed by program
+        # signature
+        assert any(k.startswith("wave:") for k in sites), sites
+        assert "stage.exec" in sites, sites
+    finally:
+        trace.configure("off")
+
+
+@pytest.fixture()
+def tctx2():
+    from dpark_tpu import DparkContext
+    c = DparkContext("tpu:2")
+    c.start()
+    yield c
+    c.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-process tail merge (the multiproc half of the item-5 handoff)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def fresh_forkserver():
+    from multiprocessing import forkserver
+
+    def stop():
+        try:
+            forkserver._forkserver._stop()
+        except Exception:
+            pass
+
+    stop()
+    yield
+    stop()
+
+
+def test_worker_fetch_tail_surfaces_on_driver(fresh_forkserver, pctx,
+                                              tmp_path, monkeypatch):
+    """Workers run the reduces (and therefore the fetches) in their
+    own processes; an injected 120 ms fetch delay there must surface
+    in the DRIVER's merged per-site tail view via the counters-file
+    digest ride-along — and persist into the adapt store keyed by
+    site."""
+    from dpark_tpu import adapt
+    monkeypatch.setenv("DPARK_FAULTS",
+                       "shuffle.fetch:nth=1,kind=delay,ms=120")
+    store = str(tmp_path / "adapt")
+    adapt.configure(mode="observe", store_dir=store)
+    trace.configure("spool", str(tmp_path / "mp"))
+    try:
+        assert _reduce_job(pctx, n=400) == {k: 80 for k in range(5)}
+        # the driver process itself fetched nothing...
+        own = health.snapshot()["sites"]
+        assert not any(k.startswith("fetch.bucket") for k in own), own
+        # ...but the merged view carries the workers' sketches
+        merged = health.merged_site_digests()
+        fetch_sites = {k: v for k, v in merged.items()
+                       if k.startswith("fetch.bucket")}
+        assert fetch_sites, merged
+        summaries = health.summarize_sites(fetch_sites)
+        worst = max(s.get("p99_ms", 0.0) for s in summaries.values())
+        assert worst >= 50.0, summaries    # the 120 ms delay is in the tail
+        # adapt-store handoff: the job-finish hook already persisted
+        # the merged deltas (a second forced persist finds nothing
+        # new — deltas never double-count); read back as a fresh
+        # process would (configure() resets all in-memory state)
+        assert health.persist_site_tails(force=True) == 0
+        adapt.configure(mode="observe", store_dir=store)
+        assert any(k.startswith("fetch.bucket")
+                   for k in adapt.summary()["sites"])
+        tails = adapt.site_tails()
+        site = next(k for k in tails if k.startswith("fetch.bucket"))
+        sk = health.Sketch.from_dict(tails[site])
+        assert sk.n >= 1
+        # stored tails read back as REAL latency sketches: the sum
+        # delta persisted too, so summary() reports percentiles (a
+        # zeroed sum would misclassify them as count-only)
+        assert "p99_ms" in sk.summary(), sk.to_dict()
+        # the stored distribution still shows the delayed fetch:
+        # some mass sits at or above the ~100 ms buckets
+        slow = sum(sk.buckets[health.Sketch.bucket_of(0.1):])
+        assert slow >= 1, tails[site]
+    finally:
+        trace.configure("off")
+        adapt.configure()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant SLO accounting (2-tenant JobServer cell)
+# ---------------------------------------------------------------------------
+
+def test_two_tenant_slo_violations_and_attainment(tmp_path):
+    from dpark_tpu import DparkContext, service
+    from dpark_tpu.service import ClientScheduler
+    from dpark_tpu.web import render_metrics
+    ctx = DparkContext("service:local")
+    ctx.start()
+    try:
+        srv = ctx.scheduler.server
+        # tenant-slow declares an impossible target (every job
+        # violates); tenant-fast a generous one (every job attains)
+        slow = ClientScheduler(srv, client="tenant-slow",
+                               slo_ms=0.001)
+        fast = ClientScheduler(srv, client="tenant-fast",
+                               slo_ms=60000)
+        rdd = ctx.parallelize([(i % 5, 1) for i in range(200)], 4) \
+            .reduceByKey(lambda a, b: a + b, 3)
+        for sched in (slow, fast, slow, fast, slow):
+            got = dict(x for part in sched.run_job(
+                rdd, lambda it: list(it)) for x in part)
+            assert got == {k: 40 for k in range(5)}
+        stats = srv.tenant_slo_stats()
+        ts, tf = stats["tenant-slow"], stats["tenant-fast"]
+        assert ts["jobs"] == 3 and ts["violations_total"] == 3, ts
+        assert ts["attainment"] == 0.0
+        assert tf["jobs"] == 2 and tf["violations_total"] == 0, tf
+        assert tf["attainment"] == 1.0
+        # burn: violations consume the error budget far faster than
+        # allowed for the slow tenant, not at all for the fast one
+        assert max(ts["burn"].values()) > 2.0, ts
+        assert max(tf["burn"].values()) == 0.0, tf
+        # the per-job verdict rides the record (web UI SLO column)
+        recs = [r for r in srv.scheduler.history
+                if r.get("client") == "tenant-slow"]
+        assert all(r.get("slo", {}).get("ok") is False for r in recs)
+        # /metrics export
+        body = render_metrics(ctx.scheduler)
+        assert ('dpark_tenant_slo_violations_total'
+                '{tenant="tenant-slow"} 3') in body, body
+        assert ('dpark_tenant_slo_violations_total'
+                '{tenant="tenant-fast"} 0') in body
+        assert 'dpark_tenant_slo_attainment{tenant="tenant-fast"} 1.0' \
+            in body
+        # /api/health grades the subsystem red with evidence attached
+        api = health.api_health(ctx.scheduler)
+        slo_sub = api["subsystems"]["service_slo"]
+        assert slo_sub["grade"] == "red", slo_sub
+        ev = slo_sub["evidence"]
+        assert ev["tenants"]["tenant-slow"]["violations_total"] == 3
+        assert "thresholds" in ev
+    finally:
+        ctx.stop()
+        from dpark_tpu import service as service_mod
+        service_mod.shutdown()
+
+
+def test_service_slo_env_default(monkeypatch):
+    """DPARK_SERVICE_SLO (conf.SERVICE_SLO_MS) applies to tenants
+    that declare nothing."""
+    from dpark_tpu import DparkContext
+    monkeypatch.setattr(conf, "SERVICE_SLO_MS", 45000.0)
+    ctx = DparkContext("service:local")
+    ctx.start()
+    try:
+        assert _reduce_job(ctx, 200) == {k: 40 for k in range(5)}
+        stats = ctx.scheduler.server.tenant_slo_stats()
+        (tenant,) = stats
+        assert stats[tenant]["slo_ms"] == 45000.0
+        assert stats[tenant]["attainment"] == 1.0
+    finally:
+        ctx.stop()
+        from dpark_tpu import service as service_mod
+        service_mod.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# /api/health endpoint + web UI columns
+# ---------------------------------------------------------------------------
+
+def test_api_health_endpoint_and_stage_p99(ctx):
+    from dpark_tpu.web import start_ui
+    trace.configure("ring")
+    _reduce_job(ctx)
+    server, url = start_ui(ctx.scheduler)
+    try:
+        with urllib.request.urlopen(url + "api/health") as r:
+            assert r.status == 200
+            api = json.loads(r.read().decode())
+        assert api["mode"] == "on"
+        assert any(k.startswith("fetch.bucket") for k in api["sites"])
+        for sub in ("shuffle_fetch", "dcn", "coding", "executor",
+                    "spill", "scheduler"):
+            assert api["subsystems"][sub]["grade"] in (
+                "green", "yellow", "red"), sub
+            assert "evidence" in api["subsystems"][sub]
+        # the stage fetch sketches feed the web UI's fetch-p99 column
+        assert api["stage_fetch"], api
+        assert all("n" in v for v in api["stage_fetch"].values())
+    finally:
+        server.shutdown()
+        trace.configure("off")
+
+
+def test_page_has_health_columns():
+    from dpark_tpu import web
+    assert "fetch p99 ms" in web._PAGE
+    assert "SLO (attain %)" in web._PAGE
+    assert "/api/health" in web._PAGE
+
+
+def test_api_health_never_throws_mid_mutation(ctx):
+    """Same discipline as /metrics: a poisoned history record must
+    not break the endpoint."""
+    trace.configure("ring")
+    _reduce_job(ctx)
+    ctx.scheduler.history.append(
+        {"id": 99, "state": None, "stage_info": ["not-a-dict"]})
+    try:
+        api = health.api_health(ctx.scheduler)
+    finally:
+        ctx.scheduler.history.pop()
+        trace.configure("off")
+    assert json.dumps(api)
+
+
+# ---------------------------------------------------------------------------
+# offline twin: dtrace --health vs the live endpoint
+# ---------------------------------------------------------------------------
+
+def _load_dtrace():
+    import importlib.machinery
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "dtrace")
+    loader = importlib.machinery.SourceFileLoader("_dtrace_cli", path)
+    spec = importlib.util.spec_from_loader("_dtrace_cli", loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+def test_dtrace_health_matches_live_endpoint(ctx, tmp_path, capsys):
+    d = str(tmp_path / "spool")
+    trace.configure("spool", d)
+    health.configure("on")            # fresh sink scoped to this run
+    _reduce_job(ctx)
+    live_digests = health.merged_site_digests()
+    live_rates = dict(health.snapshot()["rates"])
+    trace.configure("off")
+    dtrace = _load_dtrace()
+    assert dtrace.main(["--health", "--dir", d]) == 0
+    offline = json.loads(capsys.readouterr().out)
+    # the offline twin folded the SAME records the live sink saw, so
+    # site summaries and sketch-fed grades agree exactly
+    assert offline["sites"] == health.summarize_sites(live_digests)
+    live_grades = health.grade(live_digests, live_rates)
+    for sub in ("shuffle_fetch", "dcn", "coding", "executor", "spill"):
+        assert offline["subsystems"][sub]["grade"] \
+            == live_grades[sub]["grade"], sub
+    # empty spool fails (the CI gate contract)
+    assert dtrace.main(["--health", "--dir",
+                        str(tmp_path / "empty")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_always_armed_in_off_mode():
+    assert trace.mode() == "off"
+    trace.flight("fetch.failed", "shuffle", shuffle=3, error="IOError")
+    ring = trace.flight_snapshot()
+    assert ring and ring[-1]["name"] == "fetch.failed"
+    assert ring[-1]["sev"] == "warn"
+    # and the sink folded the failure rate even without a trace plane
+    assert health.snapshot()["rates"].get("fetch.failed") == 1
+
+
+def test_error_spans_mirror_into_flight_ring(tmp_path):
+    trace.configure("ring")
+    with pytest.raises(RuntimeError):
+        with trace.span("work", "test"):
+            raise RuntimeError("no")
+    trace.configure("off")
+    assert any(r["name"] == "work" for r in trace.flight_snapshot())
+
+
+def test_flight_event_lands_once_with_plane_installed(tmp_path):
+    """An error-carrying flight event must occupy ONE ring slot even
+    though plane.record also mirrors error records — a failure storm
+    must not halve the ring's effective capacity."""
+    trace.configure("ring")
+    trace.flight("fetch.failed", "shuffle", shuffle=1, error="IOError")
+    trace.configure("off")
+    hits = [r for r in trace.flight_snapshot()
+            if r["name"] == "fetch.failed"]
+    assert len(hits) == 1, hits
+    assert hits[0]["sev"] == "warn"
+
+
+def test_worker_health_file_is_o1_per_process(ctx, tmp_path):
+    """The per-process health digest file is rewritten latest-wins —
+    many jobs/tasks leave exactly one record, not one per task (the
+    counters file is append-only and uncapped, so digests must not
+    ride it)."""
+    d = str(tmp_path / "o1")
+    trace.configure("spool", d)
+    health.configure("on")
+    for _ in range(3):
+        _reduce_job(ctx)
+        trace.emit_process_counters()
+    trace.configure("off")
+    hf = [f for f in os.listdir(d) if f.startswith("health-")]
+    assert len(hf) == 1, hf
+    recs, skipped = __import__(
+        "dpark_tpu.utils", fromlist=["unframe_jsonl"]).unframe_jsonl(
+        open(os.path.join(d, hf[0]), "rb").read())
+    assert skipped == 0 and len(recs) == 1, (len(recs), skipped)
+    assert recs[0]["name"] == "process.health"
+    assert any(k.startswith("fetch.bucket")
+               for k in recs[0]["args"]["health"])
+
+
+def test_flight_dump_disabled_without_dir(ctx):
+    assert conf.DPARK_FLIGHT_DIR == ""
+    assert health.flight_dump("manual", scheduler=ctx.scheduler) \
+        is None
+
+
+def test_flight_dump_on_job_abort_and_dtrace_roundtrip(ctx, tmp_path,
+                                                       capsys):
+    conf.DPARK_FLIGHT_DIR = str(tmp_path / "flight")
+
+    def boom(x):
+        raise ValueError("injected abort")
+
+    with pytest.raises(RuntimeError):
+        ctx.parallelize([1, 2], 2).map(boom).collect()
+    dumps = os.listdir(conf.DPARK_FLIGHT_DIR)
+    assert dumps, "abort produced no flight dump"
+    path = os.path.join(conf.DPARK_FLIGHT_DIR, sorted(dumps)[0])
+    recs = health.load_flight(path)
+    kinds = {r["kind"] for r in recs}
+    assert {"flight.header", "flight.event", "flight.health",
+            "flight.job", "flight.recovery", "flight.adapt"} <= kinds
+    header = next(r for r in recs if r["kind"] == "flight.header")
+    assert header["reason"].startswith("job-abort")
+    # the ring carried the abort event
+    names = {(r.get("rec") or {}).get("name") for r in recs
+             if r["kind"] == "flight.event"}
+    assert "job.abort" in names, names
+    job = next(r for r in recs if r["kind"] == "flight.job")
+    assert job["record"]["state"] == "aborted"
+    # dtrace --flight round-trip
+    dtrace = _load_dtrace()
+    assert dtrace.main(["--flight", path]) == 0
+    out = capsys.readouterr().out
+    assert "job-abort" in out and "warning-and-above" in out
+    # an unusable dump fails
+    bad = str(tmp_path / "bad.jsonl")
+    open(bad, "w").write("garbage\n")
+    assert dtrace.main(["--flight", bad]) == 1
+
+
+def test_flight_dump_on_stage_degrade(ctx, tmp_path):
+    conf.DPARK_FLIGHT_DIR = str(tmp_path / "flight")
+    _reduce_job(ctx)                 # starts the lazy scheduler
+    sched = ctx.scheduler
+    sched._current_record = {"id": 1, "stage_info": []}
+    try:
+        sched.note_stage(7, degrade_reason="test degrade")
+    finally:
+        sched._current_record = None
+    assert any(f.startswith("flight-")
+               for f in os.listdir(conf.DPARK_FLIGHT_DIR))
+    assert any(r["name"] == "stage.degrade"
+               for r in trace.flight_snapshot())
+
+
+def test_flight_dump_on_sigusr2(ctx, tmp_path):
+    conf.DPARK_FLIGHT_DIR = str(tmp_path / "flight")
+    _reduce_job(ctx)                 # job finish arms the handler
+    assert health._sigusr2_installed or health.install_sigusr2()
+    os.kill(os.getpid(), signal.SIGUSR2)
+    deadline = time.time() + 5
+    found = []
+    while time.time() < deadline and not found:
+        if os.path.isdir(conf.DPARK_FLIGHT_DIR):
+            found = [f for f in os.listdir(conf.DPARK_FLIGHT_DIR)]
+        time.sleep(0.01)
+    assert found, "SIGUSR2 produced no flight dump"
+    recs = health.load_flight(
+        os.path.join(conf.DPARK_FLIGHT_DIR, found[0]))
+    header = next(r for r in recs if r["kind"] == "flight.header")
+    assert header["reason"] == "sigusr2"
+
+
+def test_flight_dump_cap(ctx, tmp_path, monkeypatch):
+    conf.DPARK_FLIGHT_DIR = str(tmp_path / "flight")
+    monkeypatch.setattr(conf, "FLIGHT_MAX_DUMPS", 2)
+    assert health.flight_dump("one") is not None
+    assert health.flight_dump("two") is not None
+    assert health.flight_dump("three") is None      # capped
+    assert len(os.listdir(conf.DPARK_FLIGHT_DIR)) == 2
+
+
+# ---------------------------------------------------------------------------
+# bench schema ride-alongs
+# ---------------------------------------------------------------------------
+
+def test_health_summary_schema(ctx):
+    trace.configure("ring")
+    _reduce_job(ctx)
+    s = health.summary()
+    trace.configure("off")
+    assert s["mode"] == "on"
+    assert isinstance(s["sites"], dict) and s["sites"]
+    site = next(k for k in s["sites"] if k.startswith("fetch.bucket"))
+    for field in ("n", "p50_ms", "p95_ms", "p99_ms"):
+        assert field in s["sites"][site]
+    assert json.dumps(s)
