@@ -224,6 +224,11 @@ def _ooc_phase():
     # (sketches fold off the trace plane); schema-gated like trace
     from dpark_tpu import health
     payload["health"] = health.summary()
+    # resource attribution (ISSUE 15): per-tenant account rollup +
+    # conservation — {"mode": "off", "tenants": {}} when off;
+    # schema-gated like health
+    from dpark_tpu import ledger
+    payload["ledger"] = ledger.summary()
     ctx.stop()
     print("OOC_RESULT %s" % json.dumps(payload), flush=True)
 
@@ -965,13 +970,32 @@ def _service_phase():
     datb = [(int(k), int(v))
             for k, v in zip(i[:n // 4] % 257, i[:n // 4])]
 
+    # the concurrent cell runs as TWO named tenants (ISSUE 15): the
+    # ledger must attribute each one's mesh consumption separately,
+    # and their device-seconds must reconcile with mesh busy time.
+    # Tracing starts HERE, not around the warm/cold submits above —
+    # service_warm_submit must keep measuring what it always did
+    # (PR 9's acceptance record is untraced), and conservation grades
+    # over the meter delta of the traced window only.
+    from dpark_tpu import ledger, trace
+    trace.configure("ring")
+    ledger.configure("on")
+    meter0 = ledger.mesh_meter(sched)
+    from dpark_tpu.service import ClientScheduler
+    ten_a = ClientScheduler(sched.server, client="tenant-a")
+    ten_b = ClientScheduler(sched.server, client="tenant-b")
+
+    def _collect(tenant, rdd):
+        return dict(x for part in tenant.run_job(
+            rdd, lambda it: list(it)) for x in part)
+
     def job_a():
-        return dict(ctx.parallelize(data, ndev)
-                    .reduceByKey(_svc_add, ndev).collect())
+        return _collect(ten_a, ctx.parallelize(data, ndev)
+                        .reduceByKey(_svc_add, ndev))
 
     def job_b():
-        return dict(ctx.parallelize(datb, 4).groupByKey(4)
-                    .mapValue(_svc_distinct).collect())
+        return _collect(ten_b, ctx.parallelize(datb, 4).groupByKey(4)
+                        .mapValue(_svc_distinct))
 
     t0 = time.perf_counter()
     ref_a = job_a()
@@ -996,11 +1020,20 @@ def _service_phase():
              "queue_wait_ms": r.get("queue_wait_ms")}
             for r in sched.history if r.get("service")]
     stats = sched.service_stats()
+    meter_delta = ledger.meter_delta(meter0,
+                                     ledger.mesh_meter(sched))
     out = {"cold": cold, "warm": warm, "concurrent": conc,
            "pairs": n, "ndev": ndev,
            "service": stats, "jobs": jobs,
            # per-tenant SLO attainment (ISSUE 14)
-           "slo": stats.get("tenants", {})}
+           "slo": stats.get("tenants", {}),
+           # per-tenant resource attribution + the conservation check
+           # (ISSUE 15 acceptance: attributed device-seconds within
+           # 10% of measured mesh busy time across the two tenants)
+           "ledger": {"tenants": ledger.tenant_totals(),
+                      "conservation": ledger.conservation(
+                          meter=meter_delta)}}
+    trace.configure("off")
     from dpark_tpu import service as service_mod
     service_mod.shutdown()
     print("SERVICE_RESULT %s" % json.dumps(out), flush=True)
@@ -1046,6 +1079,59 @@ def _health_phase():
                "sites": sites, "pairs": n, "ndev": ndev}
     ctx.stop()
     print("HEALTH_RESULT %s" % json.dumps(payload), flush=True)
+
+
+def _ledger_phase():
+    """Child-process entry: ledger-plane overhead A/B (ISSUE 15
+    acceptance, riding the health_plane_overhead pattern).  The same
+    ring-traced device reduceByKey with the attribution sink OFF vs
+    ON — folding every span into the per-(tenant, job, stage,
+    program) accounts must cost <= 3% wall.  Also reports the nonzero
+    account count and the conservation check (attributed
+    device-seconds vs measured mesh-lock busy time) the CI smoke
+    gates."""
+    import numpy as np
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import Columns, DparkContext, ledger, trace
+    n = int(os.environ.get("BENCH_LEDGER_PAIRS",
+                           os.environ.get("BENCH_PAIRS", "500000")))
+    i = np.arange(n, dtype=np.int64)
+    data = Columns((i * 2654435761) % 4096, i & 0xFFFF)
+    ctx = DparkContext("tpu")
+    ctx.start()
+    ndev = ctx.scheduler.executor.ndev
+    trace.configure("ring")
+
+    def run():
+        t0 = time.perf_counter()
+        cnt = (ctx.parallelize(data, ndev)
+               .reduceByKey(_svc_add, ndev).count())
+        assert cnt == min(4096, n), cnt
+        return time.perf_counter() - t0
+
+    reps = int(os.environ.get("BENCH_LEDGER_REPS", "3"))
+    ledger.configure("off")
+    run()                                      # warm-up compile
+    t_off = min(run() for _ in range(reps))
+    # conservation is graded over the ON window only: the sink starts
+    # empty here, so the meter baseline must too (the off leg's mesh
+    # time was deliberately unobserved)
+    meter0 = ledger.mesh_meter(ctx.scheduler)
+    ledger.configure("on")
+    run()                                      # fold path warm
+    t_on = min(run() for _ in range(reps))
+    summ = ledger.summary()
+    cons = ledger.conservation(meter=ledger.meter_delta(
+        meter0, ledger.mesh_meter(ctx.scheduler)))
+    trace.configure("off")
+    payload = {"t_off": round(t_off, 4), "t_on": round(t_on, 4),
+               "accounts": summ["accounts"],
+               "tenants": summ["tenants"],
+               "conservation": cons, "pairs": n, "ndev": ndev}
+    ctx.stop()
+    print("LEDGER_RESULT %s" % json.dumps(payload), flush=True)
 
 
 def _probe_phase():
@@ -1180,6 +1266,9 @@ def main():
         return
     if "--health-only" in sys.argv:
         _health_phase()
+        return
+    if "--ledger-only" in sys.argv:
+        _ledger_phase()
         return
     if "--table-only" in sys.argv:
         _table_phase()
@@ -1450,6 +1539,7 @@ def main():
                      "concurrent": s["concurrent"],
                      "service": s["service"], "jobs": s["jobs"],
                      "slo": s.get("slo", {}),
+                     "ledger": s.get("ledger", {}),
                      "pairs": s["pairs"], "chips": s["ndev"]}
             if emulated:
                 svout["emulated_cpu_mesh"] = True
@@ -1473,6 +1563,27 @@ def main():
             if emulated:
                 hout["emulated_cpu_mesh"] = True
             print(json.dumps(hout))
+    # ledger-plane overhead A/B (ISSUE 15 acceptance): the same
+    # ring-traced job with the attribution sink off vs on — folding
+    # every span into the per-tenant accounts must cost <= 3% wall,
+    # with nonzero accounts and the conservation check attached
+    if os.environ.get("BENCH_LEDGER", "1") != "0":
+        got = _run_child("--ledger-only", child_timeout,
+                         env=extra_env, ok_prefix="LEDGER_RESULT ")
+        if got is not None:
+            led = json.loads(got)
+            lout = {"metric": _suffix("ledger_plane_overhead"),
+                    "value": round(led["t_on"]
+                                   / max(led["t_off"], 1e-9), 3),
+                    "unit": "x wall (lower is better; <=1.03 passes)",
+                    "t_off_s": led["t_off"], "t_on_s": led["t_on"],
+                    "accounts": led["accounts"],
+                    "tenants": led["tenants"],
+                    "conservation": led["conservation"],
+                    "pairs": led["pairs"], "chips": led["ndev"]}
+            if emulated:
+                lout["emulated_cpu_mesh"] = True
+            print(json.dumps(lout))
     if not extras:
         return
     # third line: join/cogroup, BASELINE config #2

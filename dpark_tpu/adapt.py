@@ -73,7 +73,7 @@ _mode = None                  # resolved mode, or None = read conf lazily
 _dir = None                   # resolved store dir, or None = read conf
 _loaded = False
 _agg = {"wave_budget": {}, "stage": {}, "skew": {}, "combine": {},
-        "pane": {}, "site": {}}
+        "pane": {}, "site": {}, "prog": {}}
 _counters = {"store_hits": 0, "store_misses": 0, "steered": 0,
              "recorded": 0, "skipped_lines": 0}
 _decisions = []
@@ -254,6 +254,8 @@ def _compact_locked(path):
                              "w": int(ent.get("w", 0))})
     for key, ent in _agg["site"].items():
         recs.append({"k": "site", "key": key, "digest": dict(ent)})
+    for key, ent in _agg["prog"].items():
+        recs.append({"k": "prog", "key": key, "profile": dict(ent)})
     try:
         from dpark_tpu.utils import frame_jsonl
         tmp = path + ".compact.%d" % os.getpid()
@@ -336,6 +338,20 @@ def _apply(rec):
         from dpark_tpu import health
         _agg["site"][key] = health.merge_digests(
             _agg["site"].get(key), rec.get("digest"))
+    elif kind == "prog":
+        # static program cost profile (ledger plane, ISSUE 15): flops
+        # / bytes accessed / peak-HBM bytes captured at compile time
+        # keyed by the cross-process-stable plan signature — the
+        # pricing PRIOR items 2/3 read before a program's first
+        # observed run.  Latest capture wins (profiles are a pure
+        # function of the program + shape class, so re-captures
+        # agree; a newer jax may refine the numbers).
+        prof = rec.get("profile")
+        if isinstance(prof, dict):
+            _agg["prog"][key] = {
+                k: (float(v) if isinstance(v, float) else int(v))
+                for k, v in prof.items()
+                if isinstance(v, (int, float))}
     elif kind == "pane":
         # per-(stream signature) windowed-emit tick cost by pane
         # strategy ("tree" | "flat" | "inv"): the split-point pricing
@@ -455,6 +471,10 @@ def summary():
                 # persisted (ISSUE 14): the item-5 handoff's proof a
                 # fresh process sees what earlier ones observed
                 "sites": sorted(_agg["site"]),
+                # persisted static program cost profiles (ledger
+                # plane, ISSUE 15): the acceptance proof a fresh
+                # process can price a program before running it
+                "programs": sorted(_agg["prog"]),
                 "decisions": [dict(d) for d in _decisions[-32:]]}
 
 
@@ -851,6 +871,48 @@ def record_site_tail(site, digest):
                  "digest": dict(digest)})
     except Exception as e:
         logger.debug("record_site_tail failed: %s", e)
+
+
+def record_program_cost(key, profile):
+    """Persist one static program cost profile (ledger plane, ISSUE
+    15): flops / bytes-accessed / arg-bytes (and, when captured via
+    the compiled path, measured peak-HBM bytes) keyed by the
+    cross-process-stable plan signature "progid|shapeclass" — the
+    pricing prior ROADMAP items 2/3 read before a program's first
+    observed run."""
+    try:
+        if not enabled() or not key or not profile:
+            return
+        _append({"k": "prog", "key": str(key),
+                 "profile": dict(profile)})
+    except Exception as e:
+        logger.debug("record_program_cost failed: %s", e)
+
+
+def program_cost(key):
+    """The persisted cost profile for one plan signature, or None."""
+    try:
+        if not enabled():
+            return None
+        _ensure_loaded()
+        with _lock:
+            ent = _agg["prog"].get(str(key))
+            return dict(ent) if ent is not None else None
+    except Exception:
+        return None
+
+
+def program_costs():
+    """{signature: profile} — every persisted program cost profile.
+    A fresh process calling this prices programs it never ran."""
+    try:
+        if not enabled():
+            return {}
+        _ensure_loaded()
+        with _lock:
+            return {k: dict(v) for k, v in _agg["prog"].items()}
+    except Exception:
+        return {}
 
 
 def site_tails():

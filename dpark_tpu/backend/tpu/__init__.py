@@ -105,26 +105,30 @@ class TPUScheduler(DAGScheduler):
 
     def _job_started(self, record):
         """Pin this job's HBM buckets against disk spill and snapshot
-        the program-cache counters (the per-job cache-hit column;
-        under CONCURRENT jobs the delta is a process-wide view, noted
-        as such in the README)."""
+        the program-cache counters.  The snapshot is only the FALLBACK
+        for probes no thread tagged; since ISSUE 15 the cache counts
+        hits/misses per job exactly (the probing thread's job stamp),
+        so concurrent jobs' record["program_cache"] deltas no longer
+        overlap — the PR 9 caveat is closed."""
         ex = self.executor
         if ex is not None:
             ex.live_jobs.add(record["id"])
-            pc = ex.program_cache_stats()
-            record["_pc_base"] = (pc["hits"], pc["misses"])
+            record["_pc_base"] = True
 
     def _job_finished(self, record):
         ex = self.executor
         if ex is None:
             return
         ex.live_jobs.discard(record["id"])
-        base = record.pop("_pc_base", None)
-        if base is not None:
-            pc = ex.program_cache_stats()
-            record["program_cache"] = {
-                "hits": pc["hits"] - base[0],
-                "misses": pc["misses"] - base[1]}
+        if record.pop("_pc_base", None) is None:
+            return
+        # exact per-job attribution (ISSUE 15 satellite): every stage
+        # submission stamps the executing thread with the job id, so
+        # the cache's per-job buckets carry this job's own probes —
+        # exact even while other jobs compile concurrently.  A job
+        # with no tagged probes (pure host work) reads 0/0, which is
+        # the truth the old process-wide delta could not tell.
+        record["program_cache"] = ex._compiled.job_stats(record["id"])
 
     def stop(self):
         super().stop()

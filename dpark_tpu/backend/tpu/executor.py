@@ -403,6 +403,47 @@ class _ProgramCache:
         self.misses = 0
         self.evictions = 0
         self._lock = threading.Lock()
+        # exact per-job attribution (ISSUE 15 satellite): each probe
+        # also counts against the job the probing THREAD is executing
+        # for (`_job_of`, installed by the executor to read its
+        # per-thread job stamp).  The process-wide delta the per-job
+        # record used to ship overlapped under concurrency (the
+        # documented PR 9 caveat); these buckets do not.  Bounded:
+        # oldest job bucket evicts past the cap.
+        self._job_of = None
+        self._job_counts = OrderedDict()     # job -> [hits, misses]
+
+    def _count_job(self, hit):
+        # called under self._lock
+        job_of = self._job_of
+        if job_of is None:
+            return
+        try:
+            job = job_of()
+        except Exception:
+            return
+        if job is None:
+            return
+        ent = self._job_counts.get(job)
+        if ent is None:
+            ent = self._job_counts[job] = [0, 0]
+            while len(self._job_counts) > 128:
+                self._job_counts.popitem(last=False)
+        else:
+            # recency-refresh: a long-running job that keeps probing
+            # must not lose its bucket to 128 short jobs minted after
+            # it (eviction is least-recently-PROBED, not insertion
+            # order — the exactness guarantee holds for any job still
+            # doing work)
+            self._job_counts.move_to_end(job)
+        ent[0 if hit else 1] += 1
+
+    def job_stats(self, job):
+        """Exact {hits, misses} attributed to one job's threads (0/0
+        for a job that never probed)."""
+        with self._lock:
+            ent = self._job_counts.get(job) or (0, 0)
+            return {"hits": ent[0], "misses": ent[1]}
 
     # Speaks the plain-dict idiom every compile site already uses —
     # `if key in cache: return cache[key]` / `cache[key] = jitted` —
@@ -420,8 +461,10 @@ class _ProgramCache:
                 # makes it MRU)
                 self._d.move_to_end(key)
                 self.hits += 1
+                self._count_job(True)
                 return True
             self.misses += 1
+            self._count_job(False)
             return False
 
     def __getitem__(self, key):
@@ -445,6 +488,82 @@ class _ProgramCache:
             return {"entries": len(self._d), "cap": self.cap,
                     "hits": self.hits, "misses": self.misses,
                     "evictions": self.evictions}
+
+
+class _MeshLock:
+    """The mesh lock, metered (ISSUE 15 tentpole): a reentrant lock
+    whose every DEPTH-0 acquisition measures its wait (how long the
+    caller queued behind other tenants' device work — the invisible
+    cost of the resident service) and its hold (mesh busy time, the
+    denominator of the ledger's conservation check).
+
+    Counters are always on — two clock reads per outer acquisition —
+    and mutated only while the lock is HELD, so they need no lock of
+    their own.  With a trace plane installed, each depth-0 release
+    additionally emits a ``mesh.lock`` span: ts = the acquisition
+    request, dur = the WAIT, args.hold_s = the hold — the ledger sink
+    folds the wait into the owning job's ``lock_wait_ms`` account and
+    the hold into the offline mesh-busy view."""
+
+    __slots__ = ("_lock", "_tls", "wait_s", "busy_s", "acquisitions",
+                 "contended", "t_created")
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._tls = threading.local()
+        self.wait_s = 0.0
+        self.busy_s = 0.0
+        self.acquisitions = 0
+        self.contended = 0
+        self.t_created = time.time()
+
+    def __enter__(self):
+        tls = self._tls
+        depth = getattr(tls, "depth", 0)
+        if depth:
+            # reentrant re-acquire by the holder: no wait, no second
+            # busy interval
+            self._lock.acquire()
+            tls.depth = depth + 1
+            return self
+        t0 = time.time()
+        wait = 0.0
+        if not self._lock.acquire(False):
+            self._lock.acquire()
+            wait = time.time() - t0
+        tls.depth = 1
+        tls.t_request = t0
+        tls.t_acquired = time.time()
+        tls.wait = wait
+        return self
+
+    def __exit__(self, *exc):
+        tls = self._tls
+        tls.depth -= 1
+        if tls.depth:
+            self._lock.release()
+            return False
+        hold = time.time() - tls.t_acquired
+        wait = tls.wait
+        t_req = tls.t_request
+        # mutated while still holding: race-free by construction
+        self.busy_s += hold
+        self.acquisitions += 1
+        if wait > 0.0:
+            self.wait_s += wait
+            self.contended += 1
+        self._lock.release()
+        if trace._PLANE is not None:
+            trace.emit("mesh.lock", "exec", t_req, wait,
+                       hold_s=round(hold, 6))
+        return False
+
+    def meter(self):
+        return {"busy_s": round(self.busy_s, 6),
+                "wait_s": round(self.wait_s, 6),
+                "acquisitions": self.acquisitions,
+                "contended": self.contended,
+                "wall_s": round(time.time() - self.t_created, 6)}
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -570,13 +689,23 @@ class JAXExecutor:
         # eviction spiller can export under a stage's lock.  Disk-run
         # exports stay lock-free — they touch no device.  Lock order
         # where both are held: _mesh_lock -> _shard_build_lock.
-        self._mesh_lock = threading.RLock()
+        self._mesh_lock = _MeshLock()
         self._export_lock = self._mesh_lock
+        # ledger plane (ISSUE 15): backend compiles become measured
+        # compile.backend spans via jax.monitoring; the listener costs
+        # one predicate per (rare) compile when tracing is off
+        trace.install_compile_listener()
         # jobs currently RUNNING on the owning scheduler (ISSUE 9):
         # their HBM shuffle stores are preferred-KEEP when the budget
         # evicts; completed jobs' buckets spill to disk first
         self.live_jobs = set()
         self._job_tls = threading.local()   # job id of this thread's stage
+        # exact per-job program-cache attribution (ISSUE 15 satellite,
+        # closing the PR 9 caveat): hits/misses tag the slot thread's
+        # CURRENT job, so concurrent jobs' record["program_cache"]
+        # deltas no longer overlap
+        self._compiled._job_of = \
+            lambda: getattr(self._job_tls, "job", None)
         # scheduler hook: called as (sid, uri) after an HBM store is
         # spilled to disk so stage output locations follow the move
         self._spill_notify = None
@@ -960,8 +1089,19 @@ class JAXExecutor:
         Holds the mesh lock throughout: with a resident job server
         (ISSUE 9) concurrent jobs' stages race for the device, and two
         collective programs in flight wedge the XLA:CPU rendezvous."""
+        # the span carries the adapt program signature (ISSUE 15): the
+        # ledger's device-seconds account and the health plane's
+        # wave sketches key by it — only worth computing when traced
+        extra = {}
+        if trace._PLANE is not None:
+            sig = _plan_sig(plan)
+            extra = {"sig": sig}
+            # every backend compile inside this stage (narrow,
+            # exchange, egest, ...) attributes to the stage's program
+            trace.set_compile_sig(sig)
         with self._mesh_lock, \
-                trace.span("stage.exec", "exec", source=plan.source[0]):
+                trace.span("stage.exec", "exec", source=plan.source[0],
+                           **extra):
             return self._run_stage(plan)
 
     def _run_stage(self, plan):
@@ -1043,6 +1183,9 @@ class JAXExecutor:
         if trace._PLANE is not None:
             trace.event("dispatch", "exec", program="narrow",
                         sig=_plan_sig(plan))
+            # backend compiles fired by the jitted call below
+            # attribute to this program (ledger plane, ISSUE 15)
+            trace.set_compile_sig(_plan_sig(plan))
         jitted = self._compile_narrow(
             plan, batch.cap, len(batch.cols),
             tuple(str(c.dtype) for c in batch.cols), donate=donate,
@@ -1051,7 +1194,25 @@ class JAXExecutor:
             bounds = self._bounds_arg(plan)
         args = (batch.counts,) + ((bounds,) if bounds is not None
                                   else ()) + tuple(batch.cols)
+        self._capture_cost(plan, jitted, args)
         return jitted(*args)
+
+    def _capture_cost(self, plan, jitted, args):
+        """Static program cost profile at first dispatch (ISSUE 15):
+        once per plan signature, BEFORE the call (donated buffers are
+        dead after it; lower() reads only avals).  Gated on BOTH the
+        ledger sink and an installed trace plane — the documented
+        contract is that the whole attribution plane is inert with
+        DPARK_TRACE=off, and the capture's re-trace must never ride
+        an untraced production dispatch under the mesh lock."""
+        from dpark_tpu import ledger
+        if ledger._SINK is None or trace._PLANE is None:
+            return
+        try:
+            ledger.capture_program_cost(
+                fuse.plan_adapt_signature(plan), jitted, args)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     # text-source ingest (SURVEY.md 3.1 hot loop #1): the narrow chain
@@ -1409,7 +1570,7 @@ class JAXExecutor:
                 notify(sid, uri)
             logger.info("spilled HBM shuffle %d (%d bytes) to disk "
                         "buckets at %s", sid, store["nbytes"], uri)
-            self.drop_shuffle(sid)
+            self.drop_shuffle(sid, reason="spill")
 
     def _finish_stage(self, plan, outs):
         if plan.epilogue is None:
@@ -1685,6 +1846,13 @@ class JAXExecutor:
         store["job"] = getattr(self._job_tls, "job", None)
         self.shuffle_store[sid] = store
         self._store_bytes += store["nbytes"]
+        if trace._PLANE is not None:
+            # ledger plane (ISSUE 15): HBM residency starts — the
+            # byte-seconds account accrues from here to the matching
+            # hbm.release (drop or spill-to-disk eviction)
+            trace.event("hbm.store", "exec", sid=sid,
+                        bytes=store["nbytes"],
+                        job=store["job"])
         self._evict_hbm(keep_sid=sid)
         self._observe_combine_ratio(dep, plan, store)
         return ("shuffle", sid)
@@ -2404,6 +2572,8 @@ class JAXExecutor:
                     else 0.0
                 t_disp = stats.now()
                 faults.hit("executor.dispatch")   # chaos site: per wave
+                if trace._PLANE is not None:
+                    trace.set_compile_sig(_plan_sig(plan))
                 jitted = self._compile_stream_nocombine(
                     plan, batch.cap, len(batch.cols), r,
                     tuple(str(c.dtype) for c in batch.cols),
@@ -2411,6 +2581,7 @@ class JAXExecutor:
                 args = (batch.counts,) + ((bounds,)
                                           if bounds is not None
                                           else ()) + tuple(batch.cols)
+                self._capture_cost(plan, jitted, args)
                 outs = jitted(*args)
                 cnts, offs = outs[0], outs[1]
                 leaves = list(outs[2:])      # [rid +] row leaves
@@ -3297,7 +3468,7 @@ class JAXExecutor:
         td = self.token_dict
         return [(td.decode(int(r[0])),) + tuple(r[1:]) for r in rows]
 
-    def drop_shuffle(self, sid):
+    def drop_shuffle(self, sid, reason="drop"):
         with self._shard_build_lock:
             for key in [k for k in self._shard_cache if k[0] == sid]:
                 self._shard_cache_bytes -= sum(
@@ -3305,6 +3476,31 @@ class JAXExecutor:
         store = self.shuffle_store.pop(sid, None)
         if store:
             self._store_bytes -= store["nbytes"]
+            if trace._PLANE is not None:
+                # ledger plane (ISSUE 15): residency ends — the sink
+                # accrues bytes x held seconds against the account
+                # that STORED it (reason "spill" marks an eviction
+                # adjusting the live HBM picture, not a data drop)
+                trace.event("hbm.release", "exec", sid=sid,
+                            bytes=store["nbytes"], reason=reason,
+                            job=store.get("job"))
+            else:
+                # tracing turned off after the store registered: the
+                # sink's residency entry must still settle, or the
+                # live gauge reports freed memory forever and the
+                # tenant's byte-seconds never accrue
+                from dpark_tpu import ledger
+                sink = ledger._SINK
+                if sink is not None:
+                    try:
+                        sink.fold({"name": "hbm.release",
+                                   "ts": time.time(),
+                                   "job": store.get("job"),
+                                   "args": {"sid": sid,
+                                            "bytes": store["nbytes"],
+                                            "reason": reason}})
+                    except Exception:
+                        pass
             if store.get("premerge") is not None:
                 # stop the background merger BEFORE deleting the spool
                 # it is reading/writing

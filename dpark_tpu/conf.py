@@ -583,6 +583,48 @@ SERVICE_SLO_BURN_YELLOW = float(os.environ.get(
 SERVICE_SLO_BURN_RED = float(os.environ.get(
     "DPARK_SERVICE_SLO_BURN_RED", "2.0"))
 
+# ---------------------------------------------------------------------------
+# resource attribution plane (dpark_tpu/ledger.py — ISSUE 15)
+# ---------------------------------------------------------------------------
+
+# off | on.  "on" (the default) installs the ledger sink as a second
+# TracePlane.record consumer (the health.py contract — one `is None`
+# check per record when off, on/off job results bit-identical): spans
+# fold into bounded merge-associative per-(tenant, job, stage,
+# program-signature) resource accounts — device wall ms, compile ms,
+# mesh-lock wait ms, HBM byte-seconds, shuffle/bulk/spill bytes.
+# /api/ledger, per-tenant /metrics counters, and the dtrace --ledger
+# offline twin read them.  With DPARK_TRACE=off nothing is emitted and
+# the ledger is inert either way.
+DPARK_LEDGER = os.environ.get("DPARK_LEDGER", "on")
+
+# bounded account registry: at most this many (job, stage, signature)
+# account keys; past the cap, new keys fold into their job's coarse
+# account (stage/sig dropped) so TOTALS stay honest no matter how many
+# distinct programs a resident server serves.  0 = unbounded.
+LEDGER_MAX_KEYS = int(os.environ.get("DPARK_LEDGER_MAX_KEYS",
+                                     "512") or 0)
+
+# static program cost profiles (the items-2/3 pricing prior): at first
+# dispatch of a compiled stage program, capture jax cost analysis
+# keyed by fuse.plan_adapt_signature and persist it to the adapt store
+# (adapt.record_program_cost).
+#   lower    (default) jitted.lower(args).cost_analysis() only — a
+#            host-side re-trace, no extra XLA compile (safe on real
+#            chips where a compile runs 30-150s)
+#   compile  additionally .compile().memory_analysis() for measured
+#            peak-HBM fields — ONE extra XLA compile per program
+#            signature (cheap on XLA:CPU; tests/CI use this)
+#   off      capture nothing
+LEDGER_COST = os.environ.get("DPARK_LEDGER_COST", "lower")
+
+# conservation grading: attributed per-tenant device-seconds must sum
+# to at least this fraction of the measured mesh-busy time (the
+# mesh-lock hold total) before /api/health grades attribution yellow —
+# device time the ledger cannot name is untracked consumption
+LEDGER_CONSERVE_YELLOW = float(os.environ.get(
+    "DPARK_LEDGER_CONSERVE_YELLOW", "0.9"))
+
 # flight recorder (ISSUE 14): warning-and-above events ALWAYS land in
 # a bounded in-memory ring (even with DPARK_TRACE=off); setting this
 # directory additionally dumps a crc-framed snapshot (ring + health

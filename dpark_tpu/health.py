@@ -528,11 +528,18 @@ def _worst(*grades):
     return "green"
 
 
-def grade(site_digests, rates, tenants=None, counters=None):
+def grade(site_digests, rates, tenants=None, counters=None,
+          ledger_data=None):
     """Grade each subsystem green/yellow/red WITH the evidence (tail
     ms, rates, thresholds) attached.  Pure function of its inputs so
     the offline twin (tools/dtrace --health) and the live endpoint
-    compute identical verdicts from identical data."""
+    compute identical verdicts from identical data.
+
+    `ledger_data` (ISSUE 15): {"top_programs", "top_tenants",
+    "conservation"} from the resource attribution plane — top-k
+    consumers attach as evidence so a yellow verdict NAMES its likely
+    consumer, and the conservation check grades as its own
+    `attribution` subsystem."""
     rates = rates or {}
     counters = counters or {}
     sites = summarize_sites(site_digests or {})
@@ -609,6 +616,12 @@ def grade(site_digests, rates, tenants=None, counters=None):
                      "compiles": rates.get("compile", 0),
                      "degrades": degrades,
                      "thresholds": {"wave_p99_ms": [wy, wr]}}}
+    if ledger_data and ledger_data.get("top_programs"):
+        # a yellow/red executor verdict should NAME its likely
+        # consumer (ISSUE 15 satellite): the heaviest programs by
+        # attributed device-seconds ride the evidence
+        out["executor"]["evidence"]["top_programs"] = \
+            ledger_data["top_programs"]
     # spill I/O
     site, p99 = tail("spill.")
     sy = float(getattr(conf, "HEALTH_SPILL_P99_YELLOW_MS", 500.0))
@@ -642,6 +655,28 @@ def grade(site_digests, rates, tenants=None, counters=None):
             "grade": worst,
             "evidence": {"tenants": tenants,
                          "thresholds": {"burn": [by, br]}}}
+        if ledger_data and ledger_data.get("top_tenants"):
+            # who is consuming the shared mesh (ISSUE 15): the
+            # heaviest tenants by HBM byte-seconds ride the SLO
+            # evidence so a burning tenant's verdict names the
+            # neighbor crowding it
+            out["service_slo"]["evidence"]["top_tenants"] = \
+                ledger_data["top_tenants"]
+    if ledger_data and ledger_data.get("conservation") is not None:
+        # the conservation check (ISSUE 15 acceptance): attributed
+        # device-seconds must reconcile with measured mesh busy time
+        # — a shortfall means untracked consumption the quota/
+        # preemption work (ROADMAP item 3) could not bill
+        cons = ledger_data["conservation"]
+        ok = cons.get("ok")
+        ev = dict(cons)
+        if ledger_data.get("top_programs"):
+            ev["top_programs"] = ledger_data["top_programs"]
+        if ledger_data.get("top_tenants"):
+            ev["top_tenants"] = ledger_data["top_tenants"]
+        out["attribution"] = {
+            "grade": "green" if ok in (True, None) else "yellow",
+            "evidence": ev}
     return out
 
 
@@ -676,13 +711,30 @@ def api_health(scheduler=None):
             tenants = svc.tenant_slo_stats() or None
     except Exception:
         tenants = None
+    ledger_data = None
+    try:
+        from dpark_tpu import ledger
+        if ledger.active():
+            # one snapshot + one merged-totals pass per scrape (the
+            # UI polls this endpoint; tenant_totals re-reads the
+            # worker sidecar files)
+            lsnap = ledger.snapshot()
+            ltotals = ledger.tenant_totals()
+            ledger_data = {
+                "top_programs": ledger.top_programs(snap=lsnap),
+                "top_tenants": ledger.top_tenants(totals=ltotals),
+                "conservation": ledger.conservation(scheduler,
+                                                    snap=lsnap),
+            }
+    except Exception:
+        ledger_data = None
     out = {
         "mode": mode(),
         "sites": summarize_sites(digests),
         "rates": snap.get("rates", {}),
         "gauges": dict(snap.get("gauges", {})),
         "subsystems": grade(digests, snap.get("rates"), tenants,
-                            counters),
+                            counters, ledger_data=ledger_data),
         "stage_fetch": {},
         "folded": snap.get("folded", 0),
     }
@@ -755,6 +807,15 @@ def flight_dump(reason, scheduler=None, record=None):
         ring.sort(key=lambda r: r.get("ts", 0.0))
         recs.extend({"kind": "flight.event", "rec": r} for r in ring)
         recs.append({"kind": "flight.health", "snapshot": snapshot()})
+        try:
+            from dpark_tpu import ledger
+            if ledger.active():
+                # resource attribution rides the post-mortem (ISSUE
+                # 15): who held the mesh when things went wrong
+                recs.append({"kind": "flight.ledger",
+                             "snapshot": ledger.snapshot()})
+        except Exception:
+            pass
         if record is not None:
             try:
                 recs.append({"kind": "flight.job",

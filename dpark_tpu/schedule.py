@@ -433,6 +433,13 @@ class DAGScheduler:
                 self._archive_metrics(dropped)
             del self.history[:-100]
         self._current_record = record
+        # resource attribution (ISSUE 15): register job -> tenant so
+        # the ledger's accounts roll up per client (one `is None`
+        # check when the plane is off; "local" on single-tenant
+        # masters)
+        from dpark_tpu import ledger
+        if ledger._SINK is not None:
+            ledger.note_job(record["id"], record.get("client"))
         self._job_started(record)
         return record
 
@@ -460,7 +467,11 @@ class DAGScheduler:
         trace.emit("job", "sched", t0, record.get("seconds", 0.0),
                    job=record["id"], scope=record.get("scope"),
                    state=record.get("state"),
-                   stages=record.get("stages"))
+                   stages=record.get("stages"),
+                   # tenant identity rides the span so the OFFLINE
+                   # ledger twin (dtrace --ledger) resolves accounts
+                   # to tenants from a spool alone (ISSUE 15)
+                   client=record.get("client") or "local")
 
     def _finalize_decodes(self, record):
         """Attribute coded-shuffle decode activity since the job

@@ -99,6 +99,7 @@ from collections import deque
 
 from dpark_tpu import conf
 from dpark_tpu import health as _health
+from dpark_tpu import ledger as _ledger
 
 MODES = ("off", "ring", "spool")
 
@@ -213,6 +214,15 @@ class TracePlane:
             # perturbs the traced job
             try:
                 sink.fold(rec)
+            except Exception:
+                pass
+        lsink = _ledger._SINK
+        if lsink is not None:
+            # resource attribution plane (ISSUE 15): the second record
+            # sink — per-(tenant, job, stage, program) accounts fold
+            # online under the same never-perturb contract
+            try:
+                lsink.fold(rec)
             except Exception:
                 pass
         args = rec.get("args")
@@ -453,6 +463,7 @@ def emit_process_counters():
                 "decodes": snap["totals"],
                 "decodes_per_shuffle": snap["per_shuffle"]}
         _write_process_health(plane)
+        _write_process_ledger(plane)
         # cumulative counters only change when a fault fires or a
         # decode happens — skip the write when nothing did, so a
         # long-lived worker running many tasks doesn't grow the
@@ -500,6 +511,96 @@ def _write_process_health(plane):
         plane._last_health = key
     except Exception:
         pass
+
+
+def _write_process_ledger(plane):
+    """Resource attribution plane (ISSUE 15): rewrite this process's
+    per-account ledger digests as ONE crc-framed record in its own
+    ``ledger-<host>-<pid>.jsonl`` (tmp+rename, latest-wins — the
+    health-<host>-<pid>.jsonl idiom), so the driver's merged accounts
+    include MULTIPROC workers' fetch/spill activity attributed to the
+    jobs that caused it.  Cumulative digests change with nearly every
+    task, so the on-disk cost stays O(1) per process."""
+    sink = _ledger._SINK
+    if sink is None:
+        return
+    try:
+        digests = sink.account_digests()
+        if not digests:
+            return
+        key = json.dumps(digests, sort_keys=True)
+        if key == getattr(plane, "_last_ledger", None):
+            return
+        from dpark_tpu.utils import frame_jsonl
+        rec = plane.make("process.ledger", "counters", time.time(),
+                         0.0, {"ledger": digests})
+        path = os.path.join(plane.dir, "ledger-%s-%d.jsonl"
+                            % (plane.host, plane.pid))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(frame_jsonl(rec))
+        os.replace(tmp, path)
+        plane._last_ledger = key
+    except Exception:
+        pass
+
+
+# jax backend-compile timing (ISSUE 15): jax.monitoring reports the
+# REAL XLA compile wall per event — the executor installs this once
+# per process and stamps the program signature it is dispatching for
+# in a thread-local, so compile.backend spans attribute to the right
+# (job, stage, program) account.  One predicate per compile when the
+# plane is off; compiles are rare by definition.
+_compile_listener_installed = False
+
+
+def set_compile_sig(sig):
+    """Stamp the program signature subsequent backend compiles on THIS
+    thread should attribute to (None clears)."""
+    _tls.compile_sig = sig
+
+
+def suppress_compile_spans(flag):
+    """Gate compile.backend emission on THIS thread: the ledger's
+    cost-capture compile (DPARK_LEDGER_COST=compile) is plane
+    overhead, not tenant consumption — emitting a span for it would
+    double-bill the program's compile_ms."""
+    _tls.no_compile_spans = bool(flag)
+
+
+def install_compile_listener():
+    """Register the jax.monitoring duration listener that turns
+    backend compiles into measured ``compile.backend`` spans.  Safe to
+    call repeatedly; a jax without the monitoring API is a no-op."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event, duration, **kw):
+            plane = _PLANE
+            if plane is None:
+                return
+            if getattr(_tls, "no_compile_spans", False):
+                return           # ledger cost-capture compile
+            if not str(event).endswith("backend_compile_duration"):
+                return
+            try:
+                sig = getattr(_tls, "compile_sig", None)
+                args = {"sig": sig} if sig else {}
+                plane.record(plane.make(
+                    "compile.backend", "exec",
+                    time.time() - float(duration), float(duration),
+                    args))
+            except Exception:
+                pass
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _compile_listener_installed = True
+        return True
+    except Exception:
+        return False
 
 
 def counts():
@@ -590,8 +691,10 @@ def merged_worker_counters(trace_dir=None, include_self=False,
     me = os.getpid()
     latest = {}
     latest_health = {}
+    latest_ledger = {}
     for rec in read_spool(trace_dir, prefixes=("counters-",
-                                               "health-")):
+                                               "health-",
+                                               "ledger-")):
         if rec.get("cat") != "counters":
             continue
         if run and rec.get("run") != run:
@@ -607,14 +710,23 @@ def merged_worker_counters(trace_dir=None, include_self=False,
             # _write_process_health)
             latest_health[(rec.get("host"), pid)] = \
                 args.get("health") or {}
+        elif rec.get("name") == "process.ledger":
+            # the per-process ledger digest file (ISSUE 15; same
+            # latest-wins O(1) idiom — see _write_process_ledger)
+            latest_ledger[(rec.get("host"), pid)] = \
+                args.get("ledger") or {}
         else:
             latest[(rec.get("host"), pid)] = args
     out = {"faults": {}, "decodes": {}, "decodes_per_shuffle": {},
-           "health": {}, "processes": len(latest)}
+           "health": {}, "ledger": {}, "processes": len(latest)}
     for digests in latest_health.values():
         for site, digest in digests.items():
             out["health"][site] = _health.merge_digests(
                 out["health"].get(site), digest)
+    for digests in latest_ledger.values():
+        for key, digest in digests.items():
+            out["ledger"][key] = _ledger.merge_account_digests(
+                out["ledger"].get(key), digest)
     for args in latest.values():
         for site, st in (args.get("faults") or {}).items():
             ent = out["faults"].setdefault(site,

@@ -91,6 +91,27 @@ def render_metrics(scheduler):
            "jobs that finished outside their tenant's SLO",
            [({"tenant": c}, t.get("violations_total", 0))
             for c, t in rows] or [({"tenant": "none"}, 0)])
+    # resource attribution plane (ISSUE 15): per-tenant mesh
+    # consumption counters.  Monotonic by construction — accounts only
+    # ever grow and HBM byte-seconds accrue at release.
+    try:
+        from dpark_tpu import ledger
+        ltenants = ledger.tenant_totals()
+    except Exception:
+        ltenants = {}
+    lrows = sorted(ltenants.items())
+    for key, help_text in (
+            ("device_seconds", "attributed device wall seconds "
+                               "(mesh-lock-held stage execution)"),
+            ("lock_wait_seconds", "seconds spent waiting for the "
+                                  "mesh lock (contention)"),
+            ("hbm_byte_seconds", "HBM shuffle-store bytes x resident "
+                                 "seconds, accrued at release"),
+            ("bulk_bytes", "bulk data-plane payload bytes attributed "
+                           "to the tenant's jobs")):
+        metric("dpark_tenant_%s_total" % key, "counter", help_text,
+               [({"tenant": c}, t.get(key, 0)) for c, t in lrows]
+               or [({"tenant": "none"}, 0)])
     metric("dpark_stages_total", "counter", "stages by execution kind",
            [({"kind": k}, n) for k, n in sorted(snap["stages"].items())]
            or [({"kind": "none"}, 0)])
@@ -268,6 +289,14 @@ _PAGE = """<!doctype html>
 <th>stream</th>
 <th>fallback / degrade</th>
 </tr></table>
+<h2>resource ledger <small>(per-tenant mesh attribution)</small></h2>
+<div id="util" style="width:480px;height:18px;display:flex;
+ border:1px solid #999;margin-bottom:6px"></div>
+<div id="utiltxt" style="margin-bottom:8px"></div>
+<table id="l"><tr><th>tenant</th><th>device s</th>
+<th>lock wait s</th><th>HBM byte-s</th><th>bulk bytes</th>
+<th>spill bytes</th><th>fetches</th><th>compiles (ms)</th>
+<th>waves</th></tr></table>
 <h2>streams <small>(pane plane: windowed DStreams)</small></h2>
 <table id="w"><tr><th>stream</th><th>type</th><th>mode</th>
 <th>window/slide</th><th>panes</th><th>nodes (built)</th>
@@ -423,6 +452,46 @@ async function tick() {
       }
     }
   }
+  // resource ledger (ISSUE 15): per-tenant attribution table + the
+  // mesh busy/idle/contended utilization bar
+  try {
+    const lr = await fetch('/api/ledger'); const led = await lr.json();
+    const lt = document.getElementById('l');
+    while (lt.rows.length > 1) lt.deleteRow(1);
+    const tenants = led.tenants || {};
+    for (const name of Object.keys(tenants).sort()) {
+      const a = tenants[name];
+      const row = lt.insertRow();
+      for (const v of [name, a.device_seconds, a.lock_wait_seconds,
+                       a.hbm_byte_seconds, a.bulk_bytes,
+                       a.spill_bytes, a.fetches,
+                       a.compiles + ' (' + a.compile_ms + ')',
+                       a.waves])
+        row.insertCell().textContent = v === undefined ? '' : v;
+    }
+    const u = led.utilization || {};
+    const bar = document.getElementById('util');
+    bar.innerHTML = '';
+    for (const [frac, color, label] of
+         [[u.busy_frac, '#2a2', 'busy'],
+          [u.contended_frac, '#c22', 'contended'],
+          [u.idle_frac, '#ddd', 'idle']]) {
+      const seg = document.createElement('div');
+      seg.style.width = (100 * (frac || 0)) + '%';
+      seg.style.background = color;
+      seg.title = label + ' ' + (100 * (frac || 0)).toFixed(1) + '%';
+      bar.appendChild(seg);
+    }
+    const cons = led.conservation || {};
+    document.getElementById('utiltxt').textContent =
+      'mesh busy ' + (100 * (u.busy_frac || 0)).toFixed(1) +
+      '% / contended ' + (100 * (u.contended_frac || 0)).toFixed(1) +
+      '% / idle ' + (100 * (u.idle_frac || 0)).toFixed(1) +
+      '%  |  conservation: ' +
+      (cons.ratio === null || cons.ratio === undefined
+        ? 'n/a' : (100 * cons.ratio).toFixed(1) +
+          '% of busy time attributed');
+  } catch (e) {}
   // pane-plane streams (ISSUE 10): live pane counts, watermark lag,
   // late-record accounting per windowed stream
   const wr = await fetch('/api/streams'); const streams = await wr.json();
@@ -500,6 +569,19 @@ def start_ui(scheduler, host="127.0.0.1", port=0):
                     from dpark_tpu import health as health_mod
                     body = json.dumps(
                         health_mod.api_health(scheduler)).encode()
+                except Exception as e:
+                    body = json.dumps(
+                        {"mode": "error", "error": str(e)}).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/api/ledger"):
+                # resource attribution plane (ISSUE 15): per-tenant
+                # accounts, the mesh utilization split, and the
+                # conservation check — defensive snapshots, never an
+                # error
+                try:
+                    from dpark_tpu import ledger as ledger_mod
+                    body = json.dumps(
+                        ledger_mod.api_ledger(scheduler)).encode()
                 except Exception as e:
                     body = json.dumps(
                         {"mode": "error", "error": str(e)}).encode()

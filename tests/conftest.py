@@ -49,6 +49,22 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+def load_tool(name):
+    """Import one of the extensionless tools/ CLIs (dtrace, ...) or a
+    tools/*.py script as a module — shared by every tool-driving
+    test."""
+    import importlib.machinery
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", name)
+    modname = "_tool_%s" % name.replace(".", "_")
+    loader = importlib.machinery.SourceFileLoader(modname, path)
+    spec = importlib.util.spec_from_loader(modname, loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
 @pytest.fixture()
 def ctx():
     from dpark_tpu import DparkContext

@@ -1,0 +1,109 @@
+"""Bench trajectory tooling (ISSUE 15 satellite): tools/bench_history
+extracts a run's headline ratios, appends them to the trajectory
+file, and diffs vs the previous entry."""
+
+import json
+import os
+
+import pytest
+
+
+def _load():
+    from tests.conftest import load_tool
+    return load_tool("bench_history.py")
+
+
+FAKE_RUN_1 = """
+# noise the extractor must skip
+{"metric": "reduceByKey_GBps_per_chip_EMULATED_CPU", "value": 0.02, "vs_baseline": 4.1}
+{"metric": "table_query_device_vs_host", "value": 9.2}
+{"metric": "bulk_channel_vs_bridge", "value": 16.6}
+{"metric": "adapt_warm_vs_cold", "value": 0.18}
+{"metric": "service_warm_submit", "value": 4.7}
+{"metric": "health_plane_overhead", "value": 0.97}
+{"metric": "ledger_plane_overhead", "value": 1.01}
+{"metric": "unrelated_metric", "value": 123.0}
+not json at all
+"""
+
+FAKE_RUN_2 = """
+{"metric": "reduceByKey_GBps_per_chip_EMULATED_CPU", "value": 0.02, "vs_baseline": 3.9}
+{"metric": "table_query_device_vs_host", "value": 4.0}
+{"metric": "bulk_channel_vs_bridge", "value": 17.0}
+{"metric": "health_plane_overhead", "value": 1.10}
+{"metric": "ledger_plane_overhead", "value": 1.0}
+"""
+
+
+def test_extract_ratios():
+    bh = _load()
+    ratios = bh.extract_ratios(FAKE_RUN_1.splitlines())
+    assert ratios == {"reduce_vs_baseline": 4.1,
+                      "table_device_vs_host": 9.2,
+                      "bulk_channel_vs_bridge": 16.6,
+                      "adapt_warm_vs_cold": 0.18,
+                      "service_warm_submit": 4.7,
+                      "health_plane_overhead": 0.97,
+                      "ledger_plane_overhead": 1.01}
+
+
+def test_append_and_diff(tmp_path, capsys):
+    bh = _load()
+    out = str(tmp_path / "BENCH_TRAJECTORY.jsonl")
+    run1 = tmp_path / "run1.txt"
+    run1.write_text(FAKE_RUN_1)
+    assert bh.main([str(run1), "--out", out, "--label", "t1"]) == 0
+    text = capsys.readouterr().out
+    assert "trajectory was empty" in text
+    entries = bh.load_trajectory(out)
+    assert len(entries) == 1
+    assert entries[0]["seq"] == 1 and entries[0]["label"] == "t1"
+
+    run2 = tmp_path / "run2.txt"
+    run2.write_text(FAKE_RUN_2)
+    assert bh.main([str(run2), "--out", out]) == 0
+    text = capsys.readouterr().out
+    # the diff names the slide: table ratio halved (regressed), bulk
+    # improved, health overhead rose (regressed on a lower-is-better)
+    assert "table_device_vs_host" in text
+    assert "regressed" in text
+    entries = bh.load_trajectory(out)
+    assert len(entries) == 2 and entries[1]["seq"] == 2
+    # metrics missing from run 2 (service/adapt) simply don't diff
+    assert "adapt_warm_vs_cold" not in entries[1]["ratios"]
+
+
+def test_gate_fails_on_regression(tmp_path, capsys):
+    bh = _load()
+    out = str(tmp_path / "traj.jsonl")
+    run1 = tmp_path / "run1.txt"
+    run1.write_text(FAKE_RUN_1)
+    run2 = tmp_path / "run2.txt"
+    run2.write_text(FAKE_RUN_2)
+    assert bh.main([str(run1), "--out", out]) == 0
+    # table_device_vs_host dropped 9.2 -> 4.0 (-57%): gate at 20%
+    assert bh.main([str(run2), "--out", out, "--gate", "20"]) == 1
+    assert "FAIL" in capsys.readouterr().out
+    # without the gate the same diff is informational
+    assert bh.main([str(run2), "--out", out]) == 0
+
+
+def test_empty_input_fails(tmp_path):
+    bh = _load()
+    empty = tmp_path / "empty.txt"
+    empty.write_text("no metrics here\n")
+    assert bh.main([str(empty), "--out",
+                    str(tmp_path / "t.jsonl")]) == 1
+
+
+def test_corrupt_trajectory_lines_skip(tmp_path):
+    bh = _load()
+    out = tmp_path / "traj.jsonl"
+    out.write_text('{"seq": 1, "ratios": {"bulk_channel_vs_bridge": '
+                   '2.0}}\nGARBAGE LINE\n')
+    run1 = tmp_path / "run1.txt"
+    run1.write_text(FAKE_RUN_1)
+    assert bh.main([str(run1), "--out", str(out)]) == 0
+    entries = bh.load_trajectory(str(out))
+    assert len(entries) == 2
+    assert entries[-1]["seq"] == 2

@@ -433,15 +433,8 @@ def test_api_health_never_throws_mid_mutation(ctx):
 # ---------------------------------------------------------------------------
 
 def _load_dtrace():
-    import importlib.machinery
-    import importlib.util
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "tools", "dtrace")
-    loader = importlib.machinery.SourceFileLoader("_dtrace_cli", path)
-    spec = importlib.util.spec_from_loader("_dtrace_cli", loader)
-    mod = importlib.util.module_from_spec(spec)
-    loader.exec_module(mod)
-    return mod
+    from tests.conftest import load_tool
+    return load_tool("dtrace")
 
 
 def test_dtrace_health_matches_live_endpoint(ctx, tmp_path, capsys):

@@ -421,15 +421,8 @@ def test_critical_path_reconciles_with_phase_table(tctx2, tiny_waves):
 # ---------------------------------------------------------------------------
 
 def _load_dtrace():
-    import importlib.machinery
-    import importlib.util
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "tools", "dtrace")
-    loader = importlib.machinery.SourceFileLoader("_dtrace_cli", path)
-    spec = importlib.util.spec_from_loader("_dtrace_cli", loader)
-    mod = importlib.util.module_from_spec(spec)
-    loader.exec_module(mod)
-    return mod
+    from tests.conftest import load_tool
+    return load_tool("dtrace")
 
 
 def test_chrome_export_shape(ctx, tmp_path):

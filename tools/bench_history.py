@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""bench_history: append a bench run's headline ratios to the bench
+trajectory and diff them against the previous entry (ISSUE 15
+satellite).
+
+Every bench/smoke run prints JSON metric lines; until now they died
+with the CI log — the trajectory file was empty and a slow regression
+across PRs was invisible.  This tool extracts the headline RATIOS
+(dimensionless, so they are comparable across machines in a way raw
+walls are not), appends one JSON line per run to
+``BENCH_TRAJECTORY.jsonl``, and prints the deltas vs the previous
+entry::
+
+    python tools/bench_smoke_check.py | tee /tmp/bench.out
+    python tools/bench_history.py /tmp/bench.out --label ci
+
+Tracked ratios (whatever the run emitted):
+
+    reduce_vs_baseline        device reduceByKey vs host process
+    groupmap_device_vs_host   SegMapOp A/B
+    table_device_vs_host      columnar query plane A/B
+    bulk_channel_vs_bridge    bulk data plane vs pickled bridge
+    coded_overhead            rs(4,2) no-fault overhead (<= 1.15)
+    adapt_warm_vs_cold        warm wall / cold wall (< 1)
+    service_warm_submit       cold/warm first-wave latency (>= 3)
+    health_plane_overhead     sink on/off wall ratio (<= 1.03)
+    ledger_plane_overhead     ledger on/off wall ratio (<= 1.03)
+
+The trajectory is plain JSON lines (one entry per run) so ``git
+diff`` reads it; corrupt lines skip at load.  The diff is
+informational by default; ``--gate PCT`` exits 1 when any tracked
+ratio regressed by more than PCT percent vs the previous entry
+(higher-is-better metrics dropping, overhead metrics rising).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# metric-line name -> (trajectory key, higher_is_better)
+HEADLINES = {
+    "reduceByKey_GBps_per_chip": ("reduce_vs_baseline", True),
+    "reduceByKey_GBps_per_chip_EMULATED_CPU":
+        ("reduce_vs_baseline", True),
+    "group_mapvalues_device_vs_host": ("groupmap_device_vs_host",
+                                       True),
+    "table_query_device_vs_host": ("table_device_vs_host", True),
+    "bulk_channel_vs_bridge": ("bulk_channel_vs_bridge", True),
+    "coded_shuffle_overhead": ("coded_overhead", False),
+    "adapt_warm_vs_cold": ("adapt_warm_vs_cold", False),
+    "service_warm_submit": ("service_warm_submit", True),
+    "health_plane_overhead": ("health_plane_overhead", False),
+    "ledger_plane_overhead": ("ledger_plane_overhead", False),
+}
+
+
+def extract_ratios(lines):
+    """JSON metric lines -> {trajectory key: ratio}.  The reduce line
+    contributes its vs_baseline ratio (the GB/s value is
+    machine-bound); every other line contributes its `value`."""
+    out = {}
+    for ln in lines:
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        metric = str(rec.get("metric", ""))
+        base = metric
+        for suffix in ("_EMULATED_CPU",):
+            if base.endswith(suffix) and base not in HEADLINES:
+                base = base[:-len(suffix)]
+        ent = HEADLINES.get(metric) or HEADLINES.get(base)
+        if ent is None:
+            continue
+        key, _ = ent
+        if key == "reduce_vs_baseline":
+            v = rec.get("vs_baseline")
+        else:
+            v = rec.get("value")
+        if isinstance(v, (int, float)):
+            out[key] = round(float(v), 4)
+    return out
+
+
+def load_trajectory(path):
+    entries = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    entries.append(json.loads(ln))
+                except ValueError:
+                    continue            # corrupt line: skip, never fail
+    except OSError:
+        pass
+    return entries
+
+
+def diff_entries(prev, cur):
+    """[(key, prev, cur, pct_change, regressed)] for every ratio both
+    entries carry.  pct is signed in the metric's GOOD direction:
+    positive = improved."""
+    rows = []
+    pr = (prev or {}).get("ratios", {})
+    cr = cur.get("ratios", {})
+    better = {key: hib for _, (key, hib) in HEADLINES.items()}
+    for key in sorted(set(pr) & set(cr)):
+        a, b = float(pr[key]), float(cr[key])
+        if a == 0:
+            continue
+        pct = (b - a) / abs(a) * 100.0
+        if not better.get(key, True):
+            pct = -pct                  # lower is better: flip sign
+        rows.append((key, a, b, round(pct, 2), pct < 0))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="bench_history", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("bench_out",
+                    help="file holding a bench run's stdout "
+                         "(JSON metric lines; '-' reads stdin)")
+    ap.add_argument("--out", default=None,
+                    help="trajectory file (default: "
+                         "BENCH_TRAJECTORY.jsonl beside this repo)")
+    ap.add_argument("--label", default="",
+                    help="free-form tag for the entry (e.g. ci, "
+                         "local, r15)")
+    ap.add_argument("--gate", type=float, default=None, metavar="PCT",
+                    help="exit 1 when any ratio regressed more than "
+                         "PCT%% vs the previous entry")
+    args = ap.parse_args(argv)
+
+    if args.bench_out == "-":
+        lines = sys.stdin.read().splitlines()
+    else:
+        with open(args.bench_out) as f:
+            lines = f.read().splitlines()
+    ratios = extract_ratios(lines)
+    if not ratios:
+        print("FAIL: no headline metric lines found in %r"
+              % args.bench_out)
+        return 1
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = args.out or os.path.join(repo, "BENCH_TRAJECTORY.jsonl")
+    entries = load_trajectory(path)
+    prev = entries[-1] if entries else None
+    entry = {"seq": (prev.get("seq", 0) + 1) if prev else 1,
+             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+             "label": args.label, "ratios": ratios}
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    print("recorded entry %d (%d ratios) -> %s"
+          % (entry["seq"], len(ratios), path))
+
+    if prev is None:
+        print("no previous entry to diff against (trajectory was "
+              "empty)")
+        return 0
+    rows = diff_entries(prev, entry)
+    regressed = []
+    for key, a, b, pct, bad in rows:
+        print("  %-26s %8.3f -> %8.3f  (%+.1f%% %s)"
+              % (key, a, b, pct, "regressed" if bad else "ok"))
+        if bad and args.gate is not None and -pct > args.gate:
+            regressed.append((key, pct))
+    new_keys = sorted(set(entry["ratios"]) - set(
+        (prev.get("ratios") or {})))
+    if new_keys:
+        print("  new since previous entry: %s" % ", ".join(new_keys))
+    if regressed:
+        print("FAIL: regressed beyond --gate %.1f%%: %s"
+              % (args.gate, ", ".join("%s (%.1f%%)" % r
+                                      for r in regressed)))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
