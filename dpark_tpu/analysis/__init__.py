@@ -21,11 +21,14 @@ from dpark_tpu.analysis.report import (Finding, PlanLintError, Report,
 from dpark_tpu.analysis.plan_rules import iter_lineage, lint_plan
 from dpark_tpu.analysis.closure_rules import (iter_plan_functions,
                                               lint_function, lint_source)
+from dpark_tpu.analysis.concurrency import (ConcurrencyPass,
+                                            lint_concurrency)
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("analysis")
 
-__all__ = ["Finding", "PlanLintError", "Report", "lint_mode",
+__all__ = ["ConcurrencyPass", "Finding", "PlanLintError", "Report",
+           "lint_concurrency", "lint_mode",
            "lint_plan", "lint_source", "lint_function", "iter_lineage",
            "iter_plan_functions", "preflight"]
 

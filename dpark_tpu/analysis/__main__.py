@@ -1,7 +1,8 @@
-"""dlint — run the closure rules over source files/trees.
+"""dlint — run the closure + concurrency rules over source files/trees.
 
     python -m dpark_tpu.analysis file.py dir/ ...
     python -m dpark_tpu.analysis --self            # dpark_tpu/ + examples/
+    python -m dpark_tpu.analysis --locks           # concurrency rules only
     tools/dlint examples/wordcount.py              # thin wrapper
 
 Exit codes: 0 clean (or every finding baselined / warnings only without
@@ -11,8 +12,10 @@ play), 2 usage error.
 The committed baseline (tools/dlint_baseline.json) freezes today's
 known findings so CI fails only on NEW anti-patterns: a baseline key is
 "<relpath>::<rule>::<site-minus-line-numbers>", deliberately coarse so
-unrelated edits to a file do not churn it.  Refresh deliberately with
---write-baseline after fixing or accepting findings.
+unrelated edits to a file do not churn it.  The file maps each key to a
+one-line justification for WHY the finding is accepted (legacy bare
+lists still load).  Refresh deliberately with --write-baseline after
+fixing or accepting findings; existing justifications are preserved.
 """
 
 import argparse
@@ -23,6 +26,7 @@ import sys
 
 from dpark_tpu.analysis.report import SEVERITIES, Report
 from dpark_tpu.analysis.closure_rules import lint_source
+from dpark_tpu.analysis.concurrency import ConcurrencyPass
 
 
 def _repo_root():
@@ -51,9 +55,22 @@ def baseline_key(root, finding):
     + site with every :<line> stripped."""
     site = re.sub(r":\d+", "", finding.site)
     parts = site.split(" ", 1)
-    rel = os.path.relpath(parts[0], root).replace(os.sep, "/")
+    rel = parts[0]
+    if os.path.isabs(rel):
+        rel = os.path.relpath(rel, root)
+    rel = rel.replace(os.sep, "/")
     tail = (" " + parts[1]) if len(parts) > 1 else ""
     return "%s%s::%s" % (rel, tail, finding.rule)
+
+
+def load_baseline(path):
+    """Baseline file -> {key: justification}.  Accepts the legacy bare
+    list form (justification defaults to empty)."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return {k: "" for k in data}
+    return dict(data)
 
 
 def main(argv=None):
@@ -62,6 +79,11 @@ def main(argv=None):
     ap.add_argument("paths", nargs="*", help=".py files or directories")
     ap.add_argument("--self", dest="self_lint", action="store_true",
                     help="lint the dpark_tpu package and examples/")
+    ap.add_argument("--locks", action="store_true",
+                    help="run ONLY the concurrency rule families "
+                         "(lock-order-cycle, blocking-under-lock, "
+                         "unbounded-wait, thread-leak, plane-contract);"
+                         " with no paths, defaults to --self scope")
     ap.add_argument("--baseline", default=None,
                     help="JSON baseline of accepted finding keys "
                          "(default with --self: tools/dlint_baseline"
@@ -78,6 +100,8 @@ def main(argv=None):
     root = _repo_root()
     paths = list(args.paths)
     baseline_path = args.baseline
+    if args.locks and not paths and not args.self_lint:
+        args.self_lint = True       # bare `dlint --locks` = self scope
     if args.self_lint:
         paths += [os.path.join(root, "dpark_tpu"),
                   os.path.join(root, "examples")]
@@ -88,17 +112,35 @@ def main(argv=None):
         ap.print_usage(sys.stderr)
         return 2
 
+    run_closure = not args.locks
+    run_locks = args.locks or args.self_lint
     report = Report()
+    conc = ConcurrencyPass(root=root) if run_locks else None
     nfiles = 0
     for path in _py_files(paths):
         nfiles += 1
-        lint_source(path, report=report, tpu=args.tpu)
+        if run_closure:
+            lint_source(path, report=report, tpu=args.tpu)
+        if conc is not None:
+            conc.add_source(path)
+    if conc is not None:
+        # the lock-order graph is global: finish() merges edges across
+        # every file fed above, then checks cycles + plane contracts
+        conc.finish(report)
 
     keys = {baseline_key(root, f): f for f in report}
     if args.write_baseline and baseline_path:
+        old = {}
+        if os.path.exists(baseline_path):
+            old = load_baseline(baseline_path)
+        merged = {k: old.get(k, "") for k in sorted(keys)}
+        if not run_closure:
+            # partial run (--locks): keep the closure-rule keys intact
+            for k, v in old.items():
+                merged.setdefault(k, v)
         os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
         with open(baseline_path, "w") as f:
-            json.dump(sorted(keys), f, indent=1)
+            json.dump(merged, f, indent=1, sort_keys=True)
             f.write("\n")
         print("dlint: wrote %d baseline keys -> %s"
               % (len(keys), baseline_path), file=sys.stderr)
@@ -106,8 +148,7 @@ def main(argv=None):
 
     baseline = set()
     if baseline_path and os.path.exists(baseline_path):
-        with open(baseline_path) as f:
-            baseline = set(json.load(f))
+        baseline = set(load_baseline(baseline_path))
 
     fresh = [f for k, f in sorted(keys.items()) if k not in baseline]
     suppressed = len(report) - len(fresh)

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from dpark_tpu import conf, faults, trace
+from dpark_tpu import conf, faults, locks, trace
 from dpark_tpu.backend.tpu import collectives, fuse, layout
 from dpark_tpu.utils.log import get_logger
 
@@ -402,7 +402,7 @@ class _ProgramCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("executor.program_cache")
         # exact per-job attribution (ISSUE 15 satellite): each probe
         # also counts against the job the probing THREAD is executing
         # for (`_job_of`, installed by the executor to read its
@@ -526,6 +526,10 @@ class _MeshLock:
             self._lock.acquire()
             tls.depth = depth + 1
             return self
+        # lockcheck plane: one global load + `is None` check when off;
+        # noted BEFORE the acquire so a strict-mode cycle raises as a
+        # stack trace instead of wedging here
+        locks.note_acquire("executor.mesh")
         t0 = time.time()
         wait = 0.0
         if not self._lock.acquire(False):
@@ -553,6 +557,7 @@ class _MeshLock:
             self.wait_s += wait
             self.contended += 1
         self._lock.release()
+        locks.note_release("executor.mesh")
         if trace._PLANE is not None:
             trace.emit("mesh.lock", "exec", t_req, wait,
                        hold_s=round(hold, 6))
@@ -718,7 +723,8 @@ class JAXExecutor:
         # within one reduce task's fan-out, so entries age out fast.
         self._shard_cache = {}        # (sid, map, reduce) -> [frames]
         self._shard_cache_bytes = 0
-        self._shard_build_lock = threading.Lock()
+        self._shard_build_lock = locks.named_lock(
+            "executor.shard_build")
         self._tracing = False
         if conf.XPROF_DIR:
             try:

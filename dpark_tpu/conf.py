@@ -625,6 +625,25 @@ LEDGER_COST = os.environ.get("DPARK_LEDGER_COST", "lower")
 LEDGER_CONSERVE_YELLOW = float(os.environ.get(
     "DPARK_LEDGER_CONSERVE_YELLOW", "0.9"))
 
+# concurrency sanitizer plane (dpark_tpu/locks.py — ISSUE 16): the
+# named-lock registry records per-thread lock acquisition order and
+# merges it into a process-wide graph, reporting lock-order cycles
+# even when no deadlock fired.
+#   off     no sanitizer; every named lock costs one `is None` check
+#           per acquisition (the standard plane off-mode contract)
+#   record  record edges; cycles() / report() surface inversions —
+#           CI arms this across the whole test suite
+#   strict  the acquisition that CLOSES a cycle (or self-deadlocks a
+#           non-reentrant lock) raises LockOrderError pre-acquire
+DPARK_LOCKCHECK = os.environ.get("DPARK_LOCKCHECK", "off")
+
+# shard/bucket fetch result waits (lockcheck `unbounded-wait` fixes):
+# a wedged peer read on a daemon fetch thread must surface as a fetch
+# failure the scheduler can recover from, not park the driver forever.
+# Seconds; generous — only a true wedge ever waits this long.
+SHUFFLE_FETCH_WAIT_S = float(os.environ.get(
+    "DPARK_SHUFFLE_FETCH_WAIT_S", "300") or 300)
+
 # flight recorder (ISSUE 14): warning-and-above events ALWAYS land in
 # a bounded in-memory ring (even with DPARK_TRACE=off); setting this
 # directory additionally dumps a crc-framed snapshot (ring + health

@@ -53,6 +53,7 @@ import struct
 import threading
 import time
 
+from dpark_tpu import locks
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("dcn")
@@ -286,7 +287,7 @@ class BucketServer(FramedServer):
     def __init__(self, workdir, host="0.0.0.0", port=0):
         self.workdir = workdir
         self.bcast_serves = {}        # (bid, chunk) -> times served
-        self._serves_lock = threading.Lock()
+        self._serves_lock = locks.named_lock("dcn.serves")
         super().__init__(self._serve, host, port,
                          name="dpark-bucket-server")
 

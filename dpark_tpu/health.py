@@ -60,6 +60,7 @@ import threading
 import time
 
 from dpark_tpu import conf
+from dpark_tpu import locks
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("health")
@@ -75,7 +76,7 @@ _B0 = 1e-4
 NBUCKETS = 36
 
 _SINK = None                 # the `is None` check trace.record makes
-_lock = threading.Lock()     # guards install/clear
+_lock = locks.named_lock("health.install")   # guards install/clear
 
 
 class Sketch:
@@ -205,7 +206,7 @@ class HealthSink:
     fetch sketches) and guarded by one lock."""
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = locks.named_lock("health.sink")
         self.sites = {}          # site -> Sketch (bounded)
         self.rates = {}          # event name -> count
         self.gauges = {"spill_bytes_written": 0,
@@ -760,7 +761,7 @@ def api_health(scheduler=None):
 # consumer 3: the flight recorder
 # ---------------------------------------------------------------------------
 
-_flight_lock = threading.Lock()
+_flight_lock = locks.named_lock("health.flight")
 _flight_dumps = 0
 _sigusr2_installed = False
 

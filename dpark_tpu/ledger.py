@@ -65,6 +65,7 @@ import threading
 import time
 
 from dpark_tpu import conf
+from dpark_tpu import locks
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("ledger")
@@ -72,7 +73,7 @@ logger = get_logger("ledger")
 MODES = ("off", "on")
 
 _SINK = None                 # the `is None` check trace.record makes
-_lock = threading.Lock()     # guards install/clear
+_lock = locks.named_lock("ledger.install")   # guards install/clear
 
 # fields every account carries, all additive (merge = field-wise sum,
 # associative and commutative — asserted in tests).  *_ms/*_s are
@@ -158,7 +159,7 @@ class LedgerSink:
     store) and guarded by one lock."""
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = locks.named_lock("ledger.sink")
         self.accounts = {}       # (job, stage, sig) -> Account
         self.job_tenant = {}     # job id -> tenant/client name
         self._job_order = []
@@ -818,7 +819,7 @@ def summary():
 # ---------------------------------------------------------------------------
 
 _cost_seen = set()
-_cost_lock = threading.Lock()
+_cost_lock = locks.named_lock("ledger.cost")
 
 
 def _cost_key(sig):

@@ -17,7 +17,7 @@ import traceback
 
 import sys
 
-from dpark_tpu import conf, serialize, trace
+from dpark_tpu import conf, locks, serialize, trace
 
 
 def _submodule(name):
@@ -102,7 +102,8 @@ class DAGScheduler:
         # snapshot copies defensively.  The archive keeps aggregates
         # of records trimmed out of the 100-job window so /metrics
         # counters never decrease.
-        self._metrics_lock = threading.RLock()
+        self._metrics_lock = locks.named_lock(
+            "schedule.metrics", reentrant=True)
         self._metrics_archive = self._new_metrics()
         # resident job server (ISSUE 9): when attached, stage
         # execution routes through the server's fair dispatcher
@@ -118,7 +119,8 @@ class DAGScheduler:
         self._last_record = None
         # guards the shared stage graph (shuffle_to_stage) against
         # concurrent run_job invocations from different driver threads
-        self._graph_lock = threading.RLock()
+        self._graph_lock = locks.named_lock(
+            "schedule.graph", reentrant=True)
 
     # -- lifecycle -------------------------------------------------------
     def start(self):

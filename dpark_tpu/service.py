@@ -49,6 +49,7 @@ import traceback
 from collections import deque
 
 from dpark_tpu import conf
+from dpark_tpu import locks
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("service")
@@ -163,7 +164,7 @@ class JobServer:
         self._adm_cv = threading.Condition()
         self._active_jobs = 0
         self._waiting_jobs = 0
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("service.server")
         # per-tenant bulk-stream bytes (ISSUE 12; see note_bulk)
         self._bulk_bytes = {}
         # per-tenant SLO accounting (ISSUE 14; see note_job_done)
@@ -439,7 +440,7 @@ class JobServer:
 # ---------------------------------------------------------------------------
 
 _SERVER = None
-_SERVER_LOCK = threading.Lock()
+_SERVER_LOCK = locks.named_lock("service.global")
 _client_ids = itertools.count(1)
 
 

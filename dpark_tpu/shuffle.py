@@ -20,7 +20,7 @@ import threading
 import time
 from queue import Empty, Queue
 
-from dpark_tpu import coding, conf, faults, trace
+from dpark_tpu import coding, conf, faults, locks, trace
 from dpark_tpu.utils import atomic_file, compress, decompress
 from dpark_tpu.utils.log import get_logger
 
@@ -240,7 +240,7 @@ class _ShardPool:
         self.tasks = Queue()
         self.size = size
         self.nthreads = 0
-        self.lock = threading.Lock()
+        self.lock = locks.named_lock("shuffle.shard_pool")
 
     def submit(self, fn, *args):
         self.tasks.put((fn, args))
@@ -305,7 +305,15 @@ def _fetch_coded(ordered, shuffle_id, map_id, reduce_id, code, hm):
     had_error = False
     frame_code = None
     while len(got) < k and outstanding:
-        idx, err, fr, uri = results.get()
+        try:
+            idx, err, fr, uri = results.get(
+                timeout=conf.SHUFFLE_FETCH_WAIT_S)
+        except Empty:
+            # a wedged shard pool (dead worker, lost peer) must not
+            # park the reduce task forever: fall through to the
+            # shortfall path below, which raises FetchFailed and
+            # hands the bucket to lineage recovery
+            break
         outstanding -= 1
         if err is None:
             if frame_code is None:
@@ -455,7 +463,15 @@ def _fetch_coded_local(ordered, shuffle_id, map_id, reduce_id):
             _SHARD_POOL.submit(attempt, fr)
         outstanding = len(frames)
         while len(good) < k and outstanding:
-            fr, err, payload = results.get()
+            try:
+                fr, err, payload = results.get(
+                    timeout=conf.SHUFFLE_FETCH_WAIT_S)
+            except Empty:
+                # wedged pool: the shortfall re-verify below retries
+                # from the pristine container bytes instead of
+                # parking here forever
+                had_error = True
+                break
             outstanding -= 1
             if err is None:
                 good.setdefault(fr.idx, payload)
@@ -705,7 +721,21 @@ class ParallelShuffleFetcher(SimpleShuffleFetcher):
             pending = {}                  # map_id -> items, out of order
             next_id = 0
             for _ in range(len(locs)):
-                map_id, err, items = results.get()
+                try:
+                    map_id, err, items = results.get(
+                        timeout=conf.SHUFFLE_FETCH_WAIT_S)
+                except Empty:
+                    # every worker is wedged or dead with buckets
+                    # still owed: surface a recoverable fetch failure
+                    # (stage resubmit) instead of parking this reduce
+                    # task forever
+                    err = FetchFailed(None, shuffle_id, next_id,
+                                      reduce_id)
+                    err.__cause__ = TimeoutError(
+                        "no fetch result within %.0fs (%d/%d buckets "
+                        "merged)" % (conf.SHUFFLE_FETCH_WAIT_S,
+                                     next_id, len(locs)))
+                    raise err
                 if err is not None:
                     raise err             # fail fast, order irrelevant
                 pending[map_id] = items

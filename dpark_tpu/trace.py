@@ -98,6 +98,7 @@ import time
 from collections import deque
 
 from dpark_tpu import conf
+from dpark_tpu import locks
 from dpark_tpu import health as _health
 from dpark_tpu import ledger as _ledger
 
@@ -149,7 +150,7 @@ class TracePlane:
         self.dir = trace_dir
         self.ring = deque(maxlen=max(16, int(
             getattr(conf, "TRACE_RING_SPANS", 4096))))
-        self.lock = threading.Lock()
+        self.lock = locks.named_lock("trace.plane")
         self.pid = os.getpid()
         self.host = socket.gethostname()
         # every record is stamped with a run id: job ids restart at 1

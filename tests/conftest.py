@@ -81,6 +81,24 @@ def pctx():
     c.stop()
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lockcheck_grade():
+    """With DPARK_LOCKCHECK=record armed over the whole suite (the CI
+    lockcheck job), fail the RUN if the merged acquisition-order graph
+    drew any cycle — even one whose threads got lucky and never
+    wedged.  Off (the default) this is a no-op."""
+    yield
+    from dpark_tpu import locks
+    san = locks.sanitizer()
+    if san is None:
+        return
+    rep = san.report()
+    if rep["cycles"] or rep["findings"]:
+        raise AssertionError(
+            "lock sanitizer observed ordering hazards across the "
+            "suite:\n%s" % locks.render_report(rep))
+
+
 @pytest.fixture(autouse=True)
 def _fresh_env(tmp_path_factory):
     """Each test gets its own workdir; the env singleton is reset."""

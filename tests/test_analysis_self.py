@@ -24,9 +24,15 @@ def test_baseline_file_is_committed_and_sorted():
     path = os.path.join(REPO, "tools", "dlint_baseline.json")
     assert os.path.exists(path), "tools/dlint_baseline.json missing"
     with open(path) as f:
-        keys = json.load(f)
+        data = json.load(f)
+    # ISSUE 16 format: {key: justification}; the legacy bare list is
+    # still accepted by load_baseline but the committed file carries
+    # a non-empty justification for every accepted finding
+    assert isinstance(data, dict)
+    keys = list(data)
     assert keys == sorted(keys)
     assert all("::" in k for k in keys)
+    assert all(isinstance(v, str) and v.strip() for v in data.values())
 
 
 def test_shipped_examples_have_no_errors(capsys):

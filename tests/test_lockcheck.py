@@ -1,0 +1,452 @@
+"""Concurrency sanitizer plane (ISSUE 16): static rule families,
+dynamic lock-order cycle detection, the PR 3 export-deadlock regression
+shape, off-mode inertness, and record-vs-off parity."""
+
+import ast
+import threading
+
+import pytest
+
+from dpark_tpu import locks
+from dpark_tpu.analysis.concurrency import (ConcurrencyPass,
+                                            check_plane_seam)
+from dpark_tpu.analysis.report import Report
+
+
+def _run_pass(tmp_path, sources):
+    p = ConcurrencyPass(root=str(tmp_path))
+    for name, src in sources.items():
+        f = tmp_path / name
+        f.write_text(src)
+        p.add_source(str(f))
+    rep = Report()
+    p.finish(rep)
+    return rep
+
+
+def _rules(rep, rule):
+    return [f for f in rep if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# static rules on synthetic modules
+# ---------------------------------------------------------------------------
+
+class TestStaticRules:
+    def test_lexical_inversion_is_a_cycle(self, tmp_path):
+        rep = _run_pass(tmp_path, {"m.py": (
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "def f():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with B:\n"
+            "        with A:\n"
+            "            pass\n")})
+        found = _rules(rep, "lock-order-cycle")
+        assert len(found) == 1
+        assert "m.A" in found[0].message and "m.B" in found[0].message
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        rep = _run_pass(tmp_path, {"m.py": (
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "def f():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with A, B:\n"
+            "        pass\n")})
+        assert not _rules(rep, "lock-order-cycle")
+
+    def test_interprocedural_cycle_through_a_call(self, tmp_path):
+        # f: A -> call g (acquires B); h: B -> call k (acquires A)
+        rep = _run_pass(tmp_path, {"m.py": (
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "def g():\n"
+            "    with B:\n"
+            "        pass\n"
+            "def f():\n"
+            "    with A:\n"
+            "        g()\n"
+            "def k():\n"
+            "    with A:\n"
+            "        pass\n"
+            "def h():\n"
+            "    with B:\n"
+            "        k()\n")})
+        assert len(_rules(rep, "lock-order-cycle")) == 1
+
+    def test_named_lock_literal_is_the_node_name(self, tmp_path):
+        # named_lock("x") merges with the DYNAMIC graph's node "x"
+        rep = _run_pass(tmp_path, {"m.py": (
+            "from dpark_tpu import locks\n"
+            "A = locks.named_lock('pool.a')\n"
+            "B = locks.named_lock('pool.b')\n"
+            "def f():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with B:\n"
+            "        with A:\n"
+            "            pass\n")})
+        found = _rules(rep, "lock-order-cycle")
+        assert len(found) == 1
+        assert "pool.a" in found[0].message
+
+    def test_blocking_under_mesh_lock(self, tmp_path):
+        rep = _run_pass(tmp_path, {"m.py": (
+            "M = _MeshLock()\n"
+            "def f(sock):\n"
+            "    with M:\n"
+            "        sock.recv(1024)\n")})
+        found = _rules(rep, "blocking-under-lock")
+        assert len(found) == 1
+        assert "recv" in found[0].message
+
+    def test_blocking_reached_through_a_call(self, tmp_path):
+        rep = _run_pass(tmp_path, {"m.py": (
+            "M = _MeshLock()\n"
+            "def leaf(path):\n"
+            "    return open(path)\n"
+            "def f(path):\n"
+            "    with M:\n"
+            "        leaf(path)\n")})
+        found = _rules(rep, "blocking-under-lock")
+        assert found and "leaf" in found[0].message
+
+    def test_blocking_without_mesh_lock_is_clean(self, tmp_path):
+        rep = _run_pass(tmp_path, {"m.py": (
+            "import threading\n"
+            "L = threading.Lock()\n"
+            "def f(sock):\n"
+            "    with L:\n"
+            "        sock.recv(1024)\n")})
+        assert not _rules(rep, "blocking-under-lock")
+
+    def test_unbounded_wait_shapes(self, tmp_path):
+        rep = _run_pass(tmp_path, {"m.py": (
+            "def f(q, d, cv):\n"
+            "    q.get()\n"                     # flagged
+            "    q.get(timeout=1)\n"            # bounded
+            "    d.get('key')\n"                # dict.get
+            "    cv.wait()\n"                   # flagged
+            "    cv.wait(0.5)\n"                # bounded
+            "    ', '.join(['a'])\n")})         # str.join
+        found = _rules(rep, "unbounded-wait")
+        assert len(found) == 2
+        kinds = sorted(f.message.split(":")[0] for f in found)
+        assert "queue .get() without timeout" in kinds[1]
+        assert ".wait() without timeout" in kinds[0]
+
+    def test_thread_leak(self, tmp_path):
+        rep = _run_pass(tmp_path, {"m.py": (
+            "import threading\n"
+            "def f(work):\n"
+            "    t = threading.Thread(target=work)\n"
+            "    t.start()\n")})
+        assert len(_rules(rep, "thread-leak")) == 1
+
+    def test_daemon_or_joined_thread_is_clean(self, tmp_path):
+        rep = _run_pass(tmp_path, {"m.py": (
+            "import threading\n"
+            "def f(work):\n"
+            "    t = threading.Thread(target=work, daemon=True)\n"
+            "    t.start()\n"
+            "def g(work):\n"
+            "    u = threading.Thread(target=work)\n"
+            "    u.start()\n"
+            "    u.join(timeout=5)\n")})
+        assert not _rules(rep, "thread-leak")
+
+
+# ---------------------------------------------------------------------------
+# plane-contract rule
+# ---------------------------------------------------------------------------
+
+class TestPlaneContract:
+    def test_good_seams_both_forms(self):
+        src = ("_PLANE = None\n"
+               "def direct(x):\n"
+               "    if _PLANE is None:\n"
+               "        return x\n"
+               "    return _PLANE.f(x)\n"
+               "def bound(x):\n"
+               "    plane = _PLANE\n"
+               "    if plane is None:\n"
+               "        return x\n"
+               "    return plane.f(x)\n"
+               "def guarded(x):\n"
+               "    plane = _PLANE\n"
+               "    if plane is not None:\n"
+               "        plane.f(x)\n"
+               "    return x\n")
+        tree = ast.parse(src)
+        for fn in ("direct", "bound", "guarded"):
+            assert check_plane_seam(tree, fn, "_PLANE") is None, fn
+
+    def test_direct_form_may_reload_on_path(self):
+        # the contract is about the OFF path: a second load after the
+        # is-None guard returned runs only with the plane on
+        tree = ast.parse(
+            "_PLANE = None\n"
+            "def f(x):\n"
+            "    if _PLANE is None:\n"
+            "        return x\n"
+            "    return _PLANE.g(x)\n")
+        assert check_plane_seam(tree, "f", "_PLANE") is None
+
+    def test_reload_after_binding_violates(self):
+        tree = ast.parse(
+            "_PLANE = None\n"
+            "def f(x):\n"
+            "    plane = _PLANE\n"
+            "    if plane is None:\n"
+            "        return x\n"
+            "    return _PLANE.g(x)\n")
+        bad = check_plane_seam(tree, "f", "_PLANE")
+        assert bad is not None and "loaded again" in bad[1]
+
+    def test_allocation_on_off_path_violates(self):
+        tree = ast.parse(
+            "_PLANE = None\n"
+            "def f(x):\n"
+            "    plane = _PLANE\n"
+            "    if plane is None:\n"
+            "        return list(x)\n"
+            "    return plane.g(x)\n")
+        bad = check_plane_seam(tree, "f", "_PLANE")
+        assert bad is not None
+
+    def test_escaping_local_violates(self):
+        tree = ast.parse(
+            "_PLANE = None\n"
+            "def f(x):\n"
+            "    plane = _PLANE\n"
+            "    if plane is not None:\n"
+            "        plane.g(x)\n"
+            "    return plane\n")
+        bad = check_plane_seam(tree, "f", "_PLANE")
+        assert bad is not None and "escapes" in bad[1]
+
+    def test_missing_function_is_loud(self):
+        tree = ast.parse("_PLANE = None\n")
+        bad = check_plane_seam(tree, "gone", "_PLANE")
+        assert bad is not None and "not found" in bad[1]
+
+    def test_package_seams_hold_at_head(self):
+        # the real manifest against the real package: faults, trace,
+        # health/ledger subscription points, and locks itself
+        rep = Report()
+        ConcurrencyPass()._check_planes(rep)
+        assert not list(rep), [f.render() for f in rep]
+
+
+# ---------------------------------------------------------------------------
+# dynamic sanitizer
+# ---------------------------------------------------------------------------
+
+def _in_thread(fn):
+    out = []
+
+    def run():
+        try:
+            out.append(fn())
+        except BaseException as e:
+            out.append(e)
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(10)
+    assert out, "worker thread hung"
+    return out[0]
+
+
+class TestDynamicSanitizer:
+    def test_two_lock_inversion_names_the_cycle(self):
+        with locks.scoped("record") as san:
+            a = locks.named_lock("t.a")
+            b = locks.named_lock("t.b")
+            _in_thread(lambda: _ordered(a, b))
+            _in_thread(lambda: _ordered(b, a))
+            cyc = san.cycles()
+            assert len(cyc) == 1
+            assert set(cyc[0]) == {"t.a", "t.b"}
+            assert cyc[0][0] == cyc[0][-1]      # closes on itself
+
+    def test_consistent_order_draws_no_cycle(self):
+        with locks.scoped("record") as san:
+            a = locks.named_lock("t.a")
+            b = locks.named_lock("t.b")
+            for _ in range(3):
+                _in_thread(lambda: _ordered(a, b))
+            assert san.cycles() == []
+            assert san.report()["edges"][0]["count"] == 3
+
+    def test_strict_raises_before_the_wedge(self):
+        with locks.scoped("strict"):
+            a = locks.named_lock("t.a")
+            b = locks.named_lock("t.b")
+            _in_thread(lambda: _ordered(a, b))
+            got = _in_thread(lambda: _ordered(b, a))
+            assert isinstance(got, locks.LockOrderError)
+            assert got.cycle[0] == got.cycle[-1]
+            # the lock itself was NOT left held by the failed acquire
+            assert b.locked() is False
+
+    def test_strict_self_deadlock_on_nonreentrant(self):
+        with locks.scoped("strict"):
+            a = locks.named_lock("t.a")
+
+            def f():
+                with a:
+                    with a:
+                        pass
+            got = _in_thread(f)
+            assert isinstance(got, locks.LockOrderError)
+
+    def test_reentrant_reacquire_is_fine(self):
+        with locks.scoped("strict") as san:
+            a = locks.named_lock("t.a", reentrant=True)
+
+            def f():
+                with a:
+                    with a:
+                        return "ok"
+            assert _in_thread(f) == "ok"
+            assert san.cycles() == []
+
+    def test_order_violation_graded_against_documented(self):
+        with locks.scoped("record") as san:
+            hi = locks.named_lock("executor.shard_build")
+            lo = locks.named_lock("executor.mesh", reentrant=True)
+            _in_thread(lambda: _ordered(hi, lo))
+            v = san.order_violations()
+            assert len(v) == 1
+            assert v[0]["held"] == "executor.shard_build"
+            assert v[0]["acquired"] == "executor.mesh"
+
+    def test_mesh_lock_notes_into_the_registry(self):
+        from dpark_tpu.backend.tpu.executor import _MeshLock
+        with locks.scoped("record") as san:
+            m = _MeshLock()
+
+            def f():
+                with m:
+                    with m:         # reentrant: depth only
+                        pass
+            _in_thread(f)
+            rep = san.report()
+            assert rep["locks"]["executor.mesh"]["count"] == 1
+            assert rep["cycles"] == []
+
+    def test_pr3_export_deadlock_shape_is_named(self):
+        """PR 3's export-bucket wedge: a stage held the mesh lock and
+        entered the export bridge; the serving side held the export
+        lock and needed the mesh — the sanitizer must NAME that cycle
+        from one clean interleaving, no wedge required."""
+        with locks.scoped("record") as san:
+            mesh = locks.named_lock("executor.mesh", reentrant=True)
+            export = locks.named_lock("executor.export")
+
+            def stage_side():       # run stage -> export bucket
+                with mesh:
+                    with export:
+                        pass
+
+            def serving_side():     # serve export -> device read
+                with export:
+                    with mesh:
+                        pass
+            _in_thread(stage_side)
+            _in_thread(serving_side)
+            cyc = san.cycles()
+            assert len(cyc) == 1
+            assert set(cyc[0]) == {"executor.mesh", "executor.export"}
+            text = locks.render_report(san.report())
+            assert "CYCLE" in text and "executor.export" in text
+
+    def test_acquire_release_api_and_trylock(self):
+        with locks.scoped("record") as san:
+            a = locks.named_lock("t.a")
+            b = locks.named_lock("t.b")
+
+            def f():
+                assert a.acquire()
+                assert b.acquire(blocking=False)
+                b.release()
+                a.release()
+            _in_thread(f)
+            assert [e["from"] for e in san.report()["edges"]] == ["t.a"]
+
+
+def _ordered(first, second):
+    with first:
+        with second:
+            pass
+    return "ok"
+
+
+# ---------------------------------------------------------------------------
+# off-mode contract
+# ---------------------------------------------------------------------------
+
+class TestOffMode:
+    def test_off_mode_is_inert(self):
+        with locks.scoped("off"):
+            assert locks.sanitizer() is None
+            assert locks.mode() == "off"
+            a = locks.named_lock("t.a")
+            with a:
+                pass
+            assert locks.cycles() == []
+            assert locks.report() == {"mode": "off"}
+
+    def test_off_mode_never_touches_a_previous_registry(self):
+        san = locks.Sanitizer()
+        with locks.scoped("off"):
+            a = locks.named_lock("t.a")
+            b = locks.named_lock("t.b")
+            _in_thread(lambda: _ordered(a, b))
+            _in_thread(lambda: _ordered(b, a))
+        assert san.acquisitions == 0 and san.edges == {}
+
+    def test_configure_modes(self):
+        with locks.scoped("off"):
+            assert locks.configure("record") is not None
+            assert locks.mode() == "record"
+            assert locks.configure("strict").strict is True
+            assert locks.configure("off") is None
+            with pytest.raises(ValueError):
+                locks.configure("bogus")
+
+
+# ---------------------------------------------------------------------------
+# record-vs-off parity on a real job
+# ---------------------------------------------------------------------------
+
+class TestParity:
+    def test_record_mode_is_bit_identical_on_a_chaos_cell(self, ctx):
+        data = [(chr(97 + i % 7), i) for i in range(200)]
+
+        def run():
+            return sorted(ctx.makeRDD(data, 4)
+                          .reduceByKey(lambda a, b: a + b)
+                          .collect())
+        with locks.scoped("off"):
+            base = run()
+        with locks.scoped("record") as san:
+            checked = run()
+            assert san.cycles() == []
+        assert checked == base
+
+    def test_dlint_locks_clean_at_head(self):
+        from dpark_tpu.analysis.__main__ import main
+        assert main(["--locks"]) == 0

@@ -25,6 +25,7 @@ Tracked ratios (whatever the run emitted):
     service_warm_submit       cold/warm first-wave latency (>= 3)
     health_plane_overhead     sink on/off wall ratio (<= 1.03)
     ledger_plane_overhead     ledger on/off wall ratio (<= 1.03)
+    lockcheck_overhead        sanitizer on/off wall ratio (<= 1.03)
 
 The trajectory is plain JSON lines (one entry per run) so ``git
 diff`` reads it; corrupt lines skip at load.  The diff is
@@ -53,6 +54,7 @@ HEADLINES = {
     "service_warm_submit": ("service_warm_submit", True),
     "health_plane_overhead": ("health_plane_overhead", False),
     "ledger_plane_overhead": ("ledger_plane_overhead", False),
+    "lockcheck_overhead": ("lockcheck_overhead", False),
 }
 
 
