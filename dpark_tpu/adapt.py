@@ -339,19 +339,31 @@ def _apply(rec):
         _agg["site"][key] = health.merge_digests(
             _agg["site"].get(key), rec.get("digest"))
     elif kind == "prog":
-        # static program cost profile (ledger plane, ISSUE 15): flops
-        # / bytes accessed / peak-HBM bytes captured at compile time
-        # keyed by the cross-process-stable plan signature — the
-        # pricing PRIOR items 2/3 read before a program's first
-        # observed run.  Latest capture wins (profiles are a pure
-        # function of the program + shape class, so re-captures
-        # agree; a newer jax may refine the numbers).
+        # program cost profile (ledger plane, ISSUE 15; AOT plane,
+        # ISSUE 17): static flops / bytes / peak-HBM captured at
+        # compile time PLUS the observed compile ms and resolution
+        # hit count the AOT cache's boot warming ranks by, keyed by
+        # the cross-process-stable plan signature.  Field-wise merge:
+        # "hits" accumulates across records (compaction folds the
+        # running total into one line, so reload stays honest),
+        # "compile_ms" smooths by EMA (a noisy box must not own the
+        # ranking), every other field is latest-wins (static profiles
+        # are a pure function of the program + shape class; a newer
+        # jax may refine the numbers).
         prof = rec.get("profile")
         if isinstance(prof, dict):
-            _agg["prog"][key] = {
-                k: (float(v) if isinstance(v, float) else int(v))
-                for k, v in prof.items()
-                if isinstance(v, (int, float))}
+            ent = _agg["prog"].setdefault(key, {})
+            for k, v in prof.items():
+                if not isinstance(v, (int, float)):
+                    continue
+                v = float(v) if isinstance(v, float) else int(v)
+                if k == "hits":
+                    ent[k] = int(ent.get(k, 0)) + int(v)
+                elif k == "compile_ms" and ent.get(k):
+                    ent[k] = round(float(ent[k]) * (1 - _EMA)
+                                   + float(v) * _EMA, 3)
+                else:
+                    ent[k] = v
     elif kind == "pane":
         # per-(stream signature) windowed-emit tick cost by pane
         # strategy ("tree" | "flat" | "inv"): the split-point pricing

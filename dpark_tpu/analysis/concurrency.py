@@ -398,6 +398,10 @@ PLANE_SEAMS = (
     ("locks.py", "_NamedLock.__exit__", "_SANITIZER"),
     ("locks.py", "note_acquire", "_SANITIZER"),
     ("locks.py", "note_release", "_SANITIZER"),
+    ("aotcache.py", "set_current_sig", "_PLANE"),
+    ("aotcache.py", "stats", "_PLANE"),
+    ("backend/tpu/executor.py", "_ProgramCache.__setitem__",
+     "aotcache._PLANE"),
 )
 
 

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from dpark_tpu import conf, faults, locks, trace
+from dpark_tpu import aotcache, conf, faults, locks, trace
 from dpark_tpu.backend.tpu import collectives, fuse, layout
 from dpark_tpu.utils.log import get_logger
 
@@ -472,13 +472,27 @@ class _ProgramCache:
             return self._d[key]     # probe already counted + touched
 
     def __setitem__(self, key, fn):
+        # the AOT plane seam (ISSUE 17): with a plane installed every
+        # inserted program wraps in the lazy two-tier proxy whose
+        # first call consults disk before compiling; off costs this
+        # one `is None` check (plane-contract rule)
+        plane = aotcache._PLANE
+        if plane is not None:
+            fn = plane.wrap(key, fn)
+        evicted = []
         with self._lock:
             self._d[key] = fn
             self._d.move_to_end(key)
             if self.cap:
                 while len(self._d) > max(1, self.cap):
-                    self._d.popitem(last=False)
+                    evicted.append(self._d.popitem(last=False)[1])
                     self.evictions += 1
+        # write-back OUTSIDE the cache lock: serializing an evicted
+        # executable is disk work no concurrent probe should wait on
+        for old in evicted:
+            wb = getattr(old, "writeback", None)
+            if wb is not None:
+                wb()
 
     def __len__(self):
         return len(self._d)
@@ -911,7 +925,10 @@ class JAXExecutor:
         jitted = jax.jit(fn, donate_argnums=tuple(
             range(leaf0, leaf0 + nleaves_in)) if donate else ())
         self._compiled[key] = jitted
-        return jitted
+        # read back through the cache: with the AOT plane on, the
+        # stored value is the two-tier proxy, and EVERY call path must
+        # route through it or the first call double-compiles
+        return self._compiled[key]
 
     def _compile_exchange(self, dtypes, nleaves, slot, cap,
                           narrow=None, donate=False):
@@ -938,7 +955,7 @@ class JAXExecutor:
         jitted = jax.jit(fn, donate_argnums=tuple(
             range(3, 3 + nleaves)) if donate else ())
         self._compiled[key] = jitted
-        return jitted
+        return self._compiled[key]
 
     def _compile_minmax(self, nleaves, cap):
         """(counts, int64 leaves) -> per-device (lo, hi) over each
@@ -965,9 +982,8 @@ class JAXExecutor:
         fn = _shard_map(per_device, self.mesh,
                         in_specs=(P(AXIS),) * (1 + nleaves),
                         out_specs=(P(AXIS),) * nleaves)
-        jitted = jax.jit(fn)
-        self._compiled[key] = jitted
-        return jitted
+        self._compiled[key] = jax.jit(fn)
+        return self._compiled[key]
 
     def _narrow_plan(self, leaves, counts):
         """Per-leaf wire dtype for the exchange (None = keep).
@@ -1071,7 +1087,7 @@ class JAXExecutor:
         jitted = jax.jit(fn, donate_argnums=tuple(
             range(buf0, buf0 + rounds * nleaves)) if donate else ())
         self._compiled[key] = jitted
-        return jitted
+        return self._compiled[key]
 
     def _bounds_arg(self, plan):
         """plan.epi_bounds tiled per device and sharded, or None.
@@ -1105,6 +1121,10 @@ class JAXExecutor:
             # every backend compile inside this stage (narrow,
             # exchange, egest, ...) attributes to the stage's program
             trace.set_compile_sig(sig)
+        if aotcache._PLANE is not None:
+            # programs inserted under this stage carry its adapt
+            # signature into the disk index / warm ranking
+            aotcache.set_current_sig(fuse.plan_adapt_signature(plan))
         with self._mesh_lock, \
                 trace.span("stage.exec", "exec", source=plan.source[0],
                            **extra):
@@ -1192,6 +1212,8 @@ class JAXExecutor:
             # backend compiles fired by the jitted call below
             # attribute to this program (ledger plane, ISSUE 15)
             trace.set_compile_sig(_plan_sig(plan))
+        if aotcache._PLANE is not None:
+            aotcache.set_current_sig(fuse.plan_adapt_signature(plan))
         jitted = self._compile_narrow(
             plan, batch.cap, len(batch.cols),
             tuple(str(c.dtype) for c in batch.cols), donate=donate,
@@ -2152,7 +2174,7 @@ class JAXExecutor:
         jitted = jax.jit(fn, donate_argnums=tuple(
             range(k, k + k * nleaves)) if donate else ())
         self._compiled[key] = jitted
-        return jitted
+        return self._compiled[key]
 
     # ------------------------------------------------------------------
     # out-of-core streaming shuffle (SURVEY.md 7.2 item 4): input bigger
@@ -2580,6 +2602,9 @@ class JAXExecutor:
                 faults.hit("executor.dispatch")   # chaos site: per wave
                 if trace._PLANE is not None:
                     trace.set_compile_sig(_plan_sig(plan))
+                if aotcache._PLANE is not None:
+                    aotcache.set_current_sig(
+                        fuse.plan_adapt_signature(plan))
                 jitted = self._compile_stream_nocombine(
                     plan, batch.cap, len(batch.cols), r,
                     tuple(str(c.dtype) for c in batch.cols),
@@ -3338,8 +3363,14 @@ class JAXExecutor:
     def program_cache_stats(self):
         """Hit/miss/evict counters of the bounded compiled-program
         cache (ISSUE 9): /metrics, the web UI per-job cache column,
-        and the warm-submit bench read these."""
-        return self._compiled.stats()
+        and the warm-submit bench read these.  With the AOT plane
+        installed (ISSUE 17) the disk tier's load/store/warm counters
+        ride along under "aot"."""
+        out = self._compiled.stats()
+        aot = aotcache.stats()
+        if aot is not None:
+            out["aot"] = aot
+        return out
 
     def _export_bucket(self, sid, map_id, reduce_id):
         store = self.shuffle_store.get(sid)

@@ -186,6 +186,28 @@ SERVICE_WEIGHT = int(os.environ.get("DPARK_SERVICE_WEIGHT", "1") or 1)
 PROGRAM_CACHE_MAX = int(os.environ.get("DPARK_PROGRAM_CACHE_MAX",
                                        "512") or 0)
 
+# persistent AOT executable cache (ISSUE 17): off | read | on.
+# "off" (the default) costs one `is None` check at the program-cache
+# seam and is bit-identical to any cached run; "read" loads serialized
+# executables from DPARK_AOT_CACHE_DIR but never writes (a replica
+# trusting a cache it does not own); "on" additionally stores newly
+# compiled programs — tmp+rename entries plus an O_APPEND index, so
+# one directory is safely shared across replicas and concurrent
+# writers.  Corrupt / truncated / version-mismatched entries skip
+# silently and fall back to compile (the adapt-store contract).
+AOT_CACHE = os.environ.get("DPARK_AOT_CACHE", "off")
+
+# where serialized executables live (delete the directory to reset)
+AOT_CACHE_DIR = os.environ.get(
+    "DPARK_AOT_CACHE_DIR", os.path.join(DPARK_WORK_DIR, "aotcache"))
+
+# boot-warming deadline: a starting JobServer spends at most this many
+# milliseconds deserializing the hottest programs (ranked by observed
+# compile ms x hit count from the adapt store) before serving.  0
+# disables warming without disabling the cache.
+AOT_WARM_BUDGET_MS = float(os.environ.get(
+    "DPARK_AOT_WARM_BUDGET_MS", "2000") or 0)
+
 # dcn transient-connect retry: total attempts (1 = no retry) and the
 # base backoff seconds (exponential with full jitter: attempt k sleeps
 # uniform in [base*2^k/2, base*2^k]).  Application-level ServerError

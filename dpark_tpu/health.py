@@ -741,6 +741,14 @@ def api_health(scheduler=None):
     }
     if tenants is not None:
         out["tenants"] = tenants
+    try:
+        # AOT executable-cache counters (ISSUE 17) for the UI topline
+        from dpark_tpu import aotcache
+        aot = aotcache.stats()
+        if aot is not None:
+            out["aot"] = aot
+    except Exception:
+        pass
     if s is not None:
         with s.lock:
             out["stage_fetch"] = {

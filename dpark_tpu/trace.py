@@ -76,6 +76,12 @@ Span taxonomy (name / cat):
                                        decode counters (the merge
                                        substrate, see
                                        merged_worker_counters)
+    aot.load, aot.store,     "aot"     persistent AOT executable
+    aot.warm                           cache (ISSUE 17): disk-tier
+                                       load/serialize per program and
+                                       the boot-warm deserializations
+                                       (warm passes run under the
+                                       __boot__ pseudo-tenant ctx)
 
 Records are flat dicts: name, cat, ts (epoch seconds), dur (seconds),
 pid, host, tid, optional job/stage/task ints, optional args.  The

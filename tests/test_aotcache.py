@@ -1,0 +1,435 @@
+"""Persistent AOT executable cache (ISSUE 17): the disk tier under
+the executor's bounded program cache.
+
+The suite proves the contracts the instant-on design makes:
+
+* HYGIENE — corrupt, truncated, or version-drifted entries NEVER
+  error and never feed a stale executable: every defect is a silent
+  miss and the caller compiles (the adapt-store contract).
+* SHARING — two concurrent writer processes on one cache directory
+  interleave safely: whole entries or no entry (tmp+rename), torn
+  index lines skip, contested keys resolve latest-wins.
+* WRITE-BACK — eviction under DPARK_PROGRAM_CACHE_MAX persists a
+  resolved-but-unstored executable before the memory tier drops it.
+* PARITY — off/read/on produce bit-identical results on a chaos
+  (injected fetch-fault) job; the modes differ only in counters.
+* WARMING — boot warming ranks the index by the adapt store's
+  observed compile-cost profiles and preloads under a deadline; the
+  first proxy resolution consumes the preload instead of the disk.
+
+Device tests run on a 2-device sliced mesh ("tpu:2") so the suite
+works on small containers.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dpark_tpu import Columns, adapt, aotcache, conf, service
+from dpark_tpu.utils import frame_jsonl, unframe_jsonl
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planes(tmp_path):
+    """Every test gets its own adapt store, no installed AOT plane to
+    start, and restored conf knobs; no process-global server leaks."""
+    old_budget = conf.AOT_WARM_BUDGET_MS
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "adapt"))
+    aotcache.configure(mode="off")
+    yield
+    conf.AOT_WARM_BUDGET_MS = old_budget
+    aotcache.configure(mode="off")
+    adapt.configure()
+    service.shutdown()
+
+
+def _plane(tmp_path, mode="on"):
+    return aotcache.configure(mode=mode,
+                              cache_dir=str(tmp_path / "cache"))
+
+
+def _mul(m):
+    return jax.jit(lambda x, _m=m: x * _m)
+
+
+def _compiled(m):
+    x = jnp.arange(8, dtype=jnp.int32)
+    return _mul(m).lower(x).compile(), x
+
+
+def _add(a, b):
+    return a + b
+
+
+# ---------------------------------------------------------------------------
+# modes and the off-mode seam
+# ---------------------------------------------------------------------------
+
+def test_mode_grammar(tmp_path):
+    assert aotcache.configure(mode="off") is None
+    assert not aotcache.active() and aotcache.plane() is None
+    p = _plane(tmp_path, "read")
+    assert p.mode == "read" and aotcache.active()
+    with pytest.raises(ValueError):
+        aotcache.configure(mode="sometimes")
+
+
+def test_off_seams_are_inert():
+    aotcache.configure(mode="off")
+    assert aotcache.stats() is None
+    # the sig stamp must be a no-op, not a crash, with no plane
+    assert aotcache.set_current_sig(("p", "s")) is None
+
+
+# ---------------------------------------------------------------------------
+# store/load round trip and defect hygiene
+# ---------------------------------------------------------------------------
+
+def test_store_load_round_trip(tmp_path):
+    plane = _plane(tmp_path)
+    exe, x = _compiled(3)
+    dk = plane.disk_key(("narrow", "k1"))
+    assert plane.store(dk, exe, sig="p1|s0", compile_ms=12.5)
+    got = plane.load(dk)
+    assert got is not None
+    np.testing.assert_array_equal(np.asarray(got(x)),
+                                  np.asarray(x) * 3)
+    st = plane.stats()
+    assert st["stores"] == 1 and st["loads"] == 1
+    assert st["load_misses"] == 0 and st["load_errors"] == 0
+    idx = plane.index()
+    assert idx[dk]["sig"] == "p1|s0" and idx[dk]["nbytes"] > 0
+
+
+def test_missing_entry_is_a_miss_not_an_error(tmp_path):
+    plane = _plane(tmp_path)
+    assert plane.load(plane.disk_key(("narrow", "ghost"))) is None
+    st = plane.stats()
+    assert st["load_misses"] == 1 and st["load_errors"] == 0
+
+
+@pytest.mark.parametrize("defect", ["flip", "truncate", "garbage"])
+def test_corrupt_entries_fall_back_silently(tmp_path, defect):
+    plane = _plane(tmp_path)
+    exe, _ = _compiled(5)
+    dk = plane.disk_key(("narrow", "kc"))
+    assert plane.store(dk, exe)
+    path = plane._entry_path(dk)
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    if defect == "flip":
+        raw[-1] ^= 0xFF                       # payload bit rot
+    elif defect == "truncate":
+        raw = raw[:len(raw) // 2]             # torn write
+    else:
+        raw = bytearray(b"not an entry at all\n")
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+    assert plane.load(dk) is None
+    st = plane.stats()
+    assert st["load_errors"] == 1 and st["load_misses"] == 1
+
+
+def test_version_drift_skips_entry(tmp_path):
+    plane = _plane(tmp_path)
+    exe, _ = _compiled(4)
+    dk = plane.disk_key(("narrow", "kv"))
+    assert plane.store(dk, exe)
+    path = plane._entry_path(dk)
+    with open(path, "rb") as f:
+        raw = f.read()
+    head, _, rest = raw.partition(b"\n")
+    recs, skipped = unframe_jsonl(head + b"\n")
+    assert recs and not skipped
+    header = recs[0]
+    header["jax"] = "0.0.0-somebody-elses-build"
+    # re-frame so the line crc still passes: the SKIP must come from
+    # the version check, not from corruption hygiene
+    with open(path, "wb") as f:
+        f.write(frame_jsonl(header) + rest)
+    assert plane.load(dk) is None
+    st = plane.stats()
+    assert st["version_skips"] == 1 and st["load_errors"] == 0
+
+
+def test_proxy_recompiles_through_corruption(tmp_path):
+    """End to end: a corrupt entry means the proxy compiles fresh and
+    re-stores a good one — correctness never depends on the disk."""
+    plane = _plane(tmp_path)
+    x = jnp.arange(6, dtype=jnp.int32)
+    prog = plane.wrap(("narrow", "kp"), _mul(7))
+    np.testing.assert_array_equal(np.asarray(prog(x)),
+                                  np.asarray(x) * 7)
+    assert plane.stats()["stores"] == 1
+    dk = plane.disk_key(("narrow", "kp"))
+    with open(plane._entry_path(dk), "wb") as f:
+        f.write(b"rotten\n")
+    # a fresh plane (fresh process stand-in) sharing the dir
+    plane2 = aotcache.configure(mode="on", cache_dir=plane.dir)
+    prog2 = plane2.wrap(("narrow", "kp"), _mul(7))
+    np.testing.assert_array_equal(np.asarray(prog2(x)),
+                                  np.asarray(x) * 7)
+    st = plane2.stats()
+    assert st["load_errors"] == 1 and st["stores"] == 1
+    # and the re-store healed the entry for the NEXT process
+    plane3 = aotcache.configure(mode="read", cache_dir=plane.dir)
+    assert plane3.load(dk) is not None
+
+
+def test_read_mode_loads_but_never_writes(tmp_path):
+    writer = _plane(tmp_path, "on")
+    exe, x = _compiled(6)
+    dk = writer.disk_key(("narrow", "kr"))
+    assert writer.store(dk, exe)
+    before = sorted(os.listdir(writer.dir))
+    reader = aotcache.configure(mode="read", cache_dir=writer.dir)
+    got = reader.load(dk)
+    np.testing.assert_array_equal(np.asarray(got(x)),
+                                  np.asarray(x) * 6)
+    assert not reader.store(reader.disk_key(("narrow", "new")), exe)
+    # and the whole-job path: a read-mode proxy with no entry falls
+    # through to the live jit without writing anything
+    prog = reader.wrap(("narrow", "absent"), _mul(2))
+    np.testing.assert_array_equal(np.asarray(prog(x)),
+                                  np.asarray(x) * 2)
+    assert sorted(os.listdir(writer.dir)) == before
+    st = reader.stats()
+    assert st["loads"] == 1 and st["stores"] == 0
+
+
+# ---------------------------------------------------------------------------
+# eviction write-back under DPARK_PROGRAM_CACHE_MAX
+# ---------------------------------------------------------------------------
+
+def test_eviction_writes_back_before_dropping(tmp_path, monkeypatch):
+    """A resolved executable whose initial store failed transiently
+    (serialize hiccup) must persist on eviction, so a later re-insert
+    loads instead of compiling."""
+    from jax.experimental import serialize_executable as se
+    from dpark_tpu.backend.tpu.executor import _ProgramCache
+    plane = _plane(tmp_path)
+    real = se.serialize
+    calls = []
+
+    def flaky(compiled):
+        calls.append(1)
+        if len(calls) == 1:
+            raise ValueError("injected: serialize unavailable")
+        return real(compiled)
+
+    monkeypatch.setattr(se, "serialize", flaky)
+    x = jnp.arange(4, dtype=jnp.int32)
+    pc = _ProgramCache(cap=1)
+    pc[("narrow", "a")] = _mul(2)
+    np.testing.assert_array_equal(np.asarray(pc[("narrow", "a")](x)),
+                                  np.asarray(x) * 2)
+    st = plane.stats()
+    assert st["store_errors"] == 1 and st["stores"] == 0
+    pc[("narrow", "b")] = _mul(3)          # cap=1: evicts "a"
+    st = plane.stats()
+    assert st["stores"] == 1 and st["evict_writebacks"] == 1
+    # the written-back entry round-trips in a fresh plane
+    plane2 = aotcache.configure(mode="read", cache_dir=plane.dir)
+    got = plane2.load(plane2.disk_key(("narrow", "a")))
+    np.testing.assert_array_equal(np.asarray(got(x)),
+                                  np.asarray(x) * 2)
+
+
+def test_already_stored_proxy_does_not_write_back(tmp_path):
+    from dpark_tpu.backend.tpu.executor import _ProgramCache
+    plane = _plane(tmp_path)
+    x = jnp.arange(4, dtype=jnp.int32)
+    pc = _ProgramCache(cap=1)
+    pc[("narrow", "a")] = _mul(2)
+    pc[("narrow", "a")](x)                 # resolves AND stores
+    pc[("narrow", "b")] = _mul(3)          # evicts "a"
+    st = plane.stats()
+    assert st["stores"] == 1 and st["evict_writebacks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# concurrent writer processes sharing one cache directory
+# ---------------------------------------------------------------------------
+
+_WRITER = r"""
+import sys
+import jax
+
+# match the spawning test process's x64 flag (executor construction
+# flips it suite-wide): the version key covers x64, so a mismatched
+# child would write entries the parent rightly refuses to load
+jax.config.update("jax_enable_x64", bool(int(sys.argv[3])))
+import jax.numpy as jnp
+from dpark_tpu import aotcache
+
+plane = aotcache.configure(mode="on", cache_dir=sys.argv[1])
+mul = int(sys.argv[2])
+x = jnp.arange(8, dtype=jnp.int32)
+for i in range(2):
+    fn = jax.jit(lambda v, _m=mul + i: v * _m)
+    exe = fn.lower(x).compile()
+    dk = plane.disk_key(("narrow", "own-%d-%d" % (mul, i)))
+    assert plane.store(dk, exe, sig="p%d-%d|s0" % (mul, i),
+                       compile_ms=1.0), (mul, i)
+fn = jax.jit(lambda v, _m=mul * 100: v * _m)
+exe = fn.lower(x).compile()
+assert plane.store(plane.disk_key(("narrow", "contested")), exe,
+                   sig="contested|s0", compile_ms=1.0)
+st = plane.stats()
+assert st["stores"] == 3 and st["store_errors"] == 0, st
+print("WRITER_OK")
+"""
+
+
+def test_two_process_writers_share_one_dir(tmp_path):
+    cache = str(tmp_path / "cache")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    x64 = str(int(bool(jax.config.jax_enable_x64)))
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WRITER, cache, str(m), x64],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for m in (2, 9)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0 and "WRITER_OK" in out, out[-1500:]
+    plane = aotcache.configure(mode="read", cache_dir=cache)
+    idx = plane.index()
+    # 2 own keys per writer + the contested key, all whole
+    assert len(idx) == 5, sorted(idx)
+    x = jnp.arange(8, dtype=jnp.int32)
+    muls = []
+    for dk in idx:
+        exe = plane.load(dk)
+        assert exe is not None, dk
+        out = np.asarray(exe(x))
+        assert out[0] == 0 and out[1] % 1 == 0
+        muls.append(int(out[1]))
+    # the contested entry is EXACTLY one writer's whole executable
+    # (tmp+rename: no interleaved torn file), latest index line wins
+    contested = plane.disk_key(("narrow", "contested"))
+    got = int(np.asarray(plane.load(contested)(x))[1])
+    assert got in (200, 900), got
+    assert sorted(muls) == sorted([2, 3, 9, 10, got])
+    st = plane.stats()
+    assert st["load_errors"] == 0 and st["version_skips"] == 0
+
+
+# ---------------------------------------------------------------------------
+# boot warming: ledger-ranked, deadline-bounded, preload-consumed
+# ---------------------------------------------------------------------------
+
+def _seed_entries(plane, sigs, stored_ms=1.0):
+    dks = {}
+    for j, sig in enumerate(sigs):
+        exe, _ = _compiled(j + 2)
+        dk = plane.disk_key(("narrow", "seed", sig))
+        assert plane.store(dk, exe, sig=sig, compile_ms=stored_ms)
+        dks[sig] = dk
+    return dks
+
+
+def test_ranked_entries_order_by_observed_cost(tmp_path):
+    plane = _plane(tmp_path)
+    _seed_entries(plane, ["A|s", "B|s", "C|s"])
+    # observed cost = compile ms x hits from the adapt store
+    adapt.record_program_cost("A|s", {"hits": 10,
+                                      "compile_ms": 100.0})
+    adapt.record_program_cost("B|s", {"hits": 1,
+                                      "compile_ms": 500.0})
+    order = [r["sig"] for r in plane.ranked_entries()]
+    assert order == ["A|s", "B|s", "C|s"]
+
+
+def test_ranked_entries_tie_break_on_stored_compile_ms(tmp_path):
+    plane = _plane(tmp_path)
+    exe, _ = _compiled(2)
+    fast = plane.disk_key(("narrow", "fast"))
+    slow = plane.disk_key(("narrow", "slow"))
+    assert plane.store(fast, exe, sig="F|s", compile_ms=2.0)
+    assert plane.store(slow, exe, sig="S|s", compile_ms=9.0)
+    # neither profiled: the storing process's measured compile ms
+    # breaks the tie, hottest first
+    order = [r["sig"] for r in plane.ranked_entries(costs={})]
+    assert order == ["S|s", "F|s"]
+
+
+def test_warm_respects_deadline_and_preloads(tmp_path):
+    plane = _plane(tmp_path)
+    _seed_entries(plane, ["W|s"])
+    assert plane.warm(budget_ms=0)["warmed"] == 0   # spent budget
+    summary = plane.warm(budget_ms=5000)
+    assert summary["warmed"] == 1 and summary["entries"] == 1
+    st = plane.stats()
+    assert st["warmed"] == 1 and st["warm_pending"] == 1
+    # the first proxy resolution consumes the preload — no disk read,
+    # no compile
+    x = jnp.arange(8, dtype=jnp.int32)
+    prog = plane.wrap(("narrow", "seed", "W|s"), _mul(2))
+    np.testing.assert_array_equal(np.asarray(prog(x)),
+                                  np.asarray(x) * 2)
+    st = plane.stats()
+    assert st["warm_hits"] == 1 and st["warm_pending"] == 0
+    assert st["loads"] == 0 and st["stores"] == 1
+
+
+def test_service_boot_warm_reports(tmp_path):
+    """A starting JobServer warms the cache dir under the conf budget
+    and reports the summary through service_stats (billed to the
+    __boot__ pseudo-tenant in traces)."""
+    from dpark_tpu import DparkContext
+    plane = _plane(tmp_path)
+    _seed_entries(plane, ["A|s", "B|s"])
+    adapt.record_program_cost("A|s", {"hits": 5, "compile_ms": 50.0})
+    conf.AOT_WARM_BUDGET_MS = 5000.0
+    c = DparkContext("service:tpu:2")
+    c.start()
+    try:
+        warm = c.scheduler.service_stats().get("aot_warm")
+        assert warm and warm["warmed"] == 2 and warm["entries"] == 2
+        assert plane.stats()["warmed"] == 2
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# off/read/on chaos-matrix parity
+# ---------------------------------------------------------------------------
+
+def test_off_read_on_chaos_matrix_parity(tmp_path):
+    """The same fetch-fault chaos job under every mode — plus a second
+    `on` pass whose fresh executor resolves off disk — must agree
+    bit-for-bit; only the counters may differ."""
+    from dpark_tpu import DparkContext, faults
+    cache = str(tmp_path / "cache")
+    n = 4000
+    i = np.arange(n, dtype=np.int64)
+    data = Columns(i % 97, i)
+    results, stats = {}, {}
+    for run, mode in (("off", "off"), ("read", "read"),
+                      ("on", "on"), ("on-warmdisk", "on")):
+        aotcache.configure(mode=mode, cache_dir=cache)
+        faults.configure("shuffle.fetch:p=0.2,seed=7,times=4")
+        c = DparkContext("tpu:2")
+        c.start()
+        try:
+            results[run] = sorted(
+                c.parallelize(data, 2).reduceByKey(_add, 2).collect())
+        finally:
+            c.stop()
+            faults.configure(None)
+        stats[run] = aotcache.stats()
+    assert results["off"] == results["read"] == results["on"] \
+        == results["on-warmdisk"]
+    assert stats["off"] is None
+    assert stats["read"]["stores"] == 0
+    assert stats["on"]["stores"] > 0
+    # the second on-run's fresh executor memory-misses everything and
+    # must find it all on disk
+    assert stats["on-warmdisk"]["loads"] > 0
+    assert stats["on-warmdisk"]["load_errors"] == 0

@@ -52,6 +52,7 @@ HEADLINES = {
     "coded_shuffle_overhead": ("coded_overhead", False),
     "adapt_warm_vs_cold": ("adapt_warm_vs_cold", False),
     "service_warm_submit": ("service_warm_submit", True),
+    "aot_restart": ("aot_restart", True),
     "health_plane_overhead": ("health_plane_overhead", False),
     "ledger_plane_overhead": ("ledger_plane_overhead", False),
     "lockcheck_overhead": ("lockcheck_overhead", False),

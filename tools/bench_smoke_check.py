@@ -422,6 +422,46 @@ def main():
                 print("FAIL: tenant %r slo missing %r (got %r)"
                       % (tenant, field, sorted(t)))
                 return 1
+    # ISSUE 17: the AOT restart A/B line must be present — the warm
+    # PROCESS (fresh interpreter against the cache dir the cold
+    # process populated) must report 0 backend compiles with every
+    # executable loaded off disk, and the two processes must agree on
+    # the answer.  The wall ratio itself is not graded here (CI boxes
+    # are too noisy; BENCH_*.json records the honest number).
+    ar = [p for p in parsed
+          if str(p.get("metric", "")).startswith("aot_restart")]
+    if not ar:
+        print("FAIL: no aot_restart line")
+        return 1
+    for side in ("cold", "warm"):
+        d = ar[0].get(side)
+        if not isinstance(d, dict) or "wall_s" not in d \
+                or "backend_compiles" not in d \
+                or not isinstance(d.get("aot"), dict):
+            print("FAIL: aot %s side missing wall_s/backend_compiles/"
+                  "aot: %r" % (side, d))
+            return 1
+    if not ar[0]["parity"]:
+        print("FAIL: cold and warm AOT processes disagreed on the "
+              "answer: %r" % ar[0])
+        return 1
+    if ar[0]["warm"]["backend_compiles"] != 0:
+        print("FAIL: warm AOT process ran %r backend compiles "
+              "(expected 0 — every executable should deserialize off "
+              "disk): %r" % (ar[0]["warm"]["backend_compiles"], ar[0]))
+        return 1
+    if not ar[0]["cold"]["backend_compiles"]:
+        print("FAIL: cold AOT process compiled nothing — the A/B "
+              "measured a pre-warmed cache dir: %r" % ar[0])
+        return 1
+    if not ar[0]["cold"]["aot"].get("stores"):
+        print("FAIL: cold AOT process stored no executables: %r"
+              % ar[0])
+        return 1
+    if not ar[0]["warm"]["aot"].get("loads"):
+        print("FAIL: warm AOT process loaded no executables off "
+              "disk: %r" % ar[0])
+        return 1
     # ISSUE 4 satellite: the segmented-apply A/B line must be present
     # with its schema (the ratio itself is not graded here — CI boxes
     # are too noisy — but the device side must have ridden the array
