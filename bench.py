@@ -1114,6 +1114,168 @@ def _aot_phase():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _reuse_data(d, n):
+    """Deterministic tabular part file for the reuse cells (written
+    once per dir — the restart step's two processes must fingerprint
+    identically)."""
+    import numpy as np
+    from dpark_tpu.tabular import write_tabular
+    part = os.path.join(d, "part-00000.tab")
+    if os.path.exists(part):
+        return part
+    os.makedirs(d, exist_ok=True)
+    i = np.arange(n, dtype=np.int64)
+    write_tabular(part, ["t", "k", "f"],
+                  zip(i.tolist(), ((i * 2654435761) % 997).tolist(),
+                      ((i % 1000) * 0.25).tolist()),
+                  chunk_rows=1 << 14)
+    return part
+
+
+def _reuse_checksum(rows):
+    import zlib
+    return zlib.crc32(repr(rows).encode("utf-8")) & 0xFFFFFFFF
+
+
+def _reuse_scan(pq):
+    """JSON-safe scan_stats (columns_read is a set)."""
+    return {k: (sorted(v) if isinstance(v, set) else v)
+            for k, v in (pq.scan_stats if pq is not None else {})
+            .items()}
+
+
+def _reuse_phase():
+    """Child entry: shared-computation plane A/B (ISSUE 18
+    acceptance).  Cell 1 — two named tenants run the IDENTICAL
+    ctx.sql group-by: tenant-a pays the scan + device exchange and
+    populates the cache; tenant-b's run must plan into a full cache
+    hit (zero scan chunks, ledger-proven: no device-seconds, a
+    resultcache hit billed to tenant-b).  Cell 2 — partial-aggregate
+    reuse: a cached aggregate over 95% of the rows serves a wider
+    query through a residual scan of the remaining 5%, beating the
+    cold run while staying bit-identical to the plane-off answer."""
+    import tempfile
+
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import ledger, resultcache, trace
+    from dpark_tpu import service as service_mod
+    n = int(os.environ.get("BENCH_REUSE_ROWS", "200000"))
+    mode = os.environ.get("BENCH_REUSE_CACHE", "mem")
+    d = tempfile.mkdtemp(prefix="bench_reuse_")
+    _reuse_data(d, n)
+    trace.configure("ring")
+    ledger.configure("on")
+    resultcache.configure(mode)
+    server = service_mod.get_server("local")
+    server.start()
+    ctx_a = service_mod._context_for(server, "tenant-a")
+    ctx_b = service_mod._context_for(server, "tenant-b")
+    sql = ("select k, sum(f) as s, count(t) as c from events "
+           "where t >= 1000 group by k")
+
+    def run_sql(ctx):
+        t = ctx.tabular(d, ["t", "k", "f"]).asTable("events")
+        q = ctx.sql(sql, events=t)
+        t0 = time.perf_counter()
+        rows = sorted(q.collect())
+        return time.perf_counter() - t0, rows, q
+
+    t_cold, rows_a, qa = run_sql(ctx_a)
+    t_warm, rows_b, qb = run_sql(ctx_b)
+    st = resultcache.stats() or {}
+    pq_b = qb._planned()
+    scan_warm = _reuse_scan(pq_b)
+    pq_a = qa._planned()
+    scan_cold = _reuse_scan(pq_a)
+    # ledger proof BEFORE the partial cell muddies tenant-b: the
+    # served tenant must show a resultcache hit and NO device time
+    tenants = ledger.tenant_totals()
+    reuse_cell = {
+        "t_cold_s": round(t_cold, 4), "t_warm_s": round(t_warm, 4),
+        "speedup": round(t_cold / max(t_warm, 1e-9), 2),
+        "parity": bool(rows_a == rows_b),
+        "scan_cold": scan_cold, "scan_warm": scan_warm,
+        "hits": st.get("hits", 0), "stores": st.get("stores", 0),
+        "tenant_b": tenants.get("tenant-b", {}),
+        "tenant_a_device_s": tenants.get("tenant-a", {})
+        .get("device_seconds", 0.0)}
+
+    # cell 2: partial-aggregate reuse.  Fresh plane so the cell
+    # stands alone; the cached entry covers t >= n/20 (95% of rows),
+    # the reuse query wants everything — the probe merges the cached
+    # aggregate with a residual scan of t <= n/20-1 (chunk-skipped
+    # to ~5% of the file).
+    resultcache.configure(mode)
+    lo = n // 20
+
+    def run_where(ctx, where):
+        q = ctx.tabular(d, ["t", "k", "f"]).asTable("events") \
+            .where(where).groupBy("k", "sum(f) as s", "count(t) as c")
+        t0 = time.perf_counter()
+        rows = sorted(q.collect())
+        return time.perf_counter() - t0, rows, q
+
+    t_pcold, _, _ = run_where(ctx_a, "t >= %d" % lo)
+    t_preuse, rows_part, qp = run_where(ctx_b, "t >= 0")
+    stp = resultcache.stats() or {}
+    pq_p = qp._planned()
+    scan_part = _reuse_scan(pq_p)
+    resultcache.configure("off")
+    _, rows_off, _ = run_where(ctx_b, "t >= 0")
+    partial_cell = {
+        "t_cold_s": round(t_pcold, 4),
+        "t_reuse_s": round(t_preuse, 4),
+        "speedup": round(t_pcold / max(t_preuse, 1e-9), 2),
+        "parity": bool(rows_part == rows_off),
+        "partial_hits": stp.get("partial_hits", 0),
+        "scan_reuse": scan_part}
+
+    out = {"mode": mode, "rows": n, "reuse": reuse_cell,
+           "partial": partial_cell,
+           "conservation": ledger.conservation()}
+    trace.configure("off")
+    service_mod.shutdown()
+    print("REUSE_RESULT %s" % json.dumps(out), flush=True)
+
+
+def _reuse_step_phase():
+    """Grandchild entry for the disk-tier restart smoke: ONE fresh
+    process running the reuse query against whatever
+    DPARK_RESULT_CACHE_DIR already holds (DPARK_RESULT_CACHE=disk in
+    the env).  The first run scans and stores; a second process must
+    boot the entry back and serve it with zero scan chunks and a
+    bit-identical checksum."""
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    from dpark_tpu import resultcache
+    from dpark_tpu import service as service_mod
+    n = int(os.environ.get("BENCH_REUSE_ROWS", "200000"))
+    d = os.environ["DPARK_REUSE_DATA"]
+    _reuse_data(d, n)
+    server = service_mod.get_server("local")
+    server.start()          # disk mode: boots hot entries to memory
+    ctx = service_mod._context_for(server, "tenant-restart")
+    t0 = time.perf_counter()
+    q = ctx.tabular(d, ["t", "k", "f"]).asTable("events") \
+        .where("t >= 1000").groupBy("k", "sum(f) as s",
+                                    "count(t) as c")
+    rows = sorted(q.collect())
+    wall = time.perf_counter() - t0
+    pq = q._planned()
+    st = resultcache.stats() or {}
+    out = {"wall_s": round(wall, 4), "groups": len(rows),
+           "checksum": _reuse_checksum(rows),
+           "scan": _reuse_scan(pq),
+           "hits": st.get("hits", 0), "stores": st.get("stores", 0),
+           "preloaded": st.get("preloaded", 0),
+           "boot": getattr(server, "_rc_boot", None)}
+    service_mod.shutdown()
+    print("REUSE_STEP %s" % json.dumps(out), flush=True)
+
+
 def _health_phase():
     """Child-process entry: health-plane overhead A/B (ISSUE 14
     acceptance).  The same ring-traced device reduceByKey with the
@@ -1407,6 +1569,12 @@ def main():
         return
     if "--aot-step" in sys.argv:
         _aot_step_phase()
+        return
+    if "--reuse-only" in sys.argv:
+        _reuse_phase()
+        return
+    if "--reuse-step" in sys.argv:
+        _reuse_step_phase()
         return
     if "--health-only" in sys.argv:
         _health_phase()
@@ -1713,6 +1881,26 @@ def main():
             if emulated:
                 rst["emulated_cpu_mesh"] = True
             print(json.dumps(rst))
+    # shared-computation reuse A/B (ISSUE 18 acceptance): tenant-b's
+    # identical ctx.sql query must plan into a full result-cache hit
+    # (zero scan chunks, ledger-proven: no device-seconds, the hit
+    # billed to tenant-b), and the partial-aggregate cell must beat
+    # its cold run while staying bit-identical to the uncached plan
+    if os.environ.get("BENCH_REUSE", "1") != "0":
+        got = _run_child("--reuse-only", child_timeout,
+                         env=extra_env, ok_prefix="REUSE_RESULT ")
+        if got is not None:
+            ru = json.loads(got)
+            rout = {"metric": _suffix("result_reuse"),
+                    "value": round(ru["reuse"]["speedup"], 2),
+                    "unit": ("x repeated-query wall (higher is "
+                             "better; >=5 passes, zero scan chunks "
+                             "on the hit)"),
+                    "reuse": ru["reuse"], "partial": ru["partial"],
+                    "mode": ru["mode"], "rows": ru["rows"]}
+            if emulated:
+                rout["emulated_cpu_mesh"] = True
+            print(json.dumps(rout))
     # health-plane overhead A/B (ISSUE 14 acceptance): the same
     # ring-traced job with the streaming sketch sink off vs on —
     # folding every span must cost <= 3% wall, with nonzero site

@@ -73,7 +73,7 @@ _mode = None                  # resolved mode, or None = read conf lazily
 _dir = None                   # resolved store dir, or None = read conf
 _loaded = False
 _agg = {"wave_budget": {}, "stage": {}, "skew": {}, "combine": {},
-        "pane": {}, "site": {}, "prog": {}}
+        "pane": {}, "site": {}, "prog": {}, "reuse": {}}
 _counters = {"store_hits": 0, "store_misses": 0, "steered": 0,
              "recorded": 0, "skipped_lines": 0}
 _decisions = []
@@ -256,6 +256,8 @@ def _compact_locked(path):
         recs.append({"k": "site", "key": key, "digest": dict(ent)})
     for key, ent in _agg["prog"].items():
         recs.append({"k": "prog", "key": key, "profile": dict(ent)})
+    for key, ent in _agg["reuse"].items():
+        recs.append(dict(ent, k="reuse", key=key))
     try:
         from dpark_tpu.utils import frame_jsonl
         tmp = path + ".compact.%d" % os.getpid()
@@ -364,6 +366,16 @@ def _apply(rec):
                                    + float(v) * _EMA, 3)
                 else:
                     ent[k] = v
+    elif kind == "reuse":
+        # result-cache hit-rate profile (ISSUE 18), keyed by the
+        # cache entry key: pure running counts (compaction folds them
+        # into one line, so reload stays honest).  The disk tier's
+        # boot preload ranks entries by these hits — the same
+        # observed-demand ranking the AOT warming uses.
+        ent = _agg["reuse"].setdefault(
+            key, {"hits": 0, "misses": 0, "partials": 0})
+        for k in ("hits", "misses", "partials"):
+            ent[k] = int(ent.get(k, 0)) + int(rec.get(k, 0) or 0)
     elif kind == "pane":
         # per-(stream signature) windowed-emit tick cost by pane
         # strategy ("tree" | "flat" | "inv"): the split-point pricing
@@ -923,6 +935,35 @@ def program_costs():
         _ensure_loaded()
         with _lock:
             return {k: dict(v) for k, v in _agg["prog"].items()}
+    except Exception:
+        return {}
+
+
+def record_reuse(key, hits=0, misses=0, partials=0):
+    """Persist one result-cache probe outcome (shared-computation
+    plane, ISSUE 18) keyed by the cache entry key: the hit-rate
+    profile the disk tier's boot preload ranks entries by."""
+    try:
+        if not enabled() or not key:
+            return
+        if not (hits or misses or partials):
+            return
+        _append({"k": "reuse", "key": str(key), "hits": int(hits),
+                 "misses": int(misses), "partials": int(partials)})
+    except Exception as e:
+        logger.debug("record_reuse failed: %s", e)
+
+
+def reuse_profiles():
+    """{cache key: {hits, misses, partials}} — every persisted
+    result-cache hit-rate profile.  A fresh process calling this
+    ranks entries it never served."""
+    try:
+        if not enabled():
+            return {}
+        _ensure_loaded()
+        with _lock:
+            return {k: dict(v) for k, v in _agg["reuse"].items()}
     except Exception:
         return {}
 

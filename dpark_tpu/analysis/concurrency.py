@@ -400,6 +400,9 @@ PLANE_SEAMS = (
     ("locks.py", "note_release", "_SANITIZER"),
     ("aotcache.py", "set_current_sig", "_PLANE"),
     ("aotcache.py", "stats", "_PLANE"),
+    ("resultcache.py", "probe", "_PLANE"),
+    ("resultcache.py", "offer", "_PLANE"),
+    ("resultcache.py", "stats", "_PLANE"),
     ("backend/tpu/executor.py", "_ProgramCache.__setitem__",
      "aotcache._PLANE"),
 )

@@ -778,6 +778,51 @@ def _rule_table_host_fallback(r, report):
             "DPARK_QUERY=0 silences planning entirely")
 
 
+def _rule_repeated_subplan(lineage, report):
+    """repeated-subplan (ISSUE 18 satellite): the same canonical
+    sub-plan signature evaluated at two DISTINCT nodes of one plan —
+    each evaluation pays the scan/exchange again even though the
+    result-cache plane (or plain subtree sharing: build the common
+    table once and derive both queries from it) could serve the
+    second for free.  Shared OBJECTS are one evaluation and never
+    flag; leaves (bare scans) don't either — reading a table twice is
+    the cache's job, not a plan smell.  Nodes outside the logical
+    grammar (plain RDDs, unsignable expressions) are skipped."""
+    from dpark_tpu.query import logical
+    seen = {}                   # signature -> node ids evaluating it
+    for node in lineage:
+        if not isinstance(node, logical.Node) \
+                or not node.children:
+            continue
+        try:
+            sig = logical.plan_signature(node)
+        except Exception:
+            continue
+        seen.setdefault(sig, set()).add(id(node))
+    dups = {s for s, ids in seen.items() if len(ids) > 1}
+
+    def _contains(parent, child):
+        return any(c == child or (isinstance(c, tuple)
+                                  and _contains(c, child))
+                   for c in parent)
+
+    for sig in sorted(dups, key=repr):
+        # report only MAXIMAL duplicated subtrees: a duplicated
+        # Filter inside a duplicated GroupAgg is the same finding
+        if any(other != sig and _contains(other, sig)
+               for other in dups):
+            continue
+        ids = seen[sig]
+        report.add(
+            "repeated-subplan", "info", str(sig[0]).lower(),
+            "the same %s sub-plan is evaluated %d times in this plan "
+            "without reuse" % (sig[0], len(ids)),
+            "derive both queries from one shared TableRDD (a logical "
+            "subtree evaluates once per object), or turn on the "
+            "shared result cache (DPARK_RESULT_CACHE=mem|disk) so "
+            "repeated sub-plans serve from cached rows")
+
+
 def lint_plan(rdd, master="local", report=None, lineage=None):
     """Run every plan rule over the lineage of `rdd`; returns a Report.
 
@@ -800,6 +845,7 @@ def lint_plan(rdd, master="local", report=None, lineage=None):
         _rule_window_noninv(r, report)
         _rule_table_host_fallback(r, report)
     _rule_uncached_reshuffle(lineage, report)
+    _rule_repeated_subplan(lineage, report)
     excess = _excess_wide_depth(rdd)
     _rule_wide_depth(rdd, report, excess)
     _rule_unbounded_recovery(rdd, report, excess)

@@ -208,6 +208,26 @@ AOT_CACHE_DIR = os.environ.get(
 AOT_WARM_BUDGET_MS = float(os.environ.get(
     "DPARK_AOT_WARM_BUDGET_MS", "2000") or 0)
 
+# shared-computation plane (ISSUE 18): off | mem | disk.  "off" (the
+# default) costs one `is None` check at the planner's probe seam and
+# is bit-identical to any cached run; "mem" serves repeated sub-plans
+# (and mergeable partial aggregates) from a host-memory LRU tier;
+# "disk" adds a crc-framed on-disk tier that survives restarts
+# alongside the AOT cache — same corruption contract (any defect
+# means recompute, never an error).  Entries invalidate by source
+# fingerprint: v2 tabular footer stats, (path, mtime, size) for v1.
+RESULT_CACHE = os.environ.get("DPARK_RESULT_CACHE", "off")
+
+# where disk-tier result entries live (delete the directory to reset)
+RESULT_CACHE_DIR = os.environ.get(
+    "DPARK_RESULT_CACHE_DIR",
+    os.path.join(DPARK_WORK_DIR, "resultcache"))
+
+# memory-tier byte budget: least-recently-served entries evict past
+# it, and a single result larger than the whole budget never stores.
+RESULT_CACHE_BUDGET = int(os.environ.get(
+    "DPARK_RESULT_CACHE_BUDGET", str(64 << 20)) or (64 << 20))
+
 # dcn transient-connect retry: total attempts (1 = no retry) and the
 # base backoff seconds (exponential with full jitter: attempt k sleeps
 # uniform in [base*2^k/2, base*2^k]).  Application-level ServerError

@@ -749,6 +749,14 @@ def api_health(scheduler=None):
             out["aot"] = aot
     except Exception:
         pass
+    try:
+        # shared-computation result-cache counters (ISSUE 18)
+        from dpark_tpu import resultcache
+        rc = resultcache.stats()
+        if rc is not None:
+            out["result_cache"] = rc
+    except Exception:
+        pass
     if s is not None:
         with s.lock:
             out["stage_fetch"] = {

@@ -82,7 +82,8 @@ FIELDS = ("device_ms", "stages", "wave_ms", "waves", "dispatches",
           "compiles", "compile_ms", "lock_wait_ms", "lock_waits",
           "lock_hold_ms", "hbm_byte_s", "hbm_stored_bytes",
           "hbm_spills", "spill_bytes", "bulk_bytes", "fetches",
-          "fetch_ms")
+          "fetch_ms", "rc_byte_s", "rc_stored_bytes", "rc_hits",
+          "rc_served_bytes")
 _FLOAT_FIELDS = frozenset(f for f in FIELDS
                           if f.endswith("_ms") or f.endswith("_s"))
 
@@ -174,6 +175,12 @@ class LedgerSink:
         self.retired = set()     # job ids whose accounts archived
         # live HBM stores: sid -> (bytes, t_registered, job, stage)
         self.hbm_live = {}
+        # live result-cache entries: sid -> (bytes, t_registered,
+        # storing tenant).  Tenant-keyed, not job-keyed: cache-served
+        # queries run no job, so resultcache.* events carry the
+        # tenant explicitly and byte-seconds settle straight into the
+        # archive at release
+        self.rc_live = {}
         self.folded = 0
         self.dropped_keys = 0
         # offline mesh view folded from mesh.lock spans (the live
@@ -340,6 +347,37 @@ class LedgerSink:
                         a.hbm_byte_s += nbytes * held
                         if args.get("reason") == "spill":
                             a.hbm_spills += 1
+            elif name == "resultcache.store":
+                # shared result cache (ISSUE 18): residency bills to
+                # the STORING tenant, carried in the event args (no
+                # job exists when the planner stores or serves)
+                sid = args.get("sid")
+                tenant = str(args.get("tenant") or "local")
+                nbytes = int(args.get("bytes", 0) or 0)
+                if sid is not None:
+                    self.rc_live[sid] = (nbytes, rec.get("ts")
+                                         or time.time(), tenant)
+                a = Account()
+                a.rc_stored_bytes = nbytes
+                self._archive_locked(tenant, "resultcache", a)
+            elif name == "resultcache.release":
+                ent = self.rc_live.pop(args.get("sid"), None)
+                if ent is not None:
+                    nbytes, t0, tenant = ent
+                    held = max(0.0, (rec.get("ts") or time.time())
+                               - t0)
+                    a = Account()
+                    a.rc_byte_s = nbytes * held
+                    self._archive_locked(tenant, "resultcache", a)
+            elif name == "resultcache.serve":
+                # hits bill to the SERVED tenant: zero scan
+                # device-seconds, just the hit count and served bytes
+                a = Account()
+                a.rc_hits = 1
+                a.rc_served_bytes = int(args.get("bytes", 0) or 0)
+                self._archive_locked(
+                    str(args.get("tenant") or "local"),
+                    "resultcache", a)
             elif name in ("spill.write", "spill.read"):
                 self._account(job, stage, None).spill_bytes += \
                     int(args.get("bytes", 0) or 0)
@@ -415,6 +453,11 @@ class LedgerSink:
                 "mesh": dict(self.mesh),
                 "hbm_live_bytes": int(live_bytes),
                 "hbm_live_byte_s": round(live_byte_s, 4),
+                "resultcache_live_bytes": int(sum(
+                    b for b, _, _ in self.rc_live.values())),
+                "resultcache_live_byte_s": round(sum(
+                    b * max(0.0, t_now - t0)
+                    for b, t0, _ in self.rc_live.values()), 4),
                 "span_window_s": round(
                     (self._t_max - self._t_min), 6)
                 if self._t_min is not None else 0.0,
@@ -586,6 +629,9 @@ def _totals_shape(a):
         "compiles": int(a.compiles),
         "compile_ms": round(a.compile_ms, 3),
         "waves": int(a.waves),
+        "resultcache_byte_seconds": round(a.rc_byte_s, 4),
+        "resultcache_hits": int(a.rc_hits),
+        "resultcache_served_bytes": int(a.rc_served_bytes),
     }
 
 

@@ -70,6 +70,12 @@ DOCUMENTED_ORDER = (
     #                          reverse
     "shuffle.shard_pool",
     "dcn.serves",
+    "resultcache.store",     # shared result cache LRU/counters: the
+    #                          planner probes it before any job
+    #                          exists and offers under a finished
+    #                          query; its trace events emit AFTER
+    #                          release, so it must order before
+    #                          trace.plane and never nest under it
     "trace.plane",           # span ring/spool (spans emit under mesh)
     "health.sink",
     "ledger.sink",

@@ -154,3 +154,63 @@ class Sort(Node):
     def describe(self):
         return "Sort(%s%s)" % (", ".join(k.expr for k in self.keys),
                                " desc" if self.reverse else "")
+
+
+class CachedResult(Node):
+    """Leaf standing in for a subtree the result-cache plane served:
+    `explain()` shows exactly what was NOT executed.  `replaced` keeps
+    the original subtree's one-line describe for the sketch."""
+
+    def __init__(self, fields, replaced, key):
+        super().__init__(fields)
+        self.replaced = replaced
+        self.key = key
+
+    def describe(self):
+        return "CachedResult(%s key=%s)" % (self.replaced, self.key)
+
+
+def plan_signature(node):
+    """Canonical, process-stable signature of a logical subtree.
+
+    Unlike `sketch()`/`describe()` this includes every expression TEXT
+    (GroupAgg.describe prints only `name:func`, so sum(b) and sum(c)
+    would collide on the sketch) — the result-cache plane and the
+    `repeated-subplan` lint rule key on this.  Source CONTENT is
+    deliberately absent: the cache composes this with a per-file
+    fingerprint (tabular.source_fingerprint); the lint rule wants
+    shape-equality within one plan.  Raises on expression objects that
+    lack `.expr` — callers treat that subtree as unsignable."""
+    t = type(node).__name__
+    if isinstance(node, Scan):
+        return ("Scan", node.table_name, tuple(node.fields))
+    if isinstance(node, Project):
+        return ("Project",
+                tuple((n, ce.expr) for n, ce in node.exprs),
+                plan_signature(node.children[0]))
+    if isinstance(node, Filter):
+        return ("Filter", tuple(sorted(p.expr for p in node.preds)),
+                plan_signature(node.children[0]))
+    if isinstance(node, GroupAgg):
+        return ("GroupAgg",
+                tuple((n, ce.expr) for n, ce in node.keys),
+                tuple((a[0], a[1],
+                       a[2].expr if a[2] is not None else None,
+                       # UDAs carry opaque callables: their identity is
+                       # not content-stable, so mark them unsignable-ish
+                       # by name only (cache callers reject UDA plans)
+                       getattr(a[3], "__name__", None)
+                       if a[3] is not None else None)
+                      for a in node.aggs),
+                plan_signature(node.children[0]))
+    if isinstance(node, Join):
+        return ("Join", node.on, tuple(node.fields),
+                plan_signature(node.children[0]),
+                plan_signature(node.children[1]))
+    if isinstance(node, Sort):
+        return ("Sort", tuple(k.expr for k in node.keys),
+                bool(node.reverse),
+                plan_signature(node.children[0]))
+    if isinstance(node, CachedResult):
+        return ("CachedResult", node.key)
+    return (t,) + tuple(plan_signature(c) for c in node.children)

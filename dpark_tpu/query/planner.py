@@ -489,6 +489,8 @@ class PlannedQuery:
         self._group = None
         self._join = None
         self._out_fields = None
+        self._partial = None        # result-cache partial-merge recipe
+        self._cache_offer = None    # result-cache store-back ticket
 
     # -- bookkeeping -----------------------------------------------------
     def decide(self, rule, op, choice, reason):
@@ -510,7 +512,18 @@ class PlannedQuery:
     # -- actions ---------------------------------------------------------
     def rows(self):
         if self._rows_cache is None:
-            self._rows_cache = self._run()
+            rows = None
+            if self._partial is not None:
+                rows = self._merge_partial(self._partial)
+            if rows is None:
+                rows = self._run()
+            self._rows_cache = rows
+            if self._cache_offer is not None:
+                try:
+                    from dpark_tpu import resultcache
+                    resultcache.offer(self, rows)
+                except Exception as e:
+                    logger.debug("result cache offer: %s", e)
         return self._rows_cache
 
     def collect(self):
@@ -521,7 +534,8 @@ class PlannedQuery:
 
     def count(self):
         has_filter = any(op[0] == "filter" for op in self.egest_ops)
-        if self._rows_cache is not None or has_filter:
+        if self._rows_cache is not None or has_filter \
+                or self._partial is not None:
             return len(self.rows())
         if self.mode == "scan":
             env = self.segs[0].run(self.scan_stats)
@@ -546,6 +560,37 @@ class PlannedQuery:
         rows = self._egest(rows, fields)
         self._observe("device", (time.time() - t0) * 1e3)
         return rows
+
+    def _merge_partial(self, part):
+        """Serve a partial-aggregate cache hit: run the residual plan
+        the probe built (covering exactly the source region the cached
+        entry does not), then fold the two disjoint aggregate row sets
+        with the mergeable combiners.  Any failure returns None and
+        the caller falls back to the full uncached run — the merge
+        path is an optimization, never a correctness dependency."""
+        try:
+            from dpark_tpu import resultcache, trace
+            t0 = time.time()
+            rpq = plan_query(part["residual"], self.ctx, reuse=False)
+            if not rpq.ok:
+                return None
+            res = rpq.rows()
+            for k, v in rpq.scan_stats.items():
+                if isinstance(v, set):
+                    self.scan_stats.setdefault(k, set()).update(v)
+                else:
+                    self.scan_stats[k] = self.scan_stats.get(k, 0) + v
+            rows = resultcache.merge_group_rows(
+                part["rows"], res, part["nk"], part["kinds"])
+            rows = self._egest(rows, list(part["fields"]))
+            trace.event("resultcache.merge", "resultcache",
+                        sid=part["key"], cached=len(part["rows"]),
+                        residual=len(res),
+                        ms=round((time.time() - t0) * 1e3, 2))
+            return rows
+        except Exception as e:
+            logger.debug("partial-aggregate merge fell back: %s", e)
+            return None
 
     def _observe(self, path, ms):
         try:
@@ -732,10 +777,11 @@ class PlannedQuery:
 # the rules
 # ---------------------------------------------------------------------------
 
-def plan_query(root, ctx):
+def plan_query(root, ctx, reuse=True):
     """Plan a logical tree onto the device path.  Returns a
     PlannedQuery; `.ok` False means the host object path should serve
-    the query (with `.fallbacks` carrying the reasons)."""
+    the query (with `.fallbacks` carrying the reasons).  `reuse=False`
+    skips the result-cache probe (residual plans must not re-probe)."""
     pq = PlannedQuery(root, ctx)
     try:
         _rule_shape(pq)
@@ -747,6 +793,8 @@ def plan_query(root, ctx):
             _rule_lower_group(pq)
         compile_egest(pq)
         _rule_price(pq)
+        if reuse:
+            _rule_reuse(pq)
         pq.ok = True
     except _Decline as d:
         pq.decide("planner", d.op, "host", d.reason)
@@ -1453,6 +1501,21 @@ def _rule_price(pq):
         raise
     except Exception as e:
         logger.debug("query pricing skipped: %s", e)
+
+
+def _rule_reuse(pq):
+    """Probe the shared result-cache plane (resultcache.py) with the
+    finished plan: a full hit presets the row cache and swaps the root
+    for a CachedResult leaf; a partial-aggregate hit installs the
+    merge recipe (`pq._partial`); a miss leaves a store-back offer so
+    the first execution populates the cache.  One `is None` check when
+    the plane is off; any plane error is logged and the plan proceeds
+    uncached."""
+    try:
+        from dpark_tpu import resultcache
+        resultcache.probe(pq)
+    except Exception as e:
+        logger.debug("result cache probe skipped: %s", e)
 
 
 # ---------------------------------------------------------------------------

@@ -51,6 +51,7 @@ from collections import deque
 from dpark_tpu import aotcache
 from dpark_tpu import conf
 from dpark_tpu import locks
+from dpark_tpu import resultcache
 from dpark_tpu.utils.log import get_logger
 
 logger = get_logger("service")
@@ -172,6 +173,8 @@ class JobServer:
         self._tenants = {}
         # boot-warming summary (ISSUE 17; see _boot_warm)
         self._aot_warm = None
+        # result-cache boot summary (ISSUE 18; see _boot_resultcache)
+        self._rc_boot = None
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -189,6 +192,12 @@ class JobServer:
             plane = aotcache._PLANE
             if plane is not None:
                 self._boot_warm(plane)
+            # shared computation (ISSUE 18): a disk-tier result cache
+            # preloads its hottest entries so the first repeated
+            # query after a restart serves with zero scan chunks
+            rc = resultcache._PLANE
+            if rc is not None:
+                self._boot_resultcache(rc)
             self._stopped = False
             for i in range(self.slots):
                 t = threading.Thread(target=self._slot_loop,
@@ -230,6 +239,27 @@ class JobServer:
                 summary["ms"], summary["budget_ms"])
         except Exception as e:
             logger.debug("aot boot warm failed: %s", e)
+
+    def _boot_resultcache(self, rc):
+        """Result-cache boot pass (ISSUE 18): load the disk tier's
+        index and preload the hottest entries (ranked by the adapt
+        store's reuse profiles) into the memory tier.  Same contract
+        as _boot_warm: runs as the ``__boot__`` pseudo-tenant, never
+        raises — a defective cache dir means cold serving, not a dead
+        server."""
+        from dpark_tpu import trace
+        try:
+            with trace.ctx(job="__boot__"):
+                summary = rc.boot()
+            self._rc_boot = summary
+            if summary.get("entries"):
+                logger.info(
+                    "result cache boot: %d/%d entries (%d bytes) in "
+                    "%.0f ms", summary["preloaded"],
+                    summary["entries"], summary["bytes"],
+                    summary["ms"])
+        except Exception as e:
+            logger.debug("result cache boot failed: %s", e)
 
     def stop(self):
         with self._lock:
@@ -470,6 +500,11 @@ class JobServer:
             out["program_cache"] = ex.program_cache_stats()
         if self._aot_warm is not None:
             out["aot_warm"] = dict(self._aot_warm)
+        if self._rc_boot is not None:
+            out["result_cache_boot"] = dict(self._rc_boot)
+        rc = resultcache.stats()
+        if rc is not None:
+            out["result_cache"] = rc
         return out
 
 
@@ -515,7 +550,8 @@ class ClientScheduler:
 
     is_service_client = True     # DparkContext.stop: leave env alive
 
-    def __init__(self, server, client=None, weight=None, slo_ms=None):
+    def __init__(self, server, client=None, weight=None, slo_ms=None,
+                 share_results=None):
         self.server = server
         self.client = client or "client-%d" % next(_client_ids)
         self.weight = weight or conf.SERVICE_WEIGHT
@@ -523,6 +559,12 @@ class ClientScheduler:
         # process default (DPARK_SERVICE_SLO); 0 = untracked
         self.slo_ms = slo_ms if slo_ms is not None \
             else conf.SERVICE_SLO_MS
+        # cross-tenant result sharing (ISSUE 18): tenants share the
+        # result cache by default; share_results=False opts this
+        # tenant out of BOTH directions (no reads, no stores)
+        if share_results is not None:
+            resultcache.opt_out(self.client,
+                                flag=not share_results)
 
     def start(self):
         self.server.start()

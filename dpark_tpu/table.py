@@ -228,6 +228,7 @@ class TableRDD:
         self._plan_fallbacks = list(plan_fallbacks or ())
         self.plan = plan if plan is not None else self._scan_plan()
         self._planned_q = False     # False = not planned yet
+        self._reuse = True          # result-cache probe allowed
 
     # -- query-plane lowering -------------------------------------------
     def _scan_plan(self):
@@ -291,7 +292,8 @@ class TableRDD:
             return None
         try:
             from dpark_tpu.query.planner import plan_query
-            pq = plan_query(self.plan, self.rdd.ctx)
+            pq = plan_query(self.plan, self.rdd.ctx,
+                            reuse=self._reuse)
         except Exception as e:
             logger.debug("query planning unavailable: %s", e)
             return None
@@ -318,6 +320,18 @@ class TableRDD:
             adapt.observe_path(sig, "host", (time.time() - t0) * 1e3)
         except Exception:
             pass
+
+    def shared(self, flag=True):
+        """Per-QUERY result-cache opt-out: ``t.shared(False).collect()``
+        neither probes nor stores into the shared-computation plane
+        (resultcache.py) for this table's actions.  Tenant-wide
+        opt-out lives on the JobServer (``resultcache.opt_out``);
+        this is the query-granularity escape hatch.  Call it LAST —
+        derived tables (select/where/...) start back at the
+        default."""
+        self._reuse = bool(flag)
+        self._planned_q = False     # re-plan under the new setting
+        return self
 
     def explain(self):
         """The logical plan + every planner rule decision (device or

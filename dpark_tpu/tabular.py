@@ -214,6 +214,40 @@ def chunk_stats(path):
     return out
 
 
+def source_fingerprint(path):
+    """Cheap content fingerprint of one tabular file, for the result
+    cache's invalidation key (ISSUE 18).
+
+    v2 files digest the footer statistics (fields + per-chunk rows /
+    sizes / min / max / nulls): rewriting any chunk's data rewrites its
+    stats and sizes, so the digest drifts without reading a single data
+    byte.  v1 files (and stat-less columns) have nothing content-like
+    in the header — `chunk_stats` legitimately returns {} there — so
+    they fall back to (path, mtime_ns, size), which must NOT error
+    (satellite: mixed v1/v2 tables fingerprint fine, v1 just
+    invalidates on any rewrite-in-place that touches mtime)."""
+    import hashlib
+    try:
+        st = os.stat(path)
+    except OSError:
+        # a vanished part still fingerprints (to a sentinel no real
+        # file can produce): the key just never matches again
+        return ("v?", path, 0, -1)
+    try:
+        header = read_header(path)
+    except (IOError, OSError, ValueError):
+        return ("v?", path, st.st_mtime_ns, st.st_size)
+    if header.get("version", 1) < FOOTER_VERSION:
+        return ("v1", path, st.st_mtime_ns, st.st_size)
+    h = hashlib.sha1()
+    h.update(repr(header.get("fields")).encode("utf-8"))
+    for chunk in header.get("chunks", []):
+        h.update(repr((chunk.get("rows"), chunk.get("sizes"),
+                       [sorted(m.items()) for m in
+                        chunk.get("columns", [])])).encode("utf-8"))
+    return ("v2", h.hexdigest())
+
+
 def read_chunks(path, wanted_fields=None, predicate_ranges=None,
                 stats=None):
     """Yield dicts of column-name -> array per chunk.

@@ -87,6 +87,33 @@ def render_metrics(scheduler):
                           "path")):
         metric("dpark_aot_%s_total" % key, "counter", help_text,
                [({}, aot.get(key, 0))])
+    # shared-computation result cache (ISSUE 18): the planner-probe
+    # counters — the two-tenant reuse acceptance ("zero scan chunks
+    # on the repeated query") is asserted from these
+    try:
+        from dpark_tpu import resultcache
+        rc = resultcache.stats() or {}
+    except Exception:
+        rc = {}
+    for key, help_text in (
+            ("hits", "sub-plan results served whole from the shared "
+                     "result cache"),
+            ("partial_hits", "partial-aggregate merges served from "
+                             "cached partials + a residual scan"),
+            ("misses", "result-cache probes that fell through to "
+                       "execution"),
+            ("stores", "query results stored into the result cache"),
+            ("evictions", "result-cache LRU evictions past the byte "
+                          "budget"),
+            ("disk_loads", "result entries loaded from the disk "
+                           "tier"),
+            ("disk_stores", "result entries written through to the "
+                            "disk tier")):
+        metric("dpark_resultcache_%s_total" % key, "counter",
+               help_text, [({}, rc.get(key, 0))])
+    metric("dpark_resultcache_bytes", "gauge",
+           "resident result-cache memory-tier bytes",
+           [({}, rc.get("bytes", 0))])
     # per-tenant SLO accounting (ISSUE 14): attainment + multi-window
     # burn gauges and the monotonic violation counter, one series per
     # tenant that declared a target

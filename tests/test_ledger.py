@@ -444,6 +444,120 @@ def test_conservation_two_concurrent_tenants(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# conservation: cache-served jobs (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+def test_resultcache_events_attribute_by_tenant():
+    """The shared result cache runs NO job for a served query, so its
+    events carry the tenant explicitly: residency byte-seconds bill
+    the STORING tenant at release, hits/served-bytes the SERVED
+    tenant — and a cache-served query conserves trivially (zero scan
+    device-seconds, nothing on the mesh to reconcile)."""
+    s = ledger.LedgerSink()
+    s.fold({"name": "resultcache.store", "ts": 10.0,
+            "args": {"sid": "k1", "bytes": 1000,
+                     "tenant": "tenant-a"}})
+    snap = s.snapshot(now=12.0)
+    assert snap["resultcache_live_bytes"] == 1000
+    assert snap["resultcache_live_byte_s"] == pytest.approx(2000.0)
+    s.fold({"name": "resultcache.serve", "ts": 11.0,
+            "args": {"sid": "k1", "bytes": 1000, "tier": "full",
+                     "tenant": "tenant-b"}})
+    s.fold({"name": "resultcache.release", "ts": 15.0,
+            "args": {"sid": "k1", "bytes": 1000, "reason": "evict",
+                     "tenant": "tenant-a"}})
+    snap = s.snapshot(now=15.0)
+    assert snap["resultcache_live_bytes"] == 0
+    totals = ledger.tenant_totals_from_snapshot(snap)
+    a, b = totals["tenant-a"], totals["tenant-b"]
+    # 1000 bytes held 10.0..15.0 bills the storing tenant
+    assert a["resultcache_byte_seconds"] == pytest.approx(5000.0)
+    assert a["resultcache_hits"] == 0
+    # the hit bills the SERVED tenant — at ZERO device-seconds
+    assert b["resultcache_hits"] == 1
+    assert b["resultcache_served_bytes"] == 1000
+    assert b["device_seconds"] == 0.0
+    # nothing ran on the mesh: the conservation check has nothing to
+    # reconcile and must NOT flag the served query as unattributed
+    cons = ledger.conservation(meter={"busy_s": 0.0, "wall_s": 5.0},
+                               snap=snap)
+    assert cons["ok"] is not False, cons
+    assert cons["attributed_device_s"] == 0.0
+
+
+def test_conservation_holds_with_cache_served_tenant():
+    """One tenant pays the scan (mesh-busy, job-attributed), another
+    is served from the cache (no job): attributed occupancy still
+    reconciles exactly — the served tenant adds hits, not holds."""
+    s = ledger.LedgerSink()
+    s.note_job(1, "tenant-a")
+    s.fold({"name": "stage.exec", "dur": 0.4, "job": 1, "stage": 1,
+            "ts": 10.0, "args": {"sig": "Q"}})
+    s.fold({"name": "mesh.lock", "dur": 0.0, "job": 1, "stage": 1,
+            "ts": 10.0, "args": {"hold_s": 0.4}})
+    s.fold({"name": "resultcache.store", "ts": 10.5,
+            "args": {"sid": "kq", "bytes": 512,
+                     "tenant": "tenant-a"}})
+    s.fold({"name": "job", "ts": 10.6, "dur": 0.5, "job": 1,
+            "args": {"client": "tenant-a", "state": "done"}})
+    s.fold({"name": "resultcache.serve", "ts": 11.0,
+            "args": {"sid": "kq", "bytes": 512, "tier": "full",
+                     "tenant": "tenant-b"}})
+    snap = s.snapshot(now=12.0)
+    cons = ledger.conservation(meter={"busy_s": 0.4, "wall_s": 2.0},
+                               snap=snap)
+    # every mesh-busy second names tenant-a; the served tenant-b
+    # consumed none and broke nothing
+    assert cons["ok"] is True and cons["ratio"] == 1.0, cons
+    totals = ledger.tenant_totals_from_snapshot(snap)
+    assert totals["tenant-a"]["device_seconds"] == \
+        pytest.approx(0.4)
+    assert totals["tenant-b"]["device_seconds"] == 0.0
+    assert totals["tenant-b"]["resultcache_hits"] == 1
+
+
+def test_cache_served_query_end_to_end_ledger(tmp_path):
+    """Live integration: a repeated tabular group-by under
+    trace=ring + ledger=on + resultcache=mem.  The second tenant's
+    query is served from the cache — the ledger shows the hit billed
+    to it with zero scan device work."""
+    from dpark_tpu import DparkContext, resultcache
+    from dpark_tpu.tabular import write_tabular
+    d = str(tmp_path / "tab")
+    os.makedirs(d)
+    write_tabular(os.path.join(d, "part-00000.tab"), ["t", "k", "a"],
+                  [(i, i % 7, i % 50) for i in range(4000)],
+                  chunk_rows=1000)
+    trace.configure("ring")
+    ledger.configure("on")
+    resultcache.configure(mode="mem",
+                          cache_dir=str(tmp_path / "rc"))
+    ctx = DparkContext("local")
+    try:
+        def q():
+            return ctx.tabular(d, ["t", "k", "a"]).asTable("e") \
+                .where("t >= 1000").groupBy("k", "sum(a) as s")
+        with resultcache.tenant("tenant-a"):
+            cold = sorted(q().collect())
+        with resultcache.tenant("tenant-b"):
+            warm = sorted(q().collect())
+        assert warm == cold
+        totals = ledger.tenant_totals()
+        b = totals["tenant-b"]
+        assert b["resultcache_hits"] == 1, totals
+        assert b["resultcache_served_bytes"] > 0
+        assert b["device_seconds"] == 0.0
+        assert totals["tenant-a"]["resultcache_hits"] == 0
+        cons = ledger.conservation()
+        assert cons["ok"] is not False, cons
+    finally:
+        resultcache.configure(mode="off")
+        trace.configure("off")
+        ledger.configure("off")
+        ctx.stop()
+
+
+# ---------------------------------------------------------------------------
 # program cost profiles (the items-2/3 pricing prior)
 # ---------------------------------------------------------------------------
 

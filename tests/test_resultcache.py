@@ -1,0 +1,563 @@
+"""Shared-computation plane (ISSUE 18): the cross-tenant sub-plan
+result cache.
+
+The suite proves the contracts the plane makes:
+
+* KEYING — an entry's identity covers the canonical plan signature,
+  the resolved dtypes, and one source fingerprint per part file: v2
+  files digest the footer stats (content-addressed — touching mtime
+  does NOT drift them), v1 files fall back to (path, mtime_ns, size)
+  so mutation ALWAYS means a miss, never a stale serve.
+* SERVING — a repeated query plans into a CachedResult leaf with zero
+  scan chunks and the bit-identical answer; a wider query whose
+  mergeable group-aggregate was cached over a narrower contained
+  filter merges the cached rows with a residual scan.
+* HYGIENE — corrupt, truncated, or version-drifted disk entries are
+  silent misses (the adapt-store contract); the memory tier evicts
+  LRU-first under its byte budget.
+* PARITY — off/mem/disk produce bit-identical results on a chaos
+  (injected fetch-fault) job; the modes differ only in counters.
+* TENANCY — tenants share by default; ``opt_out`` removes one from
+  both directions; ``shared(False)`` pins one query out.
+"""
+
+import os
+import pickle
+
+import pytest
+
+from dpark_tpu import adapt, conf, resultcache, service
+from dpark_tpu.tabular import source_fingerprint, write_tabular
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planes(tmp_path):
+    """Every test gets its own adapt store and no installed result
+    cache to start; no process-global server leaks."""
+    adapt.configure(mode="observe", store_dir=str(tmp_path / "adapt"))
+    resultcache.configure(mode="off")
+    yield
+    resultcache.configure(mode="off")
+    adapt.configure()
+    service.shutdown()
+
+
+def _plane(tmp_path, mode="mem", **kw):
+    return resultcache.configure(
+        mode=mode, cache_dir=str(tmp_path / "rc"), **kw)
+
+
+def _write(path, rows, fields, name="part-00000.tab",
+           chunk_rows=500, version=2):
+    os.makedirs(path, exist_ok=True)
+    p = os.path.join(str(path), name)
+    write_tabular(p, fields, rows, chunk_rows=chunk_rows,
+                  version=version)
+    return p
+
+
+def _rows(n=4000):
+    return [(i, i % 97, i % 50) for i in range(n)]
+
+
+def _table(ctx, path):
+    return ctx.tabular(str(path), ["t", "k", "a"]).asTable("events")
+
+
+def _group(ctx, path, where="t >= 1000"):
+    return _table(ctx, path).where(where).groupBy(
+        "k", "sum(a) as s", "count(t) as c")
+
+
+# ---------------------------------------------------------------------------
+# modes and the off-mode seam
+# ---------------------------------------------------------------------------
+
+def test_mode_grammar(tmp_path):
+    assert resultcache.configure(mode="off") is None
+    assert not resultcache.active() and resultcache.plane() is None
+    p = _plane(tmp_path, "mem")
+    assert p.mode == "mem" and resultcache.active()
+    assert resultcache.configure(mode="none") is None
+    with pytest.raises(ValueError):
+        resultcache.configure(mode="sometimes")
+
+
+def test_off_seams_are_inert():
+    resultcache.configure(mode="off")
+    assert resultcache.stats() is None
+    assert resultcache.probe(object()) is None
+    assert resultcache.offer(object(), []) is False
+    assert resultcache.opt_out("t") is False
+
+
+# ---------------------------------------------------------------------------
+# full hits: store on first run, serve the repeat with zero scan
+# ---------------------------------------------------------------------------
+
+def test_full_hit_round_trip(ctx, tmp_path):
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    cold = sorted(_group(ctx, path).collect())
+    q2 = _group(ctx, path)
+    warm = sorted(q2.collect())
+    assert warm == cold
+    pq = q2._planned()
+    # the hit ran NO scan and the explain names what did not run
+    assert pq.scan_stats == {}, pq.scan_stats
+    assert "CachedResult" in pq.root.describe()
+    st = resultcache.stats()
+    assert st["hits"] == 1 and st["stores"] == 1
+    assert st["misses"] == 1 and st["entries"] == 1
+
+
+def test_scan_only_query_caches_too(ctx, tmp_path):
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    q = _table(ctx, path).where("t >= 3500")
+    cold = sorted(q.collect())
+    q2 = _table(ctx, path).where("t >= 3500")
+    assert sorted(q2.collect()) == cold
+    assert q2._planned().scan_stats == {}
+    assert resultcache.stats()["hits"] == 1
+
+
+def test_in_memory_source_never_cached(ctx, tmp_path):
+    """parallelize-backed tables mutate invisibly — no fingerprint,
+    no entry, not even a recorded miss."""
+    _plane(tmp_path)
+    rows = [("a", 1), ("b", 2), ("a", 3)]
+    t = ctx.parallelize(rows, 2).asTable("k v", name="m")
+    t.groupBy("k", "sum(v) as s").collect()
+    st = resultcache.stats()
+    assert st["stores"] == 0 and st["misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fingerprints: v2 content-addressed, v1 mtime+size fallback
+# (satellites 1 and 3)
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_versions(tmp_path):
+    rows = _rows(1200)
+    p2 = _write(tmp_path / "v2", rows, ["t", "k", "a"])
+    p1 = _write(tmp_path / "v1", rows, ["t", "k", "a"], version=1)
+    f2 = source_fingerprint(p2)
+    f1 = source_fingerprint(p1)
+    assert f2[0] == "v2" and f1[0] == "v1"
+    # v1 falls back to (path, mtime_ns, size)
+    assert f1[1] == p1 and f1[3] == os.stat(p1).st_size
+    # missing file: a distinct sentinel, not an error
+    assert source_fingerprint(str(tmp_path / "ghost"))[0] == "v?"
+
+
+def test_mixed_v1_v2_table_caches_and_invalidates(ctx, tmp_path):
+    """A table directory mixing a v2 part with a v1 (stat-less) part
+    still caches; TOUCHING the v1 part (mtime drift, same bytes)
+    invalidates, while touching the v2 part does not — its
+    fingerprint is content-addressed."""
+    _plane(tmp_path)
+    path = tmp_path / "mix"
+    rows = _rows()
+    _write(path, rows[:2000], ["t", "k", "a"], "part-00000.tab")
+    _write(path, rows[2000:], ["t", "k", "a"], "part-00001.tab",
+           version=1)
+    cold = sorted(_group(ctx, path).collect())
+    assert sorted(_group(ctx, path).collect()) == cold
+    assert resultcache.stats()["hits"] == 1
+    # v2 touch: content unchanged -> fingerprint unchanged -> hit
+    os.utime(os.path.join(str(path), "part-00000.tab"))
+    assert sorted(_group(ctx, path).collect()) == cold
+    assert resultcache.stats()["hits"] == 2
+    # v1 touch: the stat fallback drifts -> miss (and a re-store)
+    os.utime(os.path.join(str(path), "part-00001.tab"))
+    q = _group(ctx, path)
+    assert sorted(q.collect()) == cold
+    assert resultcache.stats()["hits"] == 2
+    assert q._planned().scan_stats.get("chunks_total"), \
+        q._planned().scan_stats
+
+
+def test_mutation_means_miss(ctx, tmp_path):
+    """Rewriting a part file with DIFFERENT rows must serve the new
+    answer — the v2 stats digest drifts without reading a data
+    byte."""
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    cold = sorted(_group(ctx, path).collect())
+    _write(path, [(t, k, a * 2) for t, k, a in _rows()],
+           ["t", "k", "a"])
+    fresh = sorted(_group(ctx, path).collect())
+    assert fresh != cold
+    assert {r.k: r.s for r in fresh} == \
+        {r.k: r.s * 2 for r in cold}
+    assert resultcache.stats()["hits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos parity: off/mem/disk agree bit-for-bit (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_off_mem_disk_chaos_parity(tmp_path):
+    from dpark_tpu import DparkContext, faults
+    path = tmp_path / "tab"
+    _write(path, _rows(8000), ["t", "k", "a"], chunk_rows=1000)
+    results, stats = {}, {}
+    for run, mode in (("off", "off"), ("mem", "mem"),
+                      ("disk", "disk"), ("disk-warm", "disk")):
+        _plane(tmp_path, mode)
+        faults.configure("shuffle.fetch:p=0.2,seed=7,times=3")
+        c = DparkContext("tpu:2")
+        c.start()
+        try:
+            q = _group(c, path)
+            results[run] = sorted(q.collect())
+            # a second identical query inside the same run must hit
+            if mode != "off":
+                results[run + "/2"] = sorted(_group(c, path).collect())
+        finally:
+            c.stop()
+            faults.configure(None)
+        stats[run] = resultcache.stats()
+    assert results["off"] == results["mem"] == results["disk"] \
+        == results["disk-warm"]
+    assert results["mem"] == results["mem/2"] == results["disk/2"] \
+        == results["disk-warm/2"]
+    assert stats["off"] is None
+    assert stats["mem"]["hits"] == 1 and stats["mem"]["stores"] == 1
+    assert stats["disk"]["disk_stores"] == 1
+    # the fourth pass reconfigured a FRESH plane on the same dir: its
+    # memory tier starts empty and the hit comes off disk
+    assert stats["disk-warm"]["disk_loads"] == 1
+    assert stats["disk-warm"]["load_errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# memory tier: size-budgeted LRU
+# ---------------------------------------------------------------------------
+
+def _ent(nbytes, tenant="local"):
+    return {"rows": [], "fields": ["x"], "nbytes": nbytes,
+            "meta": None, "group_sig": None, "tenant": tenant}
+
+
+def test_lru_eviction_under_budget(tmp_path):
+    p = _plane(tmp_path, "mem", budget_bytes=1000)
+    p._insert("k1", _ent(600), write_disk=False)
+    p._insert("k2", _ent(600), write_disk=False)
+    st = p.stats()
+    assert st["evictions"] == 1 and st["entries"] == 1
+    assert "k2" in p._mem and "k1" not in p._mem
+    assert st["bytes"] <= 1000
+
+
+def test_lru_touch_on_get(tmp_path):
+    p = _plane(tmp_path, "mem", budget_bytes=1000)
+    p._insert("k1", _ent(400), write_disk=False)
+    p._insert("k2", _ent(400), write_disk=False)
+    assert p.get("k1") is not None      # k1 becomes MRU
+    p._insert("k3", _ent(400), write_disk=False)
+    assert "k1" in p._mem and "k2" not in p._mem
+
+
+def test_oversize_result_never_stored(ctx, tmp_path):
+    _plane(tmp_path, "mem", budget_bytes=64)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    _group(ctx, path).collect()
+    st = resultcache.stats()
+    assert st["oversize"] == 1 and st["stores"] == 0
+
+
+# ---------------------------------------------------------------------------
+# disk tier: round trip, defect hygiene, boot preload
+# ---------------------------------------------------------------------------
+
+def test_disk_round_trip(tmp_path):
+    p = _plane(tmp_path, "disk")
+    blob = pickle.dumps((["x"], [(1, 2)], None), protocol=2)
+    ent = {"rows": [(1, 2)], "fields": ["x"], "nbytes": len(blob),
+           "meta": None, "group_sig": None, "tenant": "t-a"}
+    p._store_entry("kk", blob, ent)
+    got = p._load_entry("kk")
+    assert got is not None
+    assert got["rows"] == [(1, 2)] and got["tenant"] == "t-a"
+    assert p.index()["kk"]["nbytes"] == len(blob)
+
+
+@pytest.mark.parametrize("defect", ["flip", "truncate", "garbage"])
+def test_corrupt_entries_fall_back_silently(tmp_path, defect):
+    p = _plane(tmp_path, "disk")
+    blob = pickle.dumps((["x"], [(1, 2)], None), protocol=2)
+    p._store_entry("kk", blob, _ent(len(blob)))
+    ep = p._entry_path("kk")
+    raw = open(ep, "rb").read()
+    if defect == "flip":
+        raw = raw[:-3] + bytes([raw[-3] ^ 0xFF]) + raw[-2:]
+    elif defect == "truncate":
+        raw = raw[:len(raw) // 2]
+    else:
+        raw = b"not an entry at all"
+    with open(ep, "wb") as f:
+        f.write(raw)
+    assert p._load_entry("kk") is None
+    assert p.stats()["load_errors"] == 1
+
+
+def test_version_drift_skips(tmp_path, monkeypatch):
+    p = _plane(tmp_path, "disk")
+    blob = pickle.dumps((["x"], [(1, 2)], None), protocol=2)
+    monkeypatch.setattr(resultcache, "FORMAT", "dpark-rc-0")
+    p._store_entry("kk", blob, _ent(len(blob)))
+    monkeypatch.undo()
+    assert p._load_entry("kk") is None
+    assert p.stats()["version_skips"] == 1
+    # old-format index lines skip too
+    assert p.index() == {}
+
+
+def test_boot_preloads_hottest_first(tmp_path):
+    blob = pickle.dumps((["x"], [(1, 2)], None), protocol=2)
+    budget = len(blob) * 3              # cap (= budget//2) fits ONE
+    p = _plane(tmp_path, "disk", budget_bytes=budget)
+    p._store_entry("cold-key", blob, _ent(len(blob)))
+    p._store_entry("hot-key", blob, _ent(len(blob)))
+    adapt.record_reuse("hot-key", hits=3)
+    # a restarted server: fresh plane on the same dir
+    p2 = _plane(tmp_path, "disk", budget_bytes=budget)
+    summary = p2.boot()
+    assert summary["entries"] == 2 and summary["preloaded"] == 1
+    assert "hot-key" in p2._mem and "cold-key" not in p2._mem
+
+
+def test_disk_hit_survives_restart(ctx, tmp_path):
+    _plane(tmp_path, "disk")
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    cold = sorted(_group(ctx, path).collect())
+    # restart: fresh plane, same dir, boot back the stored entry
+    p2 = _plane(tmp_path, "disk")
+    assert p2.boot()["preloaded"] == 1
+    q = _group(ctx, path)
+    assert sorted(q.collect()) == cold
+    assert q._planned().scan_stats == {}
+    assert resultcache.stats()["hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# partial-aggregate reuse
+# ---------------------------------------------------------------------------
+
+def test_partial_merge_serves_wider_query(ctx, tmp_path):
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"], chunk_rows=500)
+    q_narrow = _group(ctx, path, "t >= 500")
+    q_narrow.collect()                  # caches the 7/8-chunk answer
+    q_wide = _group(ctx, path, "t >= 0")
+    got = sorted(q_wide.collect())
+    st = resultcache.stats()
+    assert st["partial_hits"] == 1, st
+    scan = q_wide._planned().scan_stats
+    # the residual scan covers t <= 499 only: one chunk read
+    assert scan["chunks_total"] - scan["chunks_skipped"] == 1, scan
+    resultcache.configure(mode="off")
+    assert got == sorted(_group(ctx, path, "t >= 0").collect())
+
+
+def test_partial_merge_all_mergeable_kinds(ctx, tmp_path):
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"], chunk_rows=500)
+
+    def q(where):
+        return _table(ctx, path).where(where).groupBy(
+            "k", "sum(a) as s", "count(t) as c", "min(a) as mn",
+            "max(a) as mx")
+
+    q("t >= 600").collect()
+    got = sorted(q("t >= 0").collect())
+    assert resultcache.stats()["partial_hits"] == 1
+    resultcache.configure(mode="off")
+    assert got == sorted(q("t >= 0").collect())
+
+
+def test_avg_is_not_partial_mergeable(ctx, tmp_path):
+    """avg finalizes s/c — its finished rows cannot merge.  Full
+    caching still applies; the partial probe must not."""
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+
+    def q(where):
+        return _table(ctx, path).where(where).groupBy(
+            "k", "avg(a) as av")
+
+    q("t >= 500").collect()
+    got = sorted(q("t >= 0").collect())
+    st = resultcache.stats()
+    assert st["partial_hits"] == 0 and st["stores"] == 2
+    resultcache.configure(mode="off")
+    assert got == sorted(q("t >= 0").collect())
+
+
+def test_equivalent_ranges_serve_as_full_hit(ctx, tmp_path):
+    """`t > 499` and `t >= 500` differ as text (different exact key)
+    but describe the same region — the cached rows ARE the answer."""
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    cold = sorted(_group(ctx, path, "t >= 500").collect())
+    q = _group(ctx, path, "t > 499")
+    assert sorted(q.collect()) == cold
+    st = resultcache.stats()
+    assert st["hits"] == 1 and q._planned().scan_stats == {}
+
+
+def test_disjoint_or_wider_cache_never_merges(ctx, tmp_path):
+    """A cached entry WIDER than (or overlapping) the new query must
+    not partial-serve — only contained boxes merge."""
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    _group(ctx, path, "t >= 100").collect()
+    got = sorted(_group(ctx, path, "t >= 200").collect())
+    st = resultcache.stats()
+    assert st["partial_hits"] == 0 and st["hits"] == 0
+    resultcache.configure(mode="off")
+    assert got == sorted(_group(ctx, path, "t >= 200").collect())
+
+
+def test_merge_group_rows_units():
+    merged = resultcache.merge_group_rows(
+        [(1, 10.0, 2, 5, 9), (2, 4.0, 1, 7, 7)],
+        [(1, 1.0, 1, 3, 11), (3, 2.0, 1, 0, 0)],
+        nk=1, kinds=("sum", "count", "min", "max"))
+    assert merged == [(1, 11.0, 3, 3, 11), (2, 4.0, 1, 7, 7),
+                      (3, 2.0, 1, 0, 0)]
+
+
+def test_interval_helpers():
+    c = resultcache._interval_contains
+    assert c((None, None), (5, 10))
+    assert c((0, 10), (0, 10)) and not c((0, 10), (0, 11))
+    assert not c((5, None), (None, 10))
+    r = resultcache._residual_intervals
+    assert r((0, 100), (50, 100)) == [(0, 49)]
+    assert r((None, None), (50, None)) == [(None, 49)]
+    assert r((0, 100), (20, 80)) == [(0, 19), (81, 100)]
+    assert r((5, 9), (5, 9)) == []
+
+
+# ---------------------------------------------------------------------------
+# tenancy: opt-out in both directions, per-query shared(False)
+# ---------------------------------------------------------------------------
+
+def test_tenant_opt_out_both_directions(ctx, tmp_path):
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    resultcache.opt_out("tenant-z")
+    with resultcache.tenant("tenant-z"):
+        _group(ctx, path).collect()     # neither reads nor stores
+    st = resultcache.stats()
+    assert st["opt_outs"] == 1 and st["stores"] == 0
+    with resultcache.tenant("tenant-y"):
+        cold = sorted(_group(ctx, path).collect())
+    assert resultcache.stats()["stores"] == 1
+    with resultcache.tenant("tenant-z"):
+        q = _group(ctx, path)
+        assert sorted(q.collect()) == cold
+        assert q._planned().scan_stats != {}    # scanned, no serve
+    assert resultcache.stats()["hits"] == 0
+    # re-admission restores sharing
+    resultcache.opt_out("tenant-z", flag=False)
+    with resultcache.tenant("tenant-z"):
+        _group(ctx, path).collect()
+    assert resultcache.stats()["hits"] == 1
+
+
+def test_shared_false_pins_one_query_out(ctx, tmp_path):
+    _plane(tmp_path)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    q = _group(ctx, path).shared(False)
+    cold = sorted(q.collect())
+    st = resultcache.stats()
+    assert st["stores"] == 0 and st["misses"] == 0
+    assert sorted(_group(ctx, path).collect()) == cold  # stores now
+    q3 = _group(ctx, path).shared(False)
+    assert sorted(q3.collect()) == cold
+    assert q3._planned().scan_stats != {}       # planned past the hit
+    assert resultcache.stats()["hits"] == 0
+
+
+def test_client_scheduler_share_results_opt_out(tmp_path):
+    p = _plane(tmp_path)
+    srv = service.get_server("local")
+    service.ClientScheduler(srv, client="t-priv", share_results=False)
+    assert "t-priv" in p._opt_out
+    service.ClientScheduler(srv, client="t-priv", share_results=True)
+    assert "t-priv" not in p._opt_out
+
+
+# ---------------------------------------------------------------------------
+# the repeated-subplan lint rule (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+def _lineage_of(*queries):
+    from dpark_tpu.query import logical
+    out = []
+    for q in queries:
+        out.extend(logical.iter_plan(q._planned().root))
+    return out
+
+
+def test_repeated_subplan_flags_distinct_duplicates(ctx, tmp_path):
+    from dpark_tpu.analysis.plan_rules import (Report,
+                                               _rule_repeated_subplan)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    q1 = _group(ctx, path)
+    q2 = _group(ctx, path)
+    rep = Report()
+    _rule_repeated_subplan(_lineage_of(q1, q2), rep)
+    hits = [f for f in rep.findings if f.rule == "repeated-subplan"]
+    # maximal-only: the duplicated Filter inside the duplicated
+    # GroupAgg is the SAME finding, not a second one
+    assert len(hits) == 1, [f.message for f in rep.findings]
+    assert "GroupAgg" in hits[0].message
+
+
+def test_repeated_subplan_shared_objects_clean(ctx, tmp_path):
+    from dpark_tpu.analysis.plan_rules import (Report,
+                                               _rule_repeated_subplan)
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    q = _group(ctx, path)
+    rep = Report()
+    # the same plan walked twice is ONE evaluation (same object ids)
+    _rule_repeated_subplan(_lineage_of(q, q), rep)
+    assert not [f for f in rep.findings
+                if f.rule == "repeated-subplan"]
+
+
+def test_repeated_subplan_bare_scans_clean(ctx, tmp_path):
+    from dpark_tpu.analysis.plan_rules import (Report,
+                                               _rule_repeated_subplan)
+    from dpark_tpu.query import logical
+    path = tmp_path / "tab"
+    _write(path, _rows(), ["t", "k", "a"])
+    src = _table(ctx, path)
+    pq = src.where("t >= 0")._planned()
+    scan = pq.segs[0].scan
+    rep = Report()
+    _rule_repeated_subplan(
+        [logical.Scan(scan.source, list(scan.fields), "events"),
+         logical.Scan(scan.source, list(scan.fields), "events")],
+        rep)
+    assert not [f for f in rep.findings
+                if f.rule == "repeated-subplan"]

@@ -23,6 +23,7 @@ Tracked ratios (whatever the run emitted):
     coded_overhead            rs(4,2) no-fault overhead (<= 1.15)
     adapt_warm_vs_cold        warm wall / cold wall (< 1)
     service_warm_submit       cold/warm first-wave latency (>= 3)
+    result_reuse              repeated-query cold/warm wall (>= 5)
     health_plane_overhead     sink on/off wall ratio (<= 1.03)
     ledger_plane_overhead     ledger on/off wall ratio (<= 1.03)
     lockcheck_overhead        sanitizer on/off wall ratio (<= 1.03)
@@ -53,6 +54,7 @@ HEADLINES = {
     "adapt_warm_vs_cold": ("adapt_warm_vs_cold", False),
     "service_warm_submit": ("service_warm_submit", True),
     "aot_restart": ("aot_restart", True),
+    "result_reuse": ("result_reuse", True),
     "health_plane_overhead": ("health_plane_overhead", False),
     "ledger_plane_overhead": ("ledger_plane_overhead", False),
     "lockcheck_overhead": ("lockcheck_overhead", False),
