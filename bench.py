@@ -896,6 +896,198 @@ def _adapt_phase():
     print("ADAPT_RESULT %s" % json.dumps(out), flush=True)
 
 
+def _code_adapt_phase():
+    """Child-process entry: straggler-adaptive coding + skew re-plan
+    A/B (ISSUE 19 acceptance).
+
+    adaptive_code: two shuffle exchanges on one local master — a HOT
+    site whose learn-pass fetches consume parity under seeded shard
+    failures, and a COLD site with tight recorded tails.  The static
+    leg codes BOTH exchanges rs(4,2); the adaptive leg
+    (DPARK_CODE_ADAPT over the same global code) re-prices per
+    exchange — hot stays escalated (it demonstrably decoded), cold
+    PINS UNCODED and sheds its parity bytes.  Both legs time the same
+    graded pass under the same injected per-peer fetch delay, so the
+    acceptance reads directly off the JSON: adaptive wall <= 1.1x
+    static at LOWER total parity bytes.
+
+    skew_replan: a dominant-bucket reduceByKey on the multiprocess
+    master — with DPARK_REPLAN off, one reduce task drags ~the whole
+    exchange; on, the mid-job salted re-split spreads it across the
+    worker pool with zero map recomputes, and the SECOND run
+    pre-salts at plan time (same stage count as the off leg, only
+    the salt differs — the steady-state improvement)."""
+    import operator
+    import tempfile
+
+    from dpark_tpu import DparkContext, adapt, coding, conf, faults
+    from dpark_tpu.health import Sketch
+    from dpark_tpu.utils.phash import portable_hash
+
+    n = int(os.environ.get("BENCH_CODE_ADAPT_PAIRS", "200000"))
+    reps = max(2, int(os.environ.get("BENCH_CODE_ADAPT_REPS", "3")))
+    delay_spec = os.environ.get(
+        "BENCH_CODE_ADAPT_DELAY",
+        "shuffle.fetch:p=0.4,seed=9,kind=delay,ms=15")
+    fail_spec = "shuffle.fetch:p=0.2,seed=7"
+
+    def hot(c):
+        return (c.parallelize(range(n), 4)
+                .map(lambda i: (i % 5003, i))
+                .reduceByKey(operator.add, 4).count())
+
+    def cold(c):
+        return (c.parallelize(range(n), 4)
+                .map(lambda i: (i % 5003, i))
+                .reduceByKey(operator.add, 4).count())
+
+    def graded_pass(ctx):
+        """Time cold FIRST (its code choice must not see the delayed
+        fetches), then hot under the injected per-peer delay; parity
+        is the delta over exactly this window."""
+        p0 = coding.parity_bytes()
+        t_cold = 1e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            assert cold(ctx) == min(5003, n)
+            t_cold = min(t_cold, time.perf_counter() - t0)
+        faults.configure(delay_spec)
+        try:
+            t_hot = 1e9
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                assert hot(ctx) == min(5003, n)
+                t_hot = min(t_hot, time.perf_counter() - t0)
+        finally:
+            faults.configure(None)
+        return t_hot, t_cold, coding.parity_bytes() - p0
+
+    # --- static leg: one global rs(4,2) codes every exchange --------
+    adapt.configure(mode="off")
+    conf.CODE_ADAPT = False
+    coding.configure("rs(4,2)")
+    coding.clear_shuffle_codes()
+    ctx = DparkContext("local")
+    ctx.start()
+    hot(ctx)
+    cold(ctx)                           # warm imports / page cache
+    t_hot_s, t_cold_s, parity_static = graded_pass(ctx)
+    ctx.stop()
+
+    # --- adaptive leg: same global, per-exchange re-pricing ---------
+    adapt.configure(mode="on", store_dir=tempfile.mkdtemp(
+        prefix="dpark-code-adapt-"))
+    conf.CODE_ADAPT = True
+    coding.configure("rs(4,2)")
+    coding.clear_shuffle_codes()
+    ctx = DparkContext("local")
+    ctx.start()
+    faults.configure(fail_spec)         # learn pass: hot decodes
+    try:
+        hot(ctx)
+    finally:
+        faults.configure(None)
+    cold(ctx)                           # learn pass: cold stays clean
+    # the serving peer's fetch-tail record (PR 14's input): tight —
+    # only OBSERVED decode consumption may escalate an exchange
+    sk = Sketch()
+    for _ in range(35):
+        sk.add(0.005)
+    adapt.record_site_tail("fetch.bucket:local", sk.to_dict())
+    t_hot_a, t_cold_a, parity_adapt = graded_pass(ctx)
+    hist = coding.code_history()
+    hot_escalated = any(c["applied"] and c["code"] != "off"
+                        for c in hist)
+    cold_pinned = any(c["applied"] and c["code"] == "off"
+                      for c in hist)
+    ctx.stop()
+    coding.configure(None)
+    coding.clear_shuffle_codes()
+    conf.CODE_ADAPT = False
+
+    # --- skew re-plan A/B on the multiprocess master ----------------
+    # every key collides into ONE hash bucket; incompressible ~50-byte
+    # values make the dominant bucket's fetch+merge the reduce-side
+    # cost the re-split spreads across the worker pool
+    nk = int(os.environ.get("BENCH_REPLAN_KEYS", "300000"))
+    width = 4
+    skew_keys = [k for k in range(nk * 5)
+                 if portable_hash(k) % width == 0][:nk]
+    skew_data = [(k, ("%d" % (k * 2654435761)) * 5)
+                 for k in skew_keys] * 2
+    expect = len(skew_keys)
+
+    def skew(c):
+        return (c.parallelize(skew_data, 4)
+                .reduceByKey(operator.add, width).count())
+
+    def reduce_wall(rec):
+        # the RESULT stage's wall — the reduce side the re-plan grades
+        return [st.get("seconds") for st in rec.get("stage_info", ())
+                if not st.get("shuffle")][-1]
+
+    adapt.configure(mode="on", store_dir=tempfile.mkdtemp(
+        prefix="dpark-replan-"))
+    old_replan = (conf.REPLAN, conf.REPLAN_MIN_BYTES)
+    conf.REPLAN = False
+    ctxp = DparkContext("process:2")
+    ctxp.start()
+    try:
+        assert skew(ctxp) == expect     # warm the forkserver pool
+        t_off = red_off = 1e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            assert skew(ctxp) == expect
+            t_off = min(t_off, time.perf_counter() - t0)
+            red_off = min(red_off,
+                          reduce_wall(ctxp.scheduler.history[-1]))
+        conf.REPLAN = True
+        conf.REPLAN_MIN_BYTES = 64
+        t0 = time.perf_counter()
+        assert skew(ctxp) == expect     # re-plans mid-job
+        t_replan = time.perf_counter() - t0
+        rec = ctxp.scheduler.history[-1]
+        t_presalt = red_presalt = 1e9   # steady state: salted at plan
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            assert skew(ctxp) == expect
+            t_presalt = min(t_presalt, time.perf_counter() - t0)
+            red_presalt = min(red_presalt,
+                              reduce_wall(ctxp.scheduler.history[-1]))
+        rec2 = ctxp.scheduler.history[-1]
+        replan = {
+            "t_off_s": round(t_off, 3),
+            "t_replan_s": round(t_replan, 3),
+            "t_presalt_s": round(t_presalt, 3),
+            "reduce_off_s": round(red_off, 3),
+            "reduce_presalt_s": round(red_presalt, 3),
+            "replans": int(rec.get("replans") or 0),
+            "resubmits": int(rec.get("resubmits") or 0),
+            "recomputes": int(rec.get("recomputes") or 0),
+            "replan_reason": next(
+                (st.get("replan_reason")
+                 for st in rec.get("stage_info", ())
+                 if st.get("replan_reason")), None),
+            "presalt_replans": int(rec2.get("replans") or 0),
+            "keys": nk, "width": width}
+    finally:
+        ctxp.stop()
+        (conf.REPLAN, conf.REPLAN_MIN_BYTES) = old_replan
+        adapt.configure(mode="observe")
+
+    print("CODE_ADAPT_RESULT %s" % json.dumps(
+        {"static": {"t_hot_s": round(t_hot_s, 3),
+                    "t_cold_s": round(t_cold_s, 3),
+                    "parity_bytes": parity_static},
+         "adaptive": {"t_hot_s": round(t_hot_a, 3),
+                      "t_cold_s": round(t_cold_a, 3),
+                      "parity_bytes": parity_adapt},
+         "hot_escalated": hot_escalated,
+         "cold_pinned_uncoded": cold_pinned,
+         "pairs": n, "reps": reps,
+         "replan": replan}), flush=True)
+
+
 def _svc_add(a, b):
     # module-level on purpose: the warm-submit A/B re-builds the DAG,
     # and a stable function identity is what lets the program cache
@@ -1561,6 +1753,9 @@ def main():
     if "--adapt-only" in sys.argv:
         _adapt_phase()
         return
+    if "--code-adapt-only" in sys.argv:
+        _code_adapt_phase()
+        return
     if "--service-only" in sys.argv:
         _service_phase()
         return
@@ -1833,6 +2028,41 @@ def main():
             if emulated:
                 aout["emulated_cpu_mesh"] = True
             print(json.dumps(aout))
+    # straggler-adaptive coding + skew re-plan A/B (ISSUE 19
+    # acceptance): per-exchange (k,m) re-pricing must hold wall within
+    # 1.1x of a global static rs(4,2) under the same injected fetch
+    # delay while shedding the tight-tailed exchange's parity bytes;
+    # the skew re-plan leg reports the dominant-bucket reduce wall
+    # off-vs-presalted with zero resubmits/recomputes
+    if os.environ.get("BENCH_CODE_ADAPT", "1") != "0":
+        got = _run_child("--code-adapt-only", child_timeout,
+                         ok_prefix="CODE_ADAPT_RESULT ")
+        if got is not None:
+            ca = json.loads(got)
+            st, ad = ca["static"], ca["adaptive"]
+            wall_s = st["t_hot_s"] + st["t_cold_s"]
+            wall_a = ad["t_hot_s"] + ad["t_cold_s"]
+            caout = {"metric": "adaptive_code",
+                     "value": round(wall_a / max(wall_s, 1e-9), 3),
+                     "unit": ("x wall vs static rs(4,2) (lower is "
+                              "better; <=1.1 at lower parity passes)"),
+                     "static": st, "adaptive": ad,
+                     "parity_ratio": round(
+                         ad["parity_bytes"]
+                         / max(st["parity_bytes"], 1), 3),
+                     "hot_escalated": ca["hot_escalated"],
+                     "cold_pinned_uncoded": ca["cold_pinned_uncoded"],
+                     "pairs": ca["pairs"], "reps": ca["reps"]}
+            print(json.dumps(caout))
+            rp = ca["replan"]
+            rpout = {"metric": "skew_replan",
+                     "value": round(rp["reduce_off_s"]
+                                    / max(rp["reduce_presalt_s"],
+                                          1e-9), 3),
+                     "unit": ("x reduce-stage wall, skewed vs "
+                              "pre-salted (higher is better)"),
+                     **rp}
+            print(json.dumps(rpout))
     # resident-service A/B (ISSUE 9 acceptance): a warm re-submission
     # of an identical DAG to the resident server must perform 0 stage
     # re-compiles (cache counters) and cut submit-to-first-wave

@@ -73,7 +73,8 @@ _mode = None                  # resolved mode, or None = read conf lazily
 _dir = None                   # resolved store dir, or None = read conf
 _loaded = False
 _agg = {"wave_budget": {}, "stage": {}, "skew": {}, "combine": {},
-        "pane": {}, "site": {}, "prog": {}, "reuse": {}}
+        "pane": {}, "site": {}, "prog": {}, "reuse": {}, "xch": {},
+        "replan": {}}
 _counters = {"store_hits": 0, "store_misses": 0, "steered": 0,
              "recorded": 0, "skipped_lines": 0}
 _decisions = []
@@ -258,6 +259,15 @@ def _compact_locked(path):
         recs.append({"k": "prog", "key": key, "profile": dict(ent)})
     for key, ent in _agg["reuse"].items():
         recs.append(dict(ent, k="reuse", key=key))
+    for key, ent in _agg["xch"].items():
+        rec = {"k": "xch", "key": key,
+               "peers": {p: dict(c)
+                         for p, c in ent.get("peers", {}).items()}}
+        if ent.get("fetch_ms") is not None:
+            rec["fetch_ms"] = round(float(ent["fetch_ms"]), 2)
+        recs.append(rec)
+    for key, ent in _agg["replan"].items():
+        recs.append(dict(ent, k="replan", key=key))
     try:
         from dpark_tpu.utils import frame_jsonl
         tmp = path + ".compact.%d" % os.getpid()
@@ -376,6 +386,36 @@ def _apply(rec):
             key, {"hits": 0, "misses": 0, "partials": 0})
         for k in ("hits", "misses", "partials"):
             ent[k] = int(ent.get(k, 0)) + int(rec.get(k, 0) or 0)
+    elif kind == "xch":
+        # per-exchange peer profile (ISSUE 19): which peers served one
+        # shuffle call site, with per-peer fetch counts and decode
+        # outcomes accumulated across runs — the straggler-adaptive
+        # code policy joins these peers against the "site" tail
+        # sketches to price (k, m) for the NEXT run of this exchange
+        ent = _agg["xch"].setdefault(key, {"peers": {}, "n": 0})
+        for p, counts in (rec.get("peers") or {}).items():
+            pc = ent["peers"].setdefault(str(p), {})
+            for ck, cv in (counts or {}).items():
+                try:
+                    pc[ck] = int(pc.get(ck, 0)) + int(cv)
+                except (TypeError, ValueError):
+                    pass
+        ent["n"] = int(ent.get("n", 0)) + 1
+        if rec.get("fetch_ms") is not None:
+            ms = float(rec["fetch_ms"])
+            cur = ent.get("fetch_ms")
+            ent["fetch_ms"] = ms if cur is None \
+                else cur * (1 - _EMA) + ms * _EMA
+    elif kind == "replan":
+        # mid-job re-plan outcome (ISSUE 19): the salted re-split the
+        # scheduler performed (or, in observe mode, would have) for a
+        # shuffle call site — latest-wins, consumed by suggest_salt()
+        # so the next run of the shape salts at PLAN time instead of
+        # paying the mid-job re-split again
+        _agg["replan"][key] = {
+            "parts": int(rec.get("parts", 0)),
+            "salt": int(rec.get("salt", 0)),
+            "frac": float(rec.get("frac", 0.0))}
     elif kind == "pane":
         # per-(stream signature) windowed-emit tick cost by pane
         # strategy ("tree" | "flat" | "inv"): the split-point pricing
@@ -978,5 +1018,176 @@ def site_tails():
         _ensure_loaded()
         with _lock:
             return {k: dict(v) for k, v in _agg["site"].items()}
+    except Exception:
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# decision point 6: per-exchange (k, m) from recorded peer tails
+# (ISSUE 19 tentpole 1 — the ROADMAP item-4 consumer of the site tails)
+# ---------------------------------------------------------------------------
+
+def choose_shuffle_code(site, static_spec=None):
+    """Price the erasure code for one exchange (identified by its
+    shuffle call site) from the store: the peers recorded serving it
+    ("xch" records), their fetch-tail sketches ("site" records, keyed
+    fetch.bucket:<peer>), and their accumulated decode outcomes.
+    Returns the chosen spec string when the policy should steer this
+    run, else None (no history / policy off / observe mode — the
+    static DPARK_SHUFFLE_CODE stands).  Every actionable choice logs
+    as decision point "code"; a steered one stays pending until
+    observe_exchange() attaches the observed fetch wall."""
+    try:
+        from dpark_tpu import coding
+        if not enabled() or not site \
+                or not getattr(conf, "CODE_ADAPT", False):
+            return None
+        _ensure_loaded()
+        with _lock:
+            ent = _agg["xch"].get(str(site))
+        if ent is None or not ent.get("peers"):
+            _counters["store_misses"] += 1
+            return None
+        peers = sorted(ent["peers"])
+        all_tails = site_tails()
+        tails = {p: all_tails.get("fetch.bucket:%s" % p)
+                 for p in peers}
+        spec, reason, predicted = coding.choose_code(
+            peers, tails, ent["peers"], static_spec)
+        if spec is None:
+            _counters["store_misses"] += 1
+            return None
+        _counters["store_hits"] += 1
+        if not steering():
+            _decide("code", site, spec, reason,
+                    predicted_ms=predicted, applied=False)
+            coding.record_choice(str(site), spec, reason, False,
+                                 predicted)
+            return None
+        d = _decide("code", site, spec, reason,
+                    predicted_ms=predicted)
+        coding.record_choice(str(site), spec, reason, True, predicted)
+        with _lock:
+            _pending["code|%s" % site] = d
+        return spec
+    except Exception as e:
+        logger.debug("choose_shuffle_code failed: %s", e)
+        return None
+
+
+def observe_exchange(site, peers, fetch_ms=None):
+    """Persist which peers served one exchange this run — `peers` is
+    {peer: {"fetches"/"repair"/"straggler_win"/"decode_failures": n}}
+    — and complete a pending code decision with the observed fetch
+    wall, so the policy is graded by its own telemetry (predicted vs
+    observed ms on the job record)."""
+    try:
+        if not enabled() or not site or not peers:
+            return
+        rec_peers = {}
+        for p, counts in peers.items():
+            cc = {k: int(v) for k, v in (counts or {}).items()
+                  if isinstance(v, (int, float)) and v}
+            if cc:
+                rec_peers[str(p)] = cc
+        if not rec_peers:
+            return
+        rec = {"k": "xch", "key": str(site), "peers": rec_peers}
+        if fetch_ms is not None:
+            rec["fetch_ms"] = round(float(fetch_ms), 2)
+        _append(rec)
+        with _lock:
+            d = _pending.pop("code|%s" % site, None)
+        if d is not None and fetch_ms is not None:
+            d["observed_ms"] = round(float(fetch_ms), 2)
+    except Exception as e:
+        logger.debug("observe_exchange failed: %s", e)
+
+
+def exchange_profiles():
+    """{site: {"peers": {peer: counts}, "n", "fetch_ms"}} — every
+    persisted per-exchange peer profile (tests / debugging)."""
+    try:
+        if not enabled():
+            return {}
+        _ensure_loaded()
+        with _lock:
+            return {k: {"peers": {p: dict(c)
+                                  for p, c in v.get("peers",
+                                                    {}).items()},
+                        "n": v.get("n", 0),
+                        "fetch_ms": v.get("fetch_ms")}
+                    for k, v in _agg["xch"].items()}
+    except Exception:
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# decision point 7: mid-job re-plan of a skewed reduce side
+# ---------------------------------------------------------------------------
+
+def note_replan(site, parts, salt, frac, applied):
+    """Log the mid-job re-plan decision (decision point 7) taken — or,
+    in observe mode, declined — by the scheduler at a stage boundary,
+    and persist the replan record so the NEXT run of this call site
+    salts its partitioner at plan time (suggest_salt) instead of
+    paying the re-split again.  Returns the reason string the
+    scheduler records as the consumer stage's `replan_reason`."""
+    reason = ("map-side bucket histogram at %s: dominant bucket "
+              "%.0f%% of exchange bytes across width %d — re-keying "
+              "the reduce side through a salted re-split (salt=%d), "
+              "no map task recomputed" % (site, frac * 100, parts,
+                                          salt))
+    try:
+        if not enabled() or not site:
+            return reason
+        _decide("replan", site, "resplit(salt=%d)" % int(salt),
+                reason, applied=bool(applied))
+        _append({"k": "replan", "key": str(site), "parts": int(parts),
+                 "salt": int(salt), "frac": round(float(frac), 4)})
+    except Exception as e:
+        logger.debug("note_replan failed: %s", e)
+    return reason
+
+
+def suggest_salt(site):
+    """Plan-time twin of the mid-job re-plan: a recorded re-plan for
+    this call site returns its salt so combineByKey builds the salted
+    partitioner up front — the map side then writes balanced buckets
+    and the mid-job probe finds nothing to re-split (the "skip
+    already-replanned shapes" contract).  0 = no salt / not steering."""
+    try:
+        if not enabled() or not site \
+                or not getattr(conf, "REPLAN", False):
+            return 0
+        _ensure_loaded()
+        with _lock:
+            ent = _agg["replan"].get(str(site))
+        if not ent or not ent.get("salt"):
+            return 0
+        _counters["store_hits"] += 1
+        reason = ("recorded re-plan at %s (dominant bucket %.0f%% of "
+                  "exchange bytes): salting the partitioner at plan "
+                  "time" % (site, ent.get("frac", 0.0) * 100))
+        if not steering():
+            _decide("replan", site, "salt=%d" % ent["salt"], reason,
+                    applied=False)
+            return 0
+        _decide("replan", site, "salt=%d" % ent["salt"], reason)
+        return int(ent["salt"])
+    except Exception as e:
+        logger.debug("suggest_salt failed: %s", e)
+        return 0
+
+
+def replan_profiles():
+    """{site: {"parts", "salt", "frac"}} — every persisted re-plan
+    record (tests / debugging)."""
+    try:
+        if not enabled():
+            return {}
+        _ensure_loaded()
+        with _lock:
+            return {k: dict(v) for k, v in _agg["replan"].items()}
     except Exception:
         return {}

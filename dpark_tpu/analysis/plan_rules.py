@@ -46,6 +46,13 @@ shuffle anti-patterns that dominate cost at production scale:
                          source: schema drift left the store's hints
                          stale, so the first run re-walks the OOM
                          ladder instead of seeding.
+  static-code-hint       the pinned DPARK_SHUFFLE_CODE contradicts
+                         the adapt store's recorded per-peer fetch
+                         tails: parity everywhere while every peer is
+                         tight (wasted tax), or no parity while a
+                         recorded peer straggles (lineage replay on
+                         every slow fetch).  Quiet when
+                         DPARK_CODE_ADAPT re-prices per exchange.
   trace-overhead-hint    DPARK_TRACE=spool with a reduce side whose
                          estimated spool writes per task (one fetch
                          span per parent map bucket) exceed
@@ -337,6 +344,12 @@ def _rule_unbounded_recovery(rdd, report, excess):
     # bound recovery under injection
     code = coding.active_code()
     if code is not None and code.m >= 1:
+        return
+    # adaptive per-exchange codes quiet it too (ISSUE 19): the policy
+    # can escalate any exchange whose peers demonstrably straggle to
+    # m >= 1 parity mid-fleet, so recovery under injection is bounded
+    # by decode even with the static code off
+    if coding.adaptive_enabled():
         return
     depth, limit = excess
     report.add(
@@ -657,7 +670,75 @@ def _rule_adapt_stale_hint(r, report):
         "adapt.reset_store()) to drop stale entries"
         + ("" if adapt.steering() else
            " (note: DPARK_ADAPT=%s only records — budgets would "
-           "steer under DPARK_ADAPT=on)" % adapt.mode()))
+           "steer under DPARK_ADAPT=on)" % adapt.mode())
+        + (" (per-exchange code choices are unaffected: they key by "
+           "shuffle call site, not row width — DPARK_CODE_ADAPT "
+           "keeps steering across a schema change)"
+           if _coding_adaptive() else ""))
+
+
+def _coding_adaptive():
+    try:
+        from dpark_tpu import coding
+        return bool(coding.adaptive_enabled())
+    except Exception:
+        return False
+
+
+def _rule_static_code_hint(rdd, report):
+    """The pinned DPARK_SHUFFLE_CODE contradicts the adapt store's
+    recorded per-peer fetch tails (ISSUE 19): parity on every bucket
+    while every recorded peer is tight wastes encode CPU and shuffle
+    bytes; no parity while a recorded peer demonstrably straggles
+    leaves recovery to lineage replay.  Quiet when the adaptive
+    per-exchange policy is on (DPARK_CODE_ADAPT re-prices each
+    exchange, superseding the pin), with DPARK_ADAPT off, and with no
+    recorded fetch tails."""
+    try:
+        from dpark_tpu import adapt, coding, conf
+        from dpark_tpu.health import Sketch
+        if not adapt.enabled() or coding.adaptive_enabled():
+            return
+        ratio_bar = float(getattr(conf, "CODE_ADAPT_TAIL_RATIO", 3.0))
+        min_n = int(getattr(conf, "CODE_ADAPT_MIN_SAMPLES", 8) or 1)
+        worst = None                          # (ratio, peer)
+        for site, digest in adapt.site_tails().items():
+            site = str(site)
+            if not site.startswith("fetch.bucket:"):
+                continue
+            sk = Sketch.from_dict(digest)
+            if sk.n < min_n or sk.sum <= 0:
+                continue
+            p50 = sk.quantile(0.50) or 0.0
+            p99 = sk.quantile(0.99) or 0.0
+            ratio = (p99 / p50) if p50 > 0 else 0.0
+            if worst is None or ratio > worst[0]:
+                worst = (ratio, site[len("fetch.bucket:"):])
+        if worst is None:
+            return
+        ratio, peer = worst
+        code = coding.active_code()
+        protected = code is not None and code.m >= 1
+    except Exception:
+        return
+    if protected and ratio < ratio_bar:
+        report.add(
+            "static-code-hint", "info", rdd.scope_name,
+            "DPARK_SHUFFLE_CODE=%s pays parity on every bucket, but "
+            "every recorded peer fetch tail is tight (worst p99/p50 "
+            "%.1f < %.1f) — the parity tax buys nothing here"
+            % (coding.describe(), ratio, ratio_bar),
+            "drop the static code, or set DPARK_CODE_ADAPT=1 to "
+            "price parity per exchange from the recorded tails")
+    elif not protected and ratio >= ratio_bar:
+        report.add(
+            "static-code-hint", "warn", rdd.scope_name,
+            "no parity is pinned (DPARK_SHUFFLE_CODE=%s) but recorded "
+            "peer %s straggles (fetch tail p99/p50 %.1f >= %.1f) — "
+            "every slow or lost fetch from it replays lineage"
+            % (coding.describe(), peer, ratio, ratio_bar),
+            "pin a code with m >= 1, or set DPARK_CODE_ADAPT=1 to "
+            "escalate only the exchanges that peer serves")
 
 
 def _width_hint(r, depth=0):
@@ -849,4 +930,5 @@ def lint_plan(rdd, master="local", report=None, lineage=None):
     excess = _excess_wide_depth(rdd)
     _rule_wide_depth(rdd, report, excess)
     _rule_unbounded_recovery(rdd, report, excess)
+    _rule_static_code_hint(rdd, report)
     return report

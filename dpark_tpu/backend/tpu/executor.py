@@ -3314,7 +3314,11 @@ class JAXExecutor:
     def _export_shard(self, sid, map_id, reduce_id, idx):
         from dpark_tpu import coding
         from dpark_tpu.utils import compress
-        code = coding.active_code()
+        # per-exchange code (ISSUE 19): an adaptively-escalated
+        # shuffle serves coded frames even with the global code off,
+        # and a pinned-uncoded one refuses the shard protocol so the
+        # fetch side falls back to whole buckets
+        code = coding.shuffle_code(sid)
         if code is None:
             raise ValueError(
                 "shard export requested with no shuffle code active")

@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 
 from dpark_tpu.backend.tpu import layout
-from dpark_tpu.dependency import HashPartitioner, RangePartitioner
+from dpark_tpu.dependency import (
+    HashPartitioner, RangePartitioner, SaltedHashPartitioner)
 from dpark_tpu.rdd import (
     CoGroupedRDD, CSVFileRDD, CSVReaderRDD, DerivedRDD, FilteredRDD,
     FlatMappedRDD, FlatMappedValuesRDD, GZipFileRDD, KeyedRDD,
@@ -56,6 +57,14 @@ def is_list_agg(agg):
 
 def partitioner_spec(part):
     """Device destination function spec for a partitioner, or None."""
+    if isinstance(part, SaltedHashPartitioner):
+        # mid-job re-plan target (ISSUE 19): the device hash kernel
+        # buckets RAW keys — a salted exchange must decline to the
+        # host object path or every row lands in the wrong bucket.
+        # Checked BEFORE HashPartitioner on purpose (it is not a
+        # subclass, but keep the decline explicit and named).
+        return _fallback("salted partitioner (mid-job re-plan) "
+                         "has no device hash kernel")
     if isinstance(part, HashPartitioner):
         return ("hash",)
     if isinstance(part, RangePartitioner):

@@ -55,6 +55,9 @@ __all__ = [
     "encode_container", "decode_container", "is_container",
     "parse_container", "extract_container_frame",
     "note", "counters_snapshot", "reset_counters", "stats",
+    "set_shuffle_code", "shuffle_code", "clear_shuffle_codes",
+    "note_parity_bytes", "parity_bytes", "choose_code",
+    "record_choice", "code_history", "adaptive_enabled",
 ]
 
 ALGO_XOR = 0
@@ -490,6 +493,20 @@ _LOCK = threading.Lock()
 _KINDS = ("repair", "straggler_win", "decode_failures")
 _TOTALS = {k: 0 for k in _KINDS}
 _PER_SHUFFLE = {}
+_PER_PEER = {}
+_PARITY_BYTES = [0]
+
+# per-shuffle code overrides (ISSUE 19): the straggler-adaptive policy
+# prices (k,m) PER EXCHANGE, so one process can be writing rs(4,2)
+# containers for a straggly exchange while a tight one stays plain.
+# The registry maps shuffle_id -> Code (None = explicitly uncoded);
+# unregistered shuffles use the global _CODE.  Both the map side
+# (ShuffleMapTask.run) and the reduce side (ShuffledRDD /
+# CoGroupedRDD.compute) register from the serialized dep before
+# touching buckets, so worker processes see the driver's choice.
+_SHUFFLE_CODES = {}
+_SHUFFLE_CODES_CAP = 1024
+_UNSET = object()
 
 
 def configure(spec=None):
@@ -512,20 +529,41 @@ def describe():
     return _CODE.describe() if _CODE is not None else "off"
 
 
-def note(kind, shuffle_id=None):
+def note(kind, shuffle_id=None, peer=None):
     """Count a decode outcome, attributed to `shuffle_id` when the
-    caller knows it (bucket fetches do; spill-run decodes don't)."""
+    caller knows it (bucket fetches do; spill-run decodes don't) and
+    to the serving `peer` (ISSUE 19 satellite: /metrics and the health
+    plane name WHICH peer's straggling triggered an escalation)."""
     with _LOCK:
         _TOTALS[kind] += 1
         if shuffle_id is not None:
             per = _PER_SHUFFLE.setdefault(
                 shuffle_id, {k: 0 for k in _KINDS})
             per[kind] += 1
+        if peer is not None:
+            pp = _PER_PEER.setdefault(
+                str(peer), {k: 0 for k in _KINDS})
+            pp[kind] += 1
     from dpark_tpu import trace
     if trace._PLANE is not None:
         # timeline twin of the counter (ISSUE 8): each decode outcome
         # is an instant event on the fetching task's span context
-        trace.event("decode." + kind, "coding", shuffle=shuffle_id)
+        trace.event("decode." + kind, "coding", shuffle=shuffle_id,
+                    peer=peer)
+
+
+def note_parity_bytes(nbytes):
+    """Count parity OVERHEAD bytes written (encoded container/frame
+    bytes minus the original payload) — the adaptive-code bench grades
+    itself on total parity bytes vs the static code."""
+    if nbytes > 0:
+        with _LOCK:
+            _PARITY_BYTES[0] += int(nbytes)
+
+
+def parity_bytes():
+    with _LOCK:
+        return _PARITY_BYTES[0]
 
 
 def counters_snapshot():
@@ -534,7 +572,10 @@ def counters_snapshot():
     with _LOCK:
         return {"totals": dict(_TOTALS),
                 "per_shuffle": {sid: dict(c)
-                                for sid, c in _PER_SHUFFLE.items()}}
+                                for sid, c in _PER_SHUFFLE.items()},
+                "per_peer": {p: dict(c)
+                             for p, c in _PER_PEER.items()},
+                "parity_bytes": _PARITY_BYTES[0]}
 
 
 def reset_counters():
@@ -542,16 +583,138 @@ def reset_counters():
         for k in _KINDS:
             _TOTALS[k] = 0
         _PER_SHUFFLE.clear()
+        _PER_PEER.clear()
+        _PARITY_BYTES[0] = 0
 
 
 def stats():
-    """{mode, repair, straggler_win, decode_failures} — the bench
-    JSON's `decodes` section and recovery_summary()'s decode view
-    (decode_failures stays distinct from plain fetch failures)."""
+    """{mode, repair, straggler_win, decode_failures, parity_bytes,
+    per_peer} — the bench JSON's `decodes` section and
+    recovery_summary()'s decode view (decode_failures stays distinct
+    from plain fetch failures)."""
     with _LOCK:
         out = dict(_TOTALS)
+        out["parity_bytes"] = _PARITY_BYTES[0]
+        out["per_peer"] = {p: dict(c) for p, c in _PER_PEER.items()}
     out["mode"] = describe()
     return out
+
+
+# ---------------------------------------------------------------------------
+# straggler-adaptive per-exchange code selection (ISSUE 19 tentpole 1)
+# ---------------------------------------------------------------------------
+
+def set_shuffle_code(shuffle_id, spec):
+    """Install a per-shuffle code override from a spec string.  "off"
+    pins the exchange uncoded (overriding a global code); None clears
+    the override (global code applies).  Malformed specs raise
+    ValueError, same contract as configure()."""
+    code = parse_code(spec) if spec is not None else _UNSET
+    with _LOCK:
+        if code is _UNSET:
+            _SHUFFLE_CODES.pop(shuffle_id, None)
+            return None
+        if len(_SHUFFLE_CODES) >= _SHUFFLE_CODES_CAP \
+                and shuffle_id not in _SHUFFLE_CODES:
+            # bounded: a long-lived service mints shuffle ids forever
+            _SHUFFLE_CODES.pop(next(iter(_SHUFFLE_CODES)))
+        _SHUFFLE_CODES[shuffle_id] = code
+    return code
+
+
+def shuffle_code(shuffle_id):
+    """The code governing one exchange: its registered override when
+    the adaptive policy priced it, else the global active code.  Both
+    the bucket writer and the fetch path resolve through here, so a
+    mixed-code run stays self-consistent end to end."""
+    with _LOCK:
+        if shuffle_id in _SHUFFLE_CODES:
+            return _SHUFFLE_CODES[shuffle_id]
+    return _CODE
+
+
+def clear_shuffle_codes():
+    with _LOCK:
+        _SHUFFLE_CODES.clear()
+
+
+_CHOICES = []
+_CHOICES_CAP = 256
+
+
+def record_choice(site, spec, reason, applied, predicted_ms=None):
+    """Append one (k,m) policy choice to the bounded in-process
+    history — rides /api/health's executor evidence so an operator can
+    see the chosen code tracking the observed tails."""
+    with _LOCK:
+        if len(_CHOICES) >= _CHOICES_CAP:
+            del _CHOICES[0]
+        _CHOICES.append({"site": site, "code": spec,
+                         "reason": reason, "applied": bool(applied),
+                         "predicted_ms": predicted_ms})
+
+
+def code_history():
+    with _LOCK:
+        return [dict(c) for c in _CHOICES]
+
+
+def adaptive_enabled():
+    """True when the per-exchange policy is allowed to STEER: the
+    conf gate is on and the adapt plane is in steering mode."""
+    from dpark_tpu import adapt, conf
+    return bool(getattr(conf, "CODE_ADAPT", False)) and adapt.steering()
+
+
+def choose_code(peers, tails, fault_rates=None, static_spec=None):
+    """Price (k,m) for one exchange from its recorded peers' fetch-tail
+    sketches and observed decode/fault rates.  Pure policy — no store
+    access, no side effects — so tests drive it with synthesized tails.
+
+    `peers`: peer labels recorded for this exchange.
+    `tails`: {peer: sketch digest (health.Sketch.to_dict shape)}.
+    `fault_rates`: {peer or "*": {"repair"/"decode_failures": n}} —
+    any observed repair or decode failure escalates (the exchange
+    demonstrably consumed parity or lost shards).
+
+    Returns (spec, reason, predicted_ms):
+      spec None      -> no history worth acting on; keep the static
+                        code (CODE_ADAPT's do-nothing outcome)
+      spec "off"     -> all recorded peers tight: drop the parity tax
+      spec escalated -> conf.CODE_ADAPT_ESCALATE for this exchange
+    predicted_ms is the policy's own fetch-wall forecast (worst-peer
+    p50 when escalating — fastest-k dodges the tail — else worst-peer
+    p99), recorded against the observed wall by decision point 6."""
+    from dpark_tpu import conf
+    from dpark_tpu.health import Sketch
+    ratio_bar = float(getattr(conf, "CODE_ADAPT_TAIL_RATIO", 3.0))
+    min_n = int(getattr(conf, "CODE_ADAPT_MIN_SAMPLES", 8) or 1)
+    worst = None                      # (ratio, p50_ms, p99_ms, peer)
+    for peer in sorted(set(peers or ())):
+        sk = Sketch.from_dict((tails or {}).get(peer) or {})
+        if sk.n < min_n or sk.sum <= 0:
+            continue
+        p50 = sk.quantile(0.50) or 0.0
+        p99 = sk.quantile(0.99) or 0.0
+        ratio = (p99 / p50) if p50 > 0 else 0.0
+        if worst is None or ratio > worst[0]:
+            worst = (ratio, p50 * 1e3, p99 * 1e3, peer)
+    decoded = sum(int(c.get(k, 0))
+                  for c in (fault_rates or {}).values()
+                  for k in ("repair", "decode_failures"))
+    if worst is None:
+        return (None, "no recorded tails for peers %s"
+                % (sorted(set(peers or ())),), None)
+    ratio, p50_ms, p99_ms, peer = worst
+    if decoded or ratio >= ratio_bar:
+        spec = getattr(conf, "CODE_ADAPT_ESCALATE", "rs(4,2)")
+        why = ("%d decode(s) consumed parity here" % decoded
+               if decoded else
+               "peer %s tail p99/p50 %.1f >= %.1f" % (peer, ratio,
+                                                      ratio_bar))
+        return spec, "escalate: " + why, round(p50_ms, 3)
+    return ("off", "tight tails: worst peer %s p99/p50 %.1f < %.1f"
+            % (peer, ratio, ratio_bar), round(p99_ms, 3))
 
 
 def _init_from_conf():

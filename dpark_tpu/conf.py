@@ -82,6 +82,33 @@ DPARK_SHUFFLE_CODE = os.environ.get("DPARK_SHUFFLE_CODE", "off")
 SHUFFLE_SHARD_ATTEMPTS = int(os.environ.get(
     "DPARK_SHUFFLE_SHARD_ATTEMPTS", "3") or 1)
 
+# straggler-adaptive per-exchange code selection (ISSUE 19): "1" lets
+# the scheduler price (k,m) PER SHUFFLE from the adapt store's
+# per-peer fetch-tail sketches and observed decode/fault rates instead
+# of paying DPARK_SHUFFLE_CODE's static parity tax everywhere —
+# exchanges whose recorded peers straggle (p99/p50 over
+# CODE_ADAPT_TAIL_RATIO) or decoded from parity before escalate to
+# CODE_ADAPT_ESCALATE, exchanges whose peers are uniformly tight drop
+# to uncoded.  Requires DPARK_ADAPT=on to steer; under
+# DPARK_ADAPT=observe choices are logged (applied=false) and the
+# static code runs, bit-identical.  The writer's self-describing frame
+# geometry makes mixed per-shuffle codes safe on the wire.
+CODE_ADAPT = os.environ.get("DPARK_CODE_ADAPT", "0") == "1"
+
+# a recorded peer counts as a straggler when its persisted fetch-tail
+# sketch shows p99/p50 at or above this ratio (and at least
+# CODE_ADAPT_MIN_SAMPLES observations); below it with a bounded p99
+# the exchange is priced tight and runs uncoded
+CODE_ADAPT_TAIL_RATIO = float(os.environ.get(
+    "DPARK_CODE_ADAPT_TAIL_RATIO", "3.0"))
+CODE_ADAPT_MIN_SAMPLES = int(os.environ.get(
+    "DPARK_CODE_ADAPT_MIN_SAMPLES", "8") or 1)
+
+# the code an escalated exchange runs (parse_code grammar); the
+# no-history / insufficient-samples default stays DPARK_SHUFFLE_CODE
+CODE_ADAPT_ESCALATE = os.environ.get(
+    "DPARK_CODE_ADAPT_ESCALATE", "rs(4,2)")
+
 # ---------------------------------------------------------------------------
 # adaptive execution (dpark_tpu/adapt.py — ISSUE 7)
 # ---------------------------------------------------------------------------
@@ -120,6 +147,32 @@ ADAPT_PATH_MARGIN = float(os.environ.get("DPARK_ADAPT_PATH_MARGIN",
 ADAPT_SKEW_FRAC = float(os.environ.get("DPARK_ADAPT_SKEW_FRAC", "0.5"))
 ADAPT_SKEW_WIDEN = int(os.environ.get("DPARK_ADAPT_SKEW_WIDEN",
                                       "2") or 2)
+
+# mid-job re-planning at the stage boundary (ISSUE 19): "1" lets the
+# scheduler re-partition a reduce side BEFORE launching it when the
+# completed map stage's on-disk bucket sizes show hash-collision skew
+# the plan-time guess missed (dominant-bucket byte fraction >=
+# REPLAN_SKEW_FRAC) — a same-width salted re-split stage re-keys the
+# buckets without recomputing any map task (resubmits == recomputes ==
+# 0) and the choice lands as `replan_reason` on the job record plus an
+# adapt "replan" record, so the NEXT run of the same call site salts
+# its partitioner at plan time and skips the mid-job re-split.
+# Requires DPARK_ADAPT=on to steer; observe mode records the would-be
+# re-plan (applied=false) and launches the original reduce side.
+REPLAN = os.environ.get("DPARK_REPLAN", "0") == "1"
+
+# dominant-bucket byte fraction (largest reduce bucket / total bucket
+# bytes across the exchange) at or above which the completed map side
+# counts as skewed enough to re-split; buckets must be file://-local
+# for the driver to size them (device HBM exchanges never re-split —
+# their skew signal is the SegMapOp histogram, adapt decision point 3)
+REPLAN_SKEW_FRAC = float(os.environ.get(
+    "DPARK_REPLAN_SKEW_FRAC", "0.6"))
+
+# floor on total exchange bytes before a re-plan is considered: tiny
+# exchanges re-split slower than they run
+REPLAN_MIN_BYTES = int(os.environ.get(
+    "DPARK_REPLAN_MIN_BYTES", "4096") or 0)
 
 # observed combine ratio (distinct keys / rows) above which map-side
 # pre-aggregation is priced OFF (nearly every key distinct: the

@@ -144,6 +144,39 @@ class HashPartitioner(Partitioner):
         return self.partitions
 
 
+class SaltedHashPartitioner(Partitioner):
+    """Hash partitioner over ``(salt, key)`` — the mid-job re-plan's
+    re-split target (ISSUE 19).  A workload whose keys collide under
+    ``portable_hash(key) % n`` (one dominant bucket, many distinct
+    keys) re-spreads under the salted tuple hash WITHOUT changing the
+    reduce width, so a running job's fixed output_parts stay valid.
+
+    Deliberately NOT a HashPartitioner subclass: the device path's
+    ``partitioner_spec`` hashes raw keys and a cogroup treats equal
+    HashPartitioners as copartitioned — both would silently
+    mis-bucket a salted exchange, so this class compares equal only
+    to an identically-salted peer and the device path declines it."""
+
+    def __init__(self, partitions, salt=1):
+        self.partitions = max(1, int(partitions))
+        self.salt = int(salt)
+
+    @property
+    def num_partitions(self):
+        return self.partitions
+
+    def get_partition(self, key):
+        return portable_hash((self.salt, key)) % self.partitions
+
+    def __eq__(self, other):
+        return (isinstance(other, SaltedHashPartitioner)
+                and other.partitions == self.partitions
+                and other.salt == self.salt)
+
+    def __hash__(self):
+        return hash((self.partitions, self.salt))
+
+
 class RangePartitioner(Partitioner):
     """Sorted-sample range partitioner backing sortByKey (reference:
     dpark RangePartitioner — bounds from a sample, bisect per key)."""

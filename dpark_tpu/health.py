@@ -742,6 +742,23 @@ def api_health(scheduler=None):
     if tenants is not None:
         out["tenants"] = tenants
     try:
+        # straggler-adaptive coded shuffle (ISSUE 19): per-peer decode
+        # outcomes next to the coding grade, and the chosen-(k,m)
+        # history as executor evidence — the operator's answer to
+        # "which peer made the policy escalate, and to what".
+        # Evidence only; grades are unchanged.
+        from dpark_tpu import coding
+        per_peer = coding.stats().get("per_peer") or {}
+        if per_peer and "coding" in out["subsystems"]:
+            out["subsystems"]["coding"]["evidence"]["by_peer"] = \
+                per_peer
+        choices = coding.code_history()
+        if choices and "executor" in out["subsystems"]:
+            out["subsystems"]["executor"]["evidence"][
+                "code_choices"] = choices
+    except Exception:
+        pass
+    try:
         # AOT executable-cache counters (ISSUE 17) for the UI topline
         from dpark_tpu import aotcache
         aot = aotcache.stats()

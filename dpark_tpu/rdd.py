@@ -29,7 +29,8 @@ from collections import Counter
 from dpark_tpu import cache as _cache
 from dpark_tpu.dependency import (
     Aggregator, CartesianDependency, HashPartitioner, OneToOneDependency,
-    RangeDependency, RangePartitioner, ShuffleDependency)
+    RangeDependency, RangePartitioner, SaltedHashPartitioner,
+    ShuffleDependency)
 from dpark_tpu.utils import atomic_file, user_call_site
 from dpark_tpu.utils.log import get_logger
 
@@ -471,7 +472,15 @@ class RDD:
             numSplits = adapt.suggest_partitions(
                 site, self.ctx.default_parallelism)
         agg = Aggregator(createCombiner, mergeValue, mergeCombiners)
-        shuffled = ShuffledRDD(self, agg, HashPartitioner(numSplits))
+        # mid-job re-plan memory (ISSUE 19): a site the scheduler
+        # already re-keyed pre-salts at plan time, so the run-2 probe
+        # finds a balanced histogram and skips the re-split stage
+        salt = adapt.suggest_salt(site)
+        if salt:
+            part = SaltedHashPartitioner(numSplits, salt)
+        else:
+            part = HashPartitioner(numSplits)
+        shuffled = ShuffledRDD(self, agg, part)
         shuffled.dep.adapt_site = site
         return shuffled
 
@@ -1311,9 +1320,14 @@ class ShuffledRDD(RDD):
                 for i in range(self.partitioner.num_partitions)]
 
     def compute(self, split):
-        from dpark_tpu import conf
+        from dpark_tpu import coding, conf
         from dpark_tpu.env import env
         from dpark_tpu.shuffle import DiskSpillMerger, SortMerger
+        # the per-exchange code choice travels on the dep (ISSUE 19) —
+        # register before fetching so the reader and the writer agree
+        spec = getattr(self.dep, "code_spec", None)
+        if spec is not None:
+            coding.set_shuffle_code(self.dep.shuffle_id, spec)
         if conf.SORT_SHUFFLE:
             merger = SortMerger(self.aggregator)
         else:
@@ -1365,6 +1379,7 @@ class CoGroupedRDD(RDD):
         return out
 
     def compute(self, split):
+        from dpark_tpu import coding
         from dpark_tpu.env import env
         from dpark_tpu.shuffle import CoGroupMerger
         merger = CoGroupMerger(len(self.rdds))
@@ -1373,6 +1388,9 @@ class CoGroupedRDD(RDD):
             if kind == "narrow":
                 merger.append(si, self.rdds[si].iterator(narrow[si]))
             else:
+                spec = getattr(obj, "code_spec", None)
+                if spec is not None:
+                    coding.set_shuffle_code(obj.shuffle_id, spec)
                 env.shuffle_fetcher.fetch(
                     obj.shuffle_id, split.index,
                     _CoGroupExtend(merger, si))
@@ -1386,6 +1404,53 @@ class _CoGroupExtend:
 
     def __call__(self, items):
         self.merger.extend(self.si, items)
+
+
+class _ResplitSplit(Split):
+    pass
+
+
+class ResplitReaderRDD(RDD):
+    """Mid-job re-plan bridge (ISSUE 19): reads the already-written
+    buckets of a finished shuffle map stage, one split per
+    (map_id, old_reduce_id) pair, so a skewed exchange can be re-keyed
+    through a second (salted) shuffle WITHOUT recomputing a single map
+    task.  Splits are map-id-major — the downstream passthrough
+    aggregator then merges each key's combiners in map-id order,
+    byte-identical to what the original reduce side would have built.
+
+    Dependencies carry the ORIGINAL ShuffleDependency: the DAG
+    scheduler wires the finished map stage as this stage's parent (a
+    no-op while its outputs live), and a missing bucket surfaces as a
+    plain FetchFailed that lineage recovery resubmits upstream —
+    re-planning adds no new failure modes."""
+
+    def __init__(self, src_dep):
+        super().__init__(src_dep.rdd.ctx)
+        self.src_dep = src_dep
+        self.n_src_maps = len(src_dep.rdd.splits)
+        self.n_src_reduces = src_dep.partitioner.num_partitions
+        self.dependencies = [src_dep]
+
+    def _make_splits(self):
+        return [_ResplitSplit(i)
+                for i in range(self.n_src_maps * self.n_src_reduces)]
+
+    def compute(self, split):
+        from dpark_tpu.env import env
+        from dpark_tpu.shuffle import FetchFailed, read_bucket_any
+        map_id = split.index // self.n_src_reduces
+        reduce_id = split.index % self.n_src_reduces
+        sid = self.src_dep.shuffle_id
+        locs = env.map_output_tracker.get_outputs(sid)
+        uri = locs[map_id] if locs else None
+        if uri is None:
+            raise FetchFailed(None, sid, map_id, reduce_id)
+        return iter(read_bucket_any(uri, sid, map_id, reduce_id))
+
+    def __repr__(self):
+        return "<ResplitReaderRDD of shuffle %d>" % \
+            self.src_dep.shuffle_id
 
 
 # --------------------------------------------------------------------------

@@ -287,6 +287,7 @@ class DAGScheduler:
         def submit_missing_tasks(stage):
             tasks = []
             if stage.is_shuffle_map:
+                self._maybe_choose_code(stage.shuffle_dep)
                 for p in range(stage.num_partitions):
                     if stage.output_locs[p] is None:
                         tasks.append(ShuffleMapTask(
@@ -359,6 +360,7 @@ class DAGScheduler:
             record["seconds"] = round(_time.time() - job_t0, 3)
             record.pop("_t_submit", None)
             self._finalize_decodes(record)
+            self._finalize_exchanges(record)
             self._finalize_adapt(record)
             self._trace_job_span(record, job_t0)
             self._finalize_health(record)
@@ -596,6 +598,206 @@ class DAGScheduler:
         except Exception:
             pass
 
+    # -- straggler-adaptive coded shuffle (ISSUE 19, decision pt 6) ------
+    def _maybe_choose_code(self, dep):
+        """Price a per-exchange shuffle code from the adapt store's
+        per-peer fetch-tail sketches before the map stage writes its
+        first bucket: an exchange whose peers historically straggle
+        gets parity even with the global code off, a tight-tailed one
+        drops to uncoded under a global rs(k,m).  The choice rides
+        ``dep.code_spec`` to every task (writer AND reader register it
+        process-locally), so mixed per-shuffle codes stay wire-safe
+        through the self-describing container framing.  One flag check
+        when DPARK_CODE_ADAPT is off."""
+        if not conf.CODE_ADAPT:
+            return
+        if getattr(dep, "code_spec", None) is not None:
+            return                      # resubmit: keep the first choice
+        site = getattr(dep, "adapt_site", None)
+        if not site:
+            return
+        from dpark_tpu import adapt, coding
+        try:
+            spec = adapt.choose_shuffle_code(site)
+        except Exception:
+            logger.exception("code choice failed for %s", site)
+            return
+        if spec is None:
+            return                      # observe mode / no usable tails
+        dep.code_spec = spec
+        coding.set_shuffle_code(dep.shuffle_id, spec)
+
+    def _finalize_exchanges(self, record):
+        """Drain the per-exchange peer observations this process
+        accumulated while the job fetched (ISSUE 19) into persistent
+        adapt "xch" records keyed by the exchange's call site — the
+        input the NEXT run's code policy prices from — and close the
+        loop on any pending code decision (predicted vs observed fetch
+        wall).  Worker processes of the multiprocess master accumulate
+        in their own processes (the documented per-process caveat)."""
+        from dpark_tpu import adapt
+        if not adapt.enabled():
+            return
+        from dpark_tpu import shuffle as _shuffle
+        try:
+            obs = _shuffle.drain_exchange_observations()
+        except Exception:
+            return
+        for sid, ent in obs.items():
+            stage = self.shuffle_to_stage.get(sid)
+            dep = stage.shuffle_dep if stage is not None else None
+            site = getattr(dep, "adapt_site", None) if dep else None
+            if not site:
+                continue
+            try:
+                adapt.observe_exchange(site, ent.get("peers") or {},
+                                       fetch_ms=ent.get("ms"))
+            except Exception:
+                pass
+
+    # -- mid-job re-planning (ISSUE 19, decision pt 7) -------------------
+    def _bucket_sizes(self, dep, stage):
+        """Per-reduce-bucket byte sizes of a finished map stage,
+        stat'd by the driver from the bucket files themselves — the
+        skew probe's histogram.  None when any output is not a local
+        file:// loc (bucket-server/tcp and hbm exchanges are excluded
+        from re-planning: no cheap driver-side size probe)."""
+        import os as _os
+        n = dep.partitioner.num_partitions
+        sizes = [0] * n
+        for m, uri in enumerate(stage.output_locs):
+            if not isinstance(uri, str) \
+                    or not uri.startswith("file://"):
+                return None
+            d = _os.path.join(uri[len("file://"):], "shuffle",
+                              str(dep.shuffle_id), str(m))
+            for r in range(n):
+                p = _os.path.join(d, str(r))
+                try:
+                    sizes[r] += _os.path.getsize(p)
+                except OSError:
+                    try:
+                        sizes[r] += _os.path.getsize(p + ".shards")
+                    except OSError:
+                        return None
+        return sizes
+
+    def _replan_consumer(self, stage, dep, waiting):
+        """The unique (waiting child stage, ShuffledRDD) pair that
+        consumes `dep`, or (None, None) when the shape is not safely
+        re-plannable: multiple children, multiple consumers, a
+        consumer that is not a plain ShuffledRDD, or a CoGroupedRDD
+        anywhere on the narrow walk (its narrow-vs-shuffle dep choice
+        was fixed at graph build from partitioner EQUALITY — swapping
+        the partitioner underneath it could desynchronize
+        copartitioning)."""
+        children = [c for c in waiting if stage in c.parents]
+        if len(children) != 1:
+            return None, None
+        child = children[0]
+        from dpark_tpu.rdd import CoGroupedRDD
+        consumers = []
+        hazard = [False]
+        seen = set()
+
+        def visit(r):
+            if r.id in seen or hazard[0]:
+                return
+            seen.add(r.id)
+            if isinstance(r, CoGroupedRDD):
+                hazard[0] = True
+                return
+            for d in r.dependencies:
+                if d is dep:
+                    consumers.append(r)
+                elif not isinstance(d, ShuffleDependency):
+                    visit(d.rdd)
+        visit(child.rdd)
+        if hazard[0] or len(consumers) != 1:
+            return None, None
+        consumer = consumers[0]
+        if getattr(consumer, "dep", None) is not dep:
+            return None, None
+        return child, consumer
+
+    def _maybe_replan(self, stage, waiting, submit_stage, record):
+        """Mid-job re-plan at the stage boundary (ISSUE 19 decision
+        point 7): the map side just finished, its bucket sizes are
+        REAL, and the reduce side has not launched — if one reduce
+        bucket dominates the exchange (hash-collision skew the
+        map-side combine could not dissolve), re-key the reduce side
+        through a salted re-split of the already-written buckets.  No
+        map task is recomputed: a ResplitReaderRDD stage re-buckets
+        (map, reduce) pairs under SaltedHashPartitioner at the SAME
+        width, and the waiting consumer is rewired onto it before it
+        ever runs.  Observe mode logs the would-be decision and
+        changes nothing.  One flag check when DPARK_REPLAN is off."""
+        if not conf.REPLAN:
+            return
+        from dpark_tpu import adapt
+        if not adapt.enabled():
+            return
+        dep = stage.shuffle_dep
+        site = getattr(dep, "adapt_site", None)
+        if not site:
+            return
+        from dpark_tpu.dependency import (
+            Aggregator, HashPartitioner, SaltedHashPartitioner)
+        if type(dep.partitioner) is not HashPartitioner:
+            return                # already salted / range: leave alone
+        n = dep.partitioner.num_partitions
+        if n <= 1:
+            return
+        try:
+            sizes = self._bucket_sizes(dep, stage)
+        except Exception:
+            return
+        if not sizes:
+            return
+        total = sum(sizes)
+        if total < conf.REPLAN_MIN_BYTES:
+            return
+        frac = max(sizes) / float(total)
+        if frac < conf.REPLAN_SKEW_FRAC:
+            return
+        child, consumer = self._replan_consumer(stage, dep, waiting)
+        if child is None:
+            return
+        salt = 1
+        steering = adapt.steering()
+        try:
+            reason = adapt.note_replan(site, n, salt, frac,
+                                       applied=steering)
+        except Exception:
+            return
+        if not steering:
+            return                     # observe: decision logged only
+        from dpark_tpu.rdd import ResplitReaderRDD, _identity
+        mc = dep.aggregator.merge_combiners
+        with self._graph_lock:
+            reader = ResplitReaderRDD(dep)
+            # readers yield (key, combiner) with each key at most once
+            # per split (map-side dicts dedupe), so identity-create +
+            # merge_combiners reproduces the original combine exactly;
+            # map-id-major reader splits keep the merge order
+            # bit-identical to the un-replanned fetch
+            new_dep = ShuffleDependency(
+                reader, Aggregator(_identity, mc, mc),
+                SaltedHashPartitioner(n, salt))
+            resplit_stage = self.get_shuffle_map_stage(new_dep)
+            consumer.dep = new_dep
+            consumer.dependencies = [new_dep]
+            consumer.partitioner = new_dep.partitioner
+            child.parents = self._get_parent_stages_locked(child.rdd)
+        submit_stage(resplit_stage)
+        record["replans"] = record.get("replans", 0) + 1
+        record["stages"] = record.get("stages", 0) + 1
+        info = self._stage_info(record, child.id)
+        info["replan_reason"] = reason
+        logger.info("re-planned shuffle %d -> %d (stage %d): %s",
+                    dep.shuffle_id, new_dep.shuffle_id,
+                    resplit_stage.id, reason)
+
     def _stage_info(self, record, stage_id):
         """The per-stage observability dict inside a job record
         (SURVEY.md 5.1: per-stage timings/path for the web UI)."""
@@ -683,7 +885,7 @@ class DAGScheduler:
         actually ran."""
         from dpark_tpu import coding, faults
         out = {"resubmits": 0, "recomputes": 0, "retries": 0,
-               "fetch_failed": 0, "speculated": 0}
+               "fetch_failed": 0, "speculated": 0, "replans": 0}
         for rec in self.history:
             for k in list(out):
                 out[k] += rec.get(k, 0)
@@ -722,7 +924,7 @@ class DAGScheduler:
                 "tasks": {"ok": 0, "fail": 0},
                 "counters": {"retries": 0, "resubmits": 0,
                              "recomputes": 0, "fetch_failed": 0,
-                             "speculated": 0},
+                             "speculated": 0, "replans": 0},
                 "adapt_decisions": {"applied": 0, "logged": 0},
                 "phases": {}}
 
@@ -1040,6 +1242,13 @@ class DAGScheduler:
                             stage.shuffle_dep.shuffle_id, stage.output_locs)
                         self._finish_stage_info(record, stage.id)
                         running.discard(stage)
+                        # mid-job re-plan probe (ISSUE 19): if this
+                        # map stage's bucket histogram shows one
+                        # dominant reduce bucket, re-key the waiting
+                        # reduce side through a salted re-split of the
+                        # JUST-WRITTEN buckets before it launches
+                        self._maybe_replan(stage, waiting,
+                                           submit_stage, record)
                         # wake children whose parents are now all ready
                         for child in list(waiting):
                             if not self.get_missing_parent_stages(child):

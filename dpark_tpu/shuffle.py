@@ -89,7 +89,10 @@ class LocalFileShuffle:
         decode, not a lineage recompute.
 
         Returns the server URI advertising these outputs."""
-        code = coding.active_code()
+        # per-exchange override first (ISSUE 19): the adaptive policy
+        # may have priced THIS shuffle coded while the global code is
+        # off, or pinned it uncoded under a global rs(k,m)
+        code = coding.shuffle_code(shuffle_id)
         for reduce_id, bucket in enumerate(buckets):
             items = list(bucket.items()) if isinstance(bucket, dict) \
                 else list(bucket)
@@ -102,8 +105,10 @@ class LocalFileShuffle:
                 with atomic_file(path, fsync=False) as f:
                     f.write(blob)
                 continue
+            data = coding.encode_container(blob, code)
+            coding.note_parity_bytes(len(data) - len(blob))
             with atomic_file(path + ".shards", fsync=False) as f:
-                f.write(coding.encode_container(blob, code))
+                f.write(data)
         return LocalFileShuffle.get_server_uri()
 
 
@@ -227,6 +232,44 @@ def peer_label(uri):
 class _Uncoded(Exception):
     """Internal: the bucket has no shard files anywhere — it was
     written without parity.  The caller retries the plain protocol."""
+
+
+# per-exchange observation accumulator (ISSUE 19): which peers served
+# each shuffle THIS process fetched from, with per-peer fetch/decode
+# counts and the summed fetch wall ms.  The scheduler drains it at job
+# finish into adapt "xch" records — the input the straggler-adaptive
+# code policy prices the next run from.  Worker processes of the
+# multiprocess master accumulate in their own processes (the same
+# per-process caveat as the decode counters).  Zero cost with the
+# adapt plane off: one mode check per bucket fetch.
+_XCH_LOCK = threading.Lock()
+_XCH_OBS = {}
+
+
+def _xch_note(shuffle_id, peer, kind="fetches", ms=0.0):
+    from dpark_tpu import adapt
+    if not adapt.enabled():
+        return
+    with _XCH_LOCK:
+        ent = _XCH_OBS.setdefault(shuffle_id,
+                                  {"peers": {}, "ms": 0.0})
+        pc = ent["peers"].setdefault(str(peer), {})
+        pc[kind] = pc.get(kind, 0) + 1
+        if ms:
+            ent["ms"] += float(ms)
+
+
+def drain_exchange_observations(shuffle_ids=None):
+    """Pop accumulated per-exchange observations, all of them or just
+    `shuffle_ids` — {sid: {"peers": {peer: counts}, "ms": wall_ms}}."""
+    with _XCH_LOCK:
+        if shuffle_ids is None:
+            out = dict(_XCH_OBS)
+            _XCH_OBS.clear()
+        else:
+            out = {sid: _XCH_OBS.pop(sid)
+                   for sid in list(shuffle_ids) if sid in _XCH_OBS}
+    return out
 
 
 class _ShardPool:
@@ -372,7 +415,9 @@ def _fetch_coded(ordered, shuffle_id, map_id, reduce_id, code, hm):
     if len(got) < k:
         if misses >= n and not had_error:
             raise _Uncoded()
-        coding.note("decode_failures", shuffle_id)
+        peer = peer_label(ordered[0]) if ordered else "local"
+        coding.note("decode_failures", shuffle_id, peer=peer)
+        _xch_note(shuffle_id, peer, "decode_failures")
         err = FetchFailed(ordered[0] if ordered else None, shuffle_id,
                           map_id, reduce_id, shards_found=len(got),
                           shards_needed=k)
@@ -398,8 +443,10 @@ def _fetch_coded(ordered, shuffle_id, map_id, reduce_id, code, hm):
         # parity actually reconstructed data: a failed shard was
         # REPAIRED, or a merely-slow one lost the race (straggler
         # win) — either way, zero lineage recompute
-        coding.note("repair" if had_error else "straggler_win",
-                    shuffle_id)
+        kind = "repair" if had_error else "straggler_win"
+        peer = peer_label(ordered[0]) if ordered else "local"
+        coding.note(kind, shuffle_id, peer=peer)
+        _xch_note(shuffle_id, peer, kind)
     return pickle.loads(decompress(blob))
 
 
@@ -516,7 +563,8 @@ def _fetch_coded_local(ordered, shuffle_id, map_id, reduce_id):
                 still.append(fr)
         failed = still
     if not frames or len(good) < k:
-        coding.note("decode_failures", shuffle_id)
+        coding.note("decode_failures", shuffle_id, peer="local")
+        _xch_note(shuffle_id, "local", "decode_failures")
         trace.flight("fetch.failed", "shuffle", shuffle=shuffle_id,
                      map=map_id, reduce=reduce_id, coded=True,
                      shards_found=len(good), shards_needed=k,
@@ -529,8 +577,9 @@ def _fetch_coded_local(ordered, shuffle_id, map_id, reduce_id):
         # parity reconstructed a data shard: a failed one was
         # REPAIRED, or a merely-slow one lost the race (straggler
         # win) — either way, zero lineage recompute
-        coding.note("repair" if had_error else "straggler_win",
-                    shuffle_id)
+        kind = "repair" if had_error else "straggler_win"
+        coding.note(kind, shuffle_id, peer="local")
+        _xch_note(shuffle_id, "local", kind)
     return pickle.loads(decompress(blob))
 
 
@@ -547,16 +596,27 @@ def read_bucket_any(uris, shuffle_id, map_id, reduce_id):
     come from, not just count failures).  With a shuffle code active
     the bucket is fetched shard-wise (fastest k of n, decode instead
     of FetchFailed).  Raises FetchFailed when every replica fails."""
-    if trace._PLANE is None:
+    from dpark_tpu import adapt
+    if trace._PLANE is None and not adapt.enabled():
         return _read_bucket_any(uris, shuffle_id, map_id, reduce_id)
     first = uris if isinstance(uris, str) else (uris[0] if uris else "")
     # the peer arg keys the health plane's per-site fetch-latency
     # sketches (ISSUE 14) — the serving host, not the full uri, so
     # site cardinality stays bounded
-    with trace.span("fetch.bucket", "shuffle", shuffle=shuffle_id,
-                    map=map_id, reduce=reduce_id,
-                    peer=peer_label(first) if first else "local"):
-        return _read_bucket_any(uris, shuffle_id, map_id, reduce_id)
+    peer = peer_label(first) if first else "local"
+    t0 = time.time()
+    if trace._PLANE is None:
+        items = _read_bucket_any(uris, shuffle_id, map_id, reduce_id)
+    else:
+        with trace.span("fetch.bucket", "shuffle", shuffle=shuffle_id,
+                        map=map_id, reduce=reduce_id, peer=peer):
+            items = _read_bucket_any(uris, shuffle_id, map_id,
+                                     reduce_id)
+    # per-exchange peer accounting (ISSUE 19): which peers served this
+    # shuffle, and the fetch wall the code policy grades itself on
+    _xch_note(shuffle_id, peer, "fetches",
+              ms=(time.time() - t0) * 1e3)
+    return items
 
 
 def _read_bucket_any(uris, shuffle_id, map_id, reduce_id):
@@ -569,7 +629,11 @@ def _read_bucket_any(uris, shuffle_id, map_id, reduce_id):
         # hostatus ranking by each replica's HOST (two replicas on one
         # host share fate): healthy-first, then by recent failure rate
         ordered = hm.rank_items(ordered, uri_host)
-    code = coding.active_code()
+    # per-exchange override first (ISSUE 19): an adaptively-escalated
+    # exchange fetches coded even with the global code off, a pinned-
+    # uncoded one skips the shard protocol under a global rs(k,m);
+    # the _Uncoded fallback still covers spec-vs-disk disagreement
+    code = coding.shuffle_code(shuffle_id)
     if code is not None and ordered:
         try:
             # the one-I/O container fast path only when EVERY replica

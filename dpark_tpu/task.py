@@ -65,6 +65,13 @@ class ShuffleMapTask(Task):
 
     def run(self, attempt_id):
         dep = self.shuffle_dep
+        # per-exchange code choice (ISSUE 19) travels on the dep and is
+        # registered process-locally so write_buckets resolves it even
+        # in a worker process that never saw the driver's registry
+        spec = getattr(dep, "code_spec", None)
+        if spec is not None:
+            from dpark_tpu import coding
+            coding.set_shuffle_code(dep.shuffle_id, spec)
         agg = dep.aggregator
         get_partition = dep.partitioner.get_partition
         n = dep.partitioner.num_partitions

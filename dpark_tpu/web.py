@@ -166,7 +166,8 @@ def render_metrics(scheduler):
             ("resubmits", "parent-stage lineage resubmissions"),
             ("recomputes", "intact-parent recomputes"),
             ("fetch_failed", "reduce-side fetch failures"),
-            ("speculated", "speculative task duplicates")):
+            ("speculated", "speculative task duplicates"),
+            ("replans", "mid-job reduce-side re-plans")):
         metric("dpark_%s_total" % key, "counter", help_text,
                [({}, snap["counters"].get(key, 0))])
     try:
@@ -189,7 +190,22 @@ def render_metrics(scheduler):
     metric("dpark_decodes_total", "counter",
            "erasure-decode outcomes",
            [({"kind": k}, v) for k, v in sorted(dstats.items())
-            if k != "mode"] or [({"kind": "none"}, 0)])
+            if isinstance(v, int)
+            and k not in ("parity_bytes",)]
+           or [({"kind": "none"}, 0)])
+    # per-peer decode attribution (ISSUE 19): which serving peer's
+    # shards the policy repaired / raced / failed on — the evidence
+    # behind a per-exchange escalation
+    metric("dpark_decodes_by_peer_total", "counter",
+           "erasure-decode outcomes by serving peer",
+           [({"kind": k, "peer": p}, v)
+            for p, counts in sorted((dstats.get("per_peer")
+                                     or {}).items())
+            for k, v in sorted(counts.items())]
+           or [({"kind": "none", "peer": "none"}, 0)])
+    metric("dpark_parity_bytes_total", "counter",
+           "erasure-parity bytes written to shuffle buckets",
+           [({}, int(dstats.get("parity_bytes", 0) or 0))])
     metric("dpark_adapt_decisions_total", "counter",
            "cost-model decisions (applied=steered)",
            [({"applied": "true"},

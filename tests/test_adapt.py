@@ -699,3 +699,212 @@ def test_pane_stream_samples_cost(monkeypatch, tmp_path):
     assert len(hist) == 1
     ent = next(iter(hist.values()))
     assert ent.get("inv_ms") is not None and ent["w"] == 4
+
+
+# ---------------------------------------------------------------------------
+# decision points 6 + 7: per-exchange codes and mid-job re-planning
+# (ISSUE 19 — straggler-adaptive coded shuffle + re-plan at the boundary)
+# ---------------------------------------------------------------------------
+
+import operator
+
+
+def _colliding_keys(n, count):
+    """Distinct keys that all land in ONE hash bucket of width n —
+    the dominant-bucket skew the map-side combine cannot dissolve."""
+    from dpark_tpu.utils.phash import portable_hash
+    out = [k for k in range(100000) if portable_hash(k) % n == 0]
+    assert len(out) >= count
+    return out[:count]
+
+
+@pytest.fixture()
+def replanning(tmp_path):
+    """DPARK_REPLAN on, steering adapt plane with its own store."""
+    old = (conf.REPLAN, conf.REPLAN_MIN_BYTES)
+    conf.REPLAN = True
+    conf.REPLAN_MIN_BYTES = 64
+    adapt.configure(mode="on", store_dir=str(tmp_path / "replan"))
+    yield
+    (conf.REPLAN, conf.REPLAN_MIN_BYTES) = old
+
+
+def _assert_replanned(rec):
+    assert rec.get("replans") == 1, rec
+    assert rec.get("resubmits", 0) == 0, rec
+    assert rec.get("recomputes", 0) == 0, rec
+    reasons = [st.get("replan_reason") for st in rec["stage_info"]
+               if st.get("replan_reason")]
+    assert reasons and "dominant bucket" in reasons[0], rec
+    assert any(st.get("rdd") == "ResplitReaderRDD"
+               for st in rec["stage_info"]), rec["stage_info"]
+
+
+def test_replan_skewed_reducebykey_bit_identical(ctx, replanning):
+    """Decision point 7, host path: a reduceByKey whose keys all
+    collide into one bucket is re-keyed through a salted re-split at
+    the stage boundary — bit-identical to the un-replanned run, no
+    map task recomputed, and the SECOND run pre-salts at plan time
+    (the probe finds a balanced histogram, no re-split stage)."""
+    keys = _colliding_keys(4, 300)
+    data = [(k, 1) for k in keys] * 3
+
+    def job(c):
+        return sorted(c.parallelize(data, 4)
+                      .reduceByKey(operator.add, 4).collect())
+
+    conf.REPLAN = False
+    clean = job(ctx)
+    conf.REPLAN = True
+    assert job(ctx) == clean
+    _assert_replanned(ctx.scheduler.history[-1])
+    # run 2, same call site: pre-salted, probe finds nothing
+    assert job(ctx) == clean
+    rec2 = ctx.scheduler.history[-1]
+    assert not rec2.get("replans"), rec2
+    assert rec2["stages"] == 2, rec2
+    with adapt._lock:
+        assert adapt._agg["replan"], "replan record must persist"
+
+
+def test_replan_skewed_groupbykey_preserves_merge_order(
+        ctx, replanning):
+    """groupByKey builds ORDER-SENSITIVE list combiners: the re-split
+    must merge each key's per-map lists in map-id order (map-id-major
+    reader splits), so the grouped values come back in exactly the
+    un-replanned sequence — not merely the same multiset."""
+    keys = _colliding_keys(3, 60)
+    data = [(keys[i % len(keys)], i) for i in range(1200)]
+
+    def job(c):
+        return sorted((k, list(vs)) for k, vs in
+                      c.parallelize(data, 5).groupByKey(3).collect())
+
+    conf.REPLAN = False
+    clean = job(ctx)
+    conf.REPLAN = True
+    assert job(ctx) == clean
+    _assert_replanned(ctx.scheduler.history[-1])
+
+
+def test_replan_device_object_path_bit_identical(tctx2, replanning):
+    """The tpu:2 parity cell: object-path rows (string values decline
+    the array path) write file:// buckets, so the probe sees the skew
+    and the re-split runs under the device master too — and the
+    pre-salted second run declines the device hash kernel by NAME
+    (SaltedHashPartitioner has no device spec)."""
+    keys = _colliding_keys(3, 120)
+    data = [(k, "v%d" % (k % 11)) for k in keys for _ in range(3)]
+
+    def job(c):
+        return sorted((k, "".join(sorted(vs))) for k, vs in
+                      c.parallelize(data, 4).groupByKey(3).collect())
+
+    conf.REPLAN = False
+    clean = job(tctx2)
+    conf.REPLAN = True
+    assert job(tctx2) == clean
+    _assert_replanned(tctx2.scheduler.history[-1])
+    assert job(tctx2) == clean                  # pre-salted run
+    assert not tctx2.scheduler.history[-1].get("replans")
+
+
+def test_replan_skips_tight_histograms_and_tiny_exchanges(
+        ctx, replanning):
+    """No dominant bucket, or an exchange under REPLAN_MIN_BYTES:
+    the probe declines and the job runs the planned two stages."""
+    def job(c):
+        return sorted(c.parallelize([(i, 1) for i in range(400)], 4)
+                      .reduceByKey(operator.add, 4).collect())
+
+    assert job(ctx) == [(i, 1) for i in range(400)]
+    rec = ctx.scheduler.history[-1]
+    assert not rec.get("replans"), rec
+    assert rec["stages"] == 2, rec
+    # a genuinely skewed but tiny exchange stays un-replanned
+    conf.REPLAN_MIN_BYTES = 1 << 30
+    keys = _colliding_keys(4, 200)
+
+    def tiny(c):
+        return sorted(c.parallelize([(k, 1) for k in keys], 4)
+                      .reduceByKey(operator.add, 4).collect())
+
+    assert tiny(ctx) == [(k, 1) for k in keys]
+    assert not ctx.scheduler.history[-1].get("replans")
+
+
+def test_observe_mode_never_steers_code_or_replan(ctx, tmp_path):
+    """The plane contract across the new decision points: observe
+    mode logs the would-be code escalation AND the would-be re-plan
+    (applied: false) but registers no per-shuffle code, writes no
+    parity, and submits no re-split stage — results and stage shapes
+    bit-identical to off, with and without fault injection."""
+    from dpark_tpu import coding, faults
+    from dpark_tpu.health import Sketch
+    old = (conf.CODE_ADAPT, conf.REPLAN, conf.REPLAN_MIN_BYTES)
+    conf.CODE_ADAPT = True
+    conf.REPLAN = True
+    conf.REPLAN_MIN_BYTES = 64
+    keys = _colliding_keys(4, 300)
+    data = [(k, 1) for k in keys] * 2
+
+    def job(c):
+        return sorted(c.parallelize(data, 4)
+                      .reduceByKey(operator.add, 4).collect())
+
+    try:
+        adapt.configure(mode="off")
+        clean = job(ctx)
+        adapt.configure(mode="observe",
+                        store_dir=str(tmp_path / "observe"))
+        sk = Sketch()
+        for _ in range(30):
+            sk.add(0.005)
+        for _ in range(5):
+            sk.add(0.5)
+        adapt.record_site_tail("fetch.bucket:local", sk.to_dict())
+        for spec in (None, "rs(4,2)"):
+            coding.configure(spec)
+            p0 = coding.parity_bytes()
+            for _ in range(2):          # run 2 has the xch record
+                assert job(ctx) == clean
+                rec = ctx.scheduler.history[-1]
+                assert not rec.get("replans"), rec
+                assert rec["stages"] == 2, rec
+                assert rec.get("resubmits", 0) == 0
+            paid = coding.parity_bytes() - p0
+            # parity follows the STATIC code alone in observe mode
+            assert (paid > 0) == (spec is not None), (spec, paid)
+            ds = [d for d in (rec.get("adapt") or {})
+                  .get("decisions", ())
+                  if d.get("point") in ("code", "replan")]
+            assert ds, "observe mode must log would-be decisions"
+            assert all(not d["applied"] for d in ds), ds
+            faults.configure("shuffle.fetch:p=0.2,seed=11")
+            assert job(ctx) == clean
+            faults.configure(None)
+    finally:
+        faults.configure(None)
+        coding.configure(None)
+        coding.clear_shuffle_codes()
+        (conf.CODE_ADAPT, conf.REPLAN, conf.REPLAN_MIN_BYTES) = old
+
+
+def test_xch_records_persist_and_fold(tmp_path):
+    """"xch" store records: per-peer counts accumulate, the fetch
+    wall folds as an EMA, and a fresh process (simulated reload)
+    reads the same profile back."""
+    store = str(tmp_path / "xch")
+    adapt.configure(mode="observe", store_dir=store)
+    adapt.observe_exchange("j.py:1", {"hostA": {"fetches": 4}},
+                           fetch_ms=100.0)
+    adapt.observe_exchange("j.py:1", {"hostA": {"fetches": 2,
+                                                "repair": 1}},
+                           fetch_ms=50.0)
+    adapt.configure(mode="observe", store_dir=store)   # reload
+    prof = adapt.exchange_profiles()
+    ent = prof["j.py:1"]
+    assert ent["peers"]["hostA"]["fetches"] == 6
+    assert ent["peers"]["hostA"]["repair"] == 1
+    assert 50.0 < ent["fetch_ms"] < 100.0, ent
+    assert ent["n"] == 2

@@ -569,3 +569,254 @@ def test_rs_bucket_decodes_after_eviction_roundtrip_to_disk():
         assert stats.get("decode_failures", 0) == 0, stats
     finally:
         ctx.stop()
+
+
+# ---------------------------------------------------------------------------
+# straggler-adaptive per-exchange codes (ISSUE 19 tentpole 1)
+# ---------------------------------------------------------------------------
+
+def _heavy_tail_digest():
+    from dpark_tpu.health import Sketch
+    sk = Sketch()
+    for _ in range(30):
+        sk.add(0.005)
+    for _ in range(5):
+        sk.add(0.5)
+    return sk.to_dict()
+
+
+def _tight_tail_digest():
+    from dpark_tpu.health import Sketch
+    sk = Sketch()
+    for _ in range(35):
+        sk.add(0.005)
+    return sk.to_dict()
+
+
+@pytest.fixture()
+def adaptive(tmp_path):
+    """DPARK_CODE_ADAPT on, steering adapt plane with its own store,
+    clean per-shuffle code registry."""
+    from dpark_tpu import adapt
+    old = conf.CODE_ADAPT
+    conf.CODE_ADAPT = True
+    adapt.configure(mode="on", store_dir=str(tmp_path / "adapt"))
+    coding.clear_shuffle_codes()
+    yield adapt
+    conf.CODE_ADAPT = old
+    coding.clear_shuffle_codes()
+    adapt.configure()
+
+
+def test_choose_code_policy_cells():
+    """Pure-policy unit cells: no tails -> None (static stands);
+    straggling tail or observed decode -> escalate; tight tails ->
+    explicit uncoded; thin evidence -> None."""
+    heavy, tight = _heavy_tail_digest(), _tight_tail_digest()
+    spec, reason, _ = coding.choose_code([], {}, {})
+    assert spec is None
+    # straggling peer escalates to the conf'd spec
+    spec, reason, pred = coding.choose_code(
+        ["peerA"], {"peerA": heavy}, {"peerA": {"fetches": 10}})
+    assert spec == conf.CODE_ADAPT_ESCALATE and "escalate" in reason
+    assert pred and pred > 0
+    # tight tails pin uncoded (drop the parity tax)
+    spec, reason, _ = coding.choose_code(
+        ["peerA"], {"peerA": tight}, {"peerA": {"fetches": 10}})
+    assert spec == "off" and "tight" in reason
+    # any observed decode escalates even with tight tails: the
+    # exchange demonstrably consumed parity
+    spec, reason, _ = coding.choose_code(
+        ["peerA"], {"peerA": tight}, {"peerA": {"repair": 2}})
+    assert spec == conf.CODE_ADAPT_ESCALATE and "decode" in reason
+    # fewer samples than CODE_ADAPT_MIN_SAMPLES: not actionable
+    from dpark_tpu.health import Sketch
+    thin = Sketch()
+    thin.add(0.5)
+    spec, _, _ = coding.choose_code(["peerA"], {"peerA": thin.to_dict()},
+                                    {})
+    assert spec is None
+
+
+def test_per_shuffle_code_registry_overrides_global():
+    """The registry answers per shuffle id: explicit spec, explicit
+    uncoded ("off" pins None even under a global code), fallback to
+    the global code, and FIFO eviction at the cap."""
+    coding.configure("rs(4,2)")
+    coding.set_shuffle_code(101, "xor")
+    coding.set_shuffle_code(102, "off")
+    assert coding.shuffle_code(101).m == 1
+    assert coding.shuffle_code(102) is None         # pinned uncoded
+    assert coding.shuffle_code(999).m == 2          # global fallback
+    coding.set_shuffle_code(101, None)              # clear
+    assert coding.shuffle_code(101).m == 2
+    coding.clear_shuffle_codes()
+    assert coding.shuffle_code(102).m == 2
+
+
+def test_two_run_escalation_targets_only_straggling_exchange(
+        ctx, adaptive):
+    """The ISSUE 19 two-run chaos proof: run 1 (static rs(4,2), fetch
+    faults on exchange A only) records per-exchange decode outcomes;
+    run 2 escalates exchange A (its xch record consumed parity) while
+    exchange B — same peers, tight tails, clean history — is pinned
+    UNCODED, dropping its parity tax under the same global code."""
+    def job_a(c):
+        return sorted(c.parallelize([(i % 7, i) for i in range(210)],
+                                    4).reduceByKey(operator.add,
+                                                   3).collect())
+
+    def job_b(c):
+        return sorted(c.parallelize([(i % 5, 1) for i in range(200)],
+                                    4).reduceByKey(operator.add,
+                                                   3).collect())
+
+    coding.configure("rs(4,2)")
+    clean_a, clean_b = job_a(ctx), job_b(ctx)
+    # run 1: faults fire on A's exchange only; B runs clean
+    faults.configure("shuffle.fetch:p=0.3,seed=7")
+    assert job_a(ctx) == clean_a
+    faults.configure(None)
+    assert job_b(ctx) == clean_b
+    from dpark_tpu import adapt
+    xch = adapt.exchange_profiles()
+    assert len(xch) >= 2, xch
+    decoded = {site: sum(c.get("repair", 0) + c.get("straggler_win", 0)
+                         for c in ent["peers"].values())
+               for site, ent in xch.items()}
+    assert any(v > 0 for v in decoded.values()), decoded
+    assert any(v == 0 for v in decoded.values()), decoded
+    # both exchanges share the local peer; its tails are tight — the
+    # discriminator is A's recorded decode consumption
+    adapt.record_site_tail("fetch.bucket:local", _tight_tail_digest())
+    # run 2 under the same static code: A stays coded, B sheds parity
+    p0 = coding.parity_bytes()
+    assert job_a(ctx) == clean_a
+    pa = coding.parity_bytes() - p0
+    assert job_b(ctx) == clean_b
+    pb = coding.parity_bytes() - p0 - pa
+    assert pa > 0, "straggling exchange must stay coded"
+    assert pb == 0, "clean tight-tailed exchange must shed parity"
+    rec = ctx.scheduler.history[-1]
+    ds = [d for d in (rec.get("adapt") or {}).get("decisions", ())
+          if d.get("point") == "code"]
+    assert ds and ds[0]["choice"] == "off" and ds[0]["applied"], ds
+    hist = coding.code_history()
+    assert any(h["code"] == conf.CODE_ADAPT_ESCALATE and h["applied"]
+               for h in hist), hist
+    assert any(h["code"] == "off" and h["applied"] for h in hist), hist
+
+
+def test_heavy_tails_escalate_from_uncoded(ctx, adaptive):
+    """With NO global code, an exchange whose recorded peer straggles
+    (p99/p50 over the bar) escalates to parity on run 2 — and the
+    pending decision closes with an observed fetch wall."""
+    def job(c):
+        return sorted(c.parallelize([(i % 7, i) for i in range(210)],
+                                    4).reduceByKey(operator.add,
+                                                   3).collect())
+
+    from dpark_tpu import adapt
+    clean = job(ctx)                       # run 1: records xch peers
+    assert adapt.exchange_profiles(), "run 1 must persist xch record"
+    adapt.record_site_tail("fetch.bucket:local", _heavy_tail_digest())
+    p0 = coding.parity_bytes()
+    assert job(ctx) == clean               # run 2: escalated
+    assert coding.parity_bytes() > p0
+    rec = ctx.scheduler.history[-1]
+    ds = [d for d in (rec.get("adapt") or {}).get("decisions", ())
+          if d.get("point") == "code"]
+    assert ds and ds[0]["applied"], ds
+    assert ds[0]["choice"] == conf.CODE_ADAPT_ESCALATE, ds
+    assert ds[0].get("predicted_ms") is not None, ds
+    assert ds[0].get("observed_ms") is not None, ds
+
+
+def test_per_peer_decode_counters_and_metrics(ctx):
+    """Satellite 1: decode counters carry the serving peer, and the
+    /metrics render exposes dpark_decodes_by_peer_total plus the
+    parity-bytes counter."""
+    def job(c):
+        return sorted(c.parallelize([(i % 7, i) for i in range(210)],
+                                    4).reduceByKey(operator.add,
+                                                   3).collect())
+
+    from dpark_tpu import adapt
+    adapt.configure(mode="observe")
+    try:
+        coding.configure("rs(4,2)")
+        coding.reset_counters()
+        faults.configure("shuffle.fetch:p=0.3,seed=7")
+        job(ctx)
+        stats = coding.stats()
+        per_peer = stats["per_peer"]
+        assert per_peer, stats
+        assert any(c.get("repair", 0) > 0 for c in per_peer.values()), \
+            per_peer
+        assert stats["parity_bytes"] > 0, stats
+        from dpark_tpu.web import render_metrics
+        body = render_metrics(ctx.scheduler)
+        assert "dpark_decodes_by_peer_total" in body
+        assert 'peer="local"' in body, body
+        assert "dpark_parity_bytes_total" in body
+        assert "dpark_replans_total" in body
+        # the plain decode metric never grows dict-valued series
+        assert 'dpark_decodes_total{kind="per_peer"}' not in body
+        # per-peer outcomes ride the health grade's evidence
+        from dpark_tpu import health
+        api = health.api_health(ctx.scheduler)
+        assert api["subsystems"]["coding"]["evidence"].get("by_peer"), \
+            api["subsystems"]["coding"]
+    finally:
+        adapt.configure()
+
+
+def test_static_code_hint_tracks_recorded_tails(ctx, tmp_path):
+    """The static-code-hint lint reads the adapt store's recorded
+    fetch tails against the pinned code: parity over tight tails ->
+    info (wasted parity), no parity over a straggling peer -> warn,
+    and the rule goes quiet once DPARK_CODE_ADAPT supersedes the pin
+    (ISSUE 19 satellite)."""
+    from dpark_tpu import adapt
+    from dpark_tpu.analysis import lint_plan
+
+    def findings(r):
+        return {f.rule: f for f in lint_plan(r)}
+
+    r = ctx.parallelize([(i % 5, 1) for i in range(50)], 2) \
+           .reduceByKey(operator.add, 2)
+    old = conf.CODE_ADAPT
+    conf.CODE_ADAPT = False
+    try:
+        # tight recorded tails + a pinned rs(4,2): the parity tax
+        # buys nothing -> info
+        adapt.configure(mode="on", store_dir=str(tmp_path / "tight"))
+        adapt.record_site_tail("fetch.bucket:local",
+                               _tight_tail_digest())
+        coding.configure("rs(4,2)")
+        f = findings(r).get("static-code-hint")
+        assert f is not None and f.severity == "info", f
+        assert "parity" in f.message
+
+        # heavy recorded tails + no code pinned: recovery is lineage
+        # replay -> warn naming the straggling peer
+        adapt.configure(mode="on", store_dir=str(tmp_path / "heavy"))
+        adapt.record_site_tail("fetch.bucket:slowpeer",
+                               _heavy_tail_digest())
+        coding.configure(None)
+        f = findings(r).get("static-code-hint")
+        assert f is not None and f.severity == "warn", f
+        assert "slowpeer" in f.message
+
+        # adaptive per-exchange pricing supersedes the pin: quiet
+        conf.CODE_ADAPT = True
+        assert "static-code-hint" not in findings(r)
+        conf.CODE_ADAPT = False
+
+        # adapt plane off: no recorded evidence to read -> quiet
+        adapt.configure(mode="off")
+        assert "static-code-hint" not in findings(r)
+    finally:
+        conf.CODE_ADAPT = old
+        coding.configure(None)
+        adapt.configure()

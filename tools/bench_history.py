@@ -52,6 +52,8 @@ HEADLINES = {
     "bulk_channel_vs_bridge": ("bulk_channel_vs_bridge", True),
     "coded_shuffle_overhead": ("coded_overhead", False),
     "adapt_warm_vs_cold": ("adapt_warm_vs_cold", False),
+    "adaptive_code": ("adaptive_code", False),
+    "skew_replan": ("skew_replan", True),
     "service_warm_submit": ("service_warm_submit", True),
     "aot_restart": ("aot_restart", True),
     "result_reuse": ("result_reuse", True),

@@ -46,6 +46,9 @@ def main():
     env.setdefault("BENCH_EXTRAS", "0")
     env.setdefault("BENCH_ADAPT_BASE_ROWS", "16384")
     env.setdefault("BENCH_BULK_ROWS", "250000")
+    env.setdefault("BENCH_CODE_ADAPT_PAIRS", "60000")
+    env.setdefault("BENCH_CODE_ADAPT_REPS", "2")
+    env.setdefault("BENCH_REPLAN_KEYS", "12000")
     env.setdefault("BENCH_TABLE_ROWS", "200000")
     env.setdefault("BENCH_PROBE_ATTEMPTS", "1")
     env.setdefault("BENCH_PROBE_TIMEOUT", "120")
@@ -334,6 +337,61 @@ def main():
                   "(expected 0 ladder retries, >=1 store hit): %r"
                   % aab[0])
             return 1
+    # ISSUE 19: per-exchange code re-pricing + mid-job re-plan — the
+    # adaptive_code line must show the hot exchange ESCALATED, the
+    # cold exchange PINNED UNCODED, and adaptive parity strictly
+    # below the static rs(4,2) leg; the skew_replan line must record
+    # exactly one mid-job re-plan with zero resubmits/recomputes,
+    # its reason, and a pre-salted (replan-free) follow-up.  Wall
+    # ratios are not graded here (CI boxes are too noisy;
+    # BENCH_*.json records the honest numbers against the <=1.1x
+    # adaptive and reduce-wall-improvement bars).
+    ac = [p for p in parsed if p.get("metric") == "adaptive_code"]
+    if not ac:
+        print("FAIL: no adaptive_code line")
+        return 1
+    for field in ("value", "static", "adaptive", "parity_ratio",
+                  "hot_escalated", "cold_pinned_uncoded"):
+        if field not in ac[0]:
+            print("FAIL: adaptive_code line missing %r (got %r)"
+                  % (field, sorted(ac[0])))
+            return 1
+    if not ac[0]["hot_escalated"] or not ac[0]["cold_pinned_uncoded"]:
+        print("FAIL: adaptive code policy did not steer both ways "
+              "(hot_escalated=%r cold_pinned_uncoded=%r)"
+              % (ac[0]["hot_escalated"], ac[0]["cold_pinned_uncoded"]))
+        return 1
+    if not (ac[0]["adaptive"].get("parity_bytes", 1 << 60)
+            < ac[0]["static"].get("parity_bytes", 0)):
+        print("FAIL: adaptive leg did not shed parity bytes vs the "
+              "static code: %r vs %r"
+              % (ac[0]["adaptive"], ac[0]["static"]))
+        return 1
+    rp = [p for p in parsed if p.get("metric") == "skew_replan"]
+    if not rp:
+        print("FAIL: no skew_replan line")
+        return 1
+    for field in ("value", "t_off_s", "t_replan_s", "t_presalt_s",
+                  "reduce_off_s", "reduce_presalt_s", "replans",
+                  "resubmits", "recomputes", "replan_reason",
+                  "presalt_replans"):
+        if field not in rp[0]:
+            print("FAIL: skew_replan line missing %r (got %r)"
+                  % (field, sorted(rp[0])))
+            return 1
+    if rp[0]["replans"] != 1 or rp[0]["resubmits"] \
+            or rp[0]["recomputes"]:
+        print("FAIL: skew re-plan must re-plan exactly once with "
+              "zero resubmits/recomputes: %r" % rp[0])
+        return 1
+    if rp[0]["presalt_replans"]:
+        print("FAIL: pre-salted follow-up re-planned again: %r"
+              % rp[0])
+        return 1
+    if "dominant bucket" not in str(rp[0]["replan_reason"] or ""):
+        print("FAIL: replan_reason missing the bucket histogram "
+              "evidence: %r" % rp[0]["replan_reason"])
+        return 1
     # ISSUE 9: the resident-service A/B line must be present — the
     # warm re-submission must show ZERO compiles with cache hits (the
     # amortized-compile acceptance), the concurrent section must be
