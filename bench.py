@@ -1306,6 +1306,92 @@ def _aot_phase():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _recovery_step_phase():
+    """Grandchild entry for the crash-recovery certification (ISSUE
+    20): ONE controller process running a 2-stage reduceByKey on the
+    pure-Python local master under whatever DPARK_JOURNAL /
+    DPARK_FAULTS the parent armed.  When the chaos spec kills it at
+    the first reduce fetch it dies with os._exit(137) AFTER the map
+    stage journaled; the next invocation over the same journal dir
+    must replay that stage (resumed_stages >= 1, 0 recomputes) and
+    agree on the order-independent checksum."""
+    from dpark_tpu import DparkContext, journal, trace
+    trace.configure("ring")
+    n = int(os.environ.get("BENCH_RECOVERY_PAIRS", "100000"))
+    ctx = DparkContext("local")
+    ctx.start()
+    t0 = time.perf_counter()
+    out = dict(ctx.parallelize([(i % 4096, i) for i in range(n)], 8)
+               .reduceByKey(_svc_add, 8).collect())
+    wall = time.perf_counter() - t0
+    csum = sum((int(k) * 1000003 + int(v)) % ((1 << 61) - 1)
+               for k, v in out.items()) % ((1 << 61) - 1)
+    rec = ctx.scheduler.history[-1]
+    replay_traced = any(ev.get("name") == "journal.replay"
+                        for ev in trace.snapshot())
+    payload = {"wall_s": round(wall, 4), "keys": len(out),
+               "checksum": csum,
+               "resumed_stages": rec.get("resumed_stages") or 0,
+               "seeded_partitions": rec.get("seeded_partitions") or 0,
+               "recomputes": rec.get("recomputes", 0),
+               "resubmits": rec.get("resubmits", 0),
+               "replay_traced": replay_traced,
+               "journal": journal.stats()}
+    ctx.stop()
+    print("RECOVERY_STEP %s" % json.dumps(payload), flush=True)
+
+
+def _recovery_phase():
+    """Child entry: kill -9 chaos certification + journal overhead A/B
+    (ISSUE 20 acceptance).  Four fresh controller processes: journal
+    OFF baseline, journal ON (the <=1.02x overhead pair), a VICTIM
+    that the chaos plane os._exit(137)s at its first reduce fetch (no
+    ok-line — the kill is the expected outcome), and a RESUME run over
+    the victim's journal + work dirs that must complete bit-identically
+    with resumed_stages >= 1 and 0 recomputes."""
+    import shutil
+    import tempfile
+    root = tempfile.mkdtemp(prefix="dpark-recovery-bench-")
+    timeout = int(os.environ.get("BENCH_RECOVERY_STEP_TIMEOUT", "180"))
+
+    def env_for(tag, journal="on", faults=""):
+        return {"DPARK_JOURNAL": journal,
+                "DPARK_JOURNAL_DIR": os.path.join(root, tag, "jnl"),
+                "DPARK_WORK_DIR": os.path.join(root, tag, "work"),
+                "DPARK_FAULTS": faults,
+                "JAX_PLATFORMS": "cpu"}
+
+    try:
+        off = _run_child("--recovery-step", timeout,
+                         env=env_for("off", journal="off"),
+                         ok_prefix="RECOVERY_STEP ")
+        on = _run_child("--recovery-step", timeout, env=env_for("on"),
+                        ok_prefix="RECOVERY_STEP ")
+        chaos_env = env_for("chaos")
+        victim = _run_child(
+            "--recovery-step", timeout,
+            env=dict(chaos_env,
+                     DPARK_FAULTS="shuffle.fetch:nth=1,kind=kill"),
+            ok_prefix="RECOVERY_STEP ")
+        resume = _run_child("--recovery-step", timeout, env=chaos_env,
+                            ok_prefix="RECOVERY_STEP ")
+        if off is None or on is None or resume is None:
+            raise SystemExit("recovery step child failed")
+        o, j, r = json.loads(off), json.loads(on), json.loads(resume)
+        out = {"off": o, "on": j, "resume": r,
+               "victim_killed": victim is None,
+               "overhead": round(j["wall_s"] / max(o["wall_s"], 1e-9),
+                                 3),
+               "parity": bool(o["checksum"] == j["checksum"]
+                              == r["checksum"]),
+               "resumed_stages": r.get("resumed_stages", 0),
+               "recomputes": r.get("recomputes", 0),
+               "replay_traced": bool(r.get("replay_traced"))}
+        print("RECOVERY_RESULT %s" % json.dumps(out), flush=True)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _reuse_data(d, n):
     """Deterministic tabular part file for the reuse cells (written
     once per dir — the restart step's two processes must fingerprint
@@ -1768,6 +1854,12 @@ def main():
     if "--reuse-only" in sys.argv:
         _reuse_phase()
         return
+    if "--recovery-only" in sys.argv:
+        _recovery_phase()
+        return
+    if "--recovery-step" in sys.argv:
+        _recovery_step_phase()
+        return
     if "--reuse-step" in sys.argv:
         _reuse_step_phase()
         return
@@ -2195,6 +2287,31 @@ def main():
             if emulated:
                 kout["emulated_cpu_mesh"] = True
             print(json.dumps(kout))
+    # crash-recovery chaos certification (ISSUE 20 acceptance): a
+    # controller kill -9ed at its first reduce fetch — after the map
+    # stage journaled — restarts and completes the SAME job
+    # bit-identically, replaying the completed stage from the journal
+    # (0 recomputes), with journal-on overhead <= 1.02x
+    if os.environ.get("BENCH_RECOVERY", "1") != "0":
+        got = _run_child("--recovery-only", child_timeout,
+                         env=extra_env, ok_prefix="RECOVERY_RESULT ")
+        if got is not None:
+            rv = json.loads(got)
+            rout = {"metric": _suffix("journal_recovery"),
+                    "value": rv["overhead"],
+                    "unit": ("x journal-on wall (lower is better; "
+                             "<=1.02 passes; the resume run must "
+                             "replay >=1 stage with 0 recomputes)"),
+                    "parity": rv["parity"],
+                    "victim_killed": rv["victim_killed"],
+                    "resumed_stages": rv["resumed_stages"],
+                    "recomputes": rv["recomputes"],
+                    "replay_traced": rv["replay_traced"],
+                    "off": rv["off"], "on": rv["on"],
+                    "resume": rv["resume"]}
+            if emulated:
+                rout["emulated_cpu_mesh"] = True
+            print(json.dumps(rout))
     if not extras:
         return
     # third line: join/cogroup, BASELINE config #2

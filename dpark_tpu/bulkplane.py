@@ -310,14 +310,17 @@ def _read_stream(sock, req):
     return meta, view
 
 
-def fetch(uri, req, timeout=30):
+def fetch(uri, req, timeout=None):
     """One bulk request against a tcp:// peer with bounded retry +
     backoff; returns (meta, payload memoryview).  ServerError (the
     peer answered; asking again cannot help) and BulkUnsupported (the
     peer predates the protocol; the caller falls back to the plain
     path) pass through unretried — only transport errors and
-    crc-rejected frames re-read on a fresh connection."""
+    crc-rejected frames re-read on a fresh connection.  The socket
+    deadline comes from conf.DCN_TIMEOUT_MS (ISSUE 20 satellite) and
+    every outcome feeds the peer-liveness leases."""
     from dpark_tpu import conf, trace
+    timeout = dcn._timeout_s(timeout)
     attempts = max(1, int(getattr(conf, "BULK_READ_ATTEMPTS", 1) or 1))
     delays = dcn.backoff_delays(attempts)
     win = _window(uri)
@@ -330,22 +333,31 @@ def fetch(uri, req, timeout=30):
         with trace.span("dcn.bulk.fetch", "dcn", kind=str(req[0]),
                         uri=uri) as sp:
             for k in range(attempts):
-                sock = _POOL.acquire(uri, timeout)
+                try:
+                    sock = _POOL.acquire(uri, timeout)
+                except (ConnectionError, OSError):
+                    # connect itself failed (after _connect's own
+                    # bounded retries): the strongest death signal
+                    dcn.note_peer_fail(uri)
+                    raise
                 ok = False
                 try:
                     meta, view = _read_stream(sock, req)
                     ok = True
                 except (dcn.ServerError, BulkUnsupported):
+                    dcn.note_peer_ok(uri)   # the peer IS answering
                     raise
                 except BulkCorrupt as e:
                     last = e
                 except (ConnectionError, OSError) as e:
                     with _C.lock:
                         _C.torn_streams += 1
+                    dcn.note_peer_fail(uri)
                     last = e
                 finally:
                     _POOL.release(uri, sock, broken=not ok)
                 if ok:
+                    dcn.note_peer_ok(uri)
                     _count_received(uri, len(view))
                     if sp is not trace._NOOP:
                         sp.args["bytes"] = len(view)

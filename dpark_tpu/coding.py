@@ -490,7 +490,11 @@ def decode_container(raw, fault_site=None, shuffle_id=None):
 _CODE = None
 
 _LOCK = threading.Lock()
-_KINDS = ("repair", "straggler_win", "decode_failures")
+_KINDS = ("repair", "straggler_win", "decode_failures",
+          # peer-death masked by parity (ISSUE 20): a lease-expired
+          # peer's shards were failed fast and the decode still closed
+          # from live peers — the recovery path the liveness layer buys
+          "peer_masked")
 _TOTALS = {k: 0 for k in _KINDS}
 _PER_SHUFFLE = {}
 _PER_PEER = {}

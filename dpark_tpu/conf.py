@@ -290,6 +290,48 @@ DCN_CONNECT_ATTEMPTS = int(os.environ.get("DPARK_DCN_CONNECT_ATTEMPTS",
 DCN_CONNECT_BACKOFF = float(os.environ.get("DPARK_DCN_CONNECT_BACKOFF",
                                            "0.05"))
 
+# dcn fetch deadline + whole-request retry (ISSUE 20 satellite — these
+# replace the hardcoded 30s socket timeout): every dcn/bulkplane fetch
+# and tracker call uses DCN_TIMEOUT_MS as its socket deadline, and a
+# transport failure (connect refused, torn stream, timeout) retries up
+# to DCN_RETRIES total attempts on a fresh connection with the same
+# exponential-full-jitter schedule as the connect path
+# (dcn.backoff_delays).  Application-level ServerError never retries.
+DCN_TIMEOUT_MS = float(os.environ.get("DPARK_DCN_TIMEOUT_MS",
+                                      "30000") or 30000)
+DCN_RETRIES = int(os.environ.get("DPARK_DCN_RETRIES", "3") or 1)
+
+# peer-liveness lease (ISSUE 20 tentpole b): every successful dcn/bulk
+# transfer renews the serving peer's lease for this many milliseconds.
+# A transport failure AFTER the lease lapsed marks the peer suspect
+# (counted once per transition as `lease_expiries` on /metrics), and
+# the coded fetch path fails that peer's shard attempts fast — racing
+# the parity shards from live peers instead of waiting out socket
+# timeouts — falling back to lineage recompute only when parity can't
+# cover the loss.  A suspect peer is re-probed after the same interval
+# so a recovered process rejoins without operator action.  0 disables
+# liveness tracking entirely (every peer always "alive").
+PEER_LEASE_MS = float(os.environ.get("DPARK_PEER_LEASE_MS",
+                                     "5000") or 0)
+
+# crash-consistent job journal (ISSUE 20 tentpole a): off | on.  "on"
+# write-ahead-logs job submission, stage completion, and the
+# shuffle-output registry as crc-framed JSON lines under
+# DPARK_JOURNAL_DIR, so a restarted controller replays the journal and
+# resumes accepted jobs from the last completed stage — re-running
+# only stages whose outputs are gone (lineage recomputes the holes).
+# Off (the default) costs one `is None` check per job and stage;
+# results are bit-identical either way.
+DPARK_JOURNAL = os.environ.get("DPARK_JOURNAL", "off")
+
+# where journal files live; must SURVIVE a controller restart, so the
+# default sits beside (not inside) the per-session workdir.  Delete
+# the directory to forget every resumable job.
+DPARK_JOURNAL_DIR = os.environ.get(
+    "DPARK_JOURNAL_DIR",
+    os.path.join(DPARK_WORK_DIR.split(",")[0].strip() or "/tmp",
+                 "journal"))
+
 # ---------------------------------------------------------------------------
 # multi-controller bulk data plane (dpark_tpu/bulkplane.py — ISSUE 12)
 # ---------------------------------------------------------------------------

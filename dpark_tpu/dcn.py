@@ -470,20 +470,171 @@ def _connect(uri, timeout, attempts=None, sleep=time.sleep, rand=None):
     raise last_err
 
 
-def fetch(uri, req, timeout=30):
+# ---------------------------------------------------------------------------
+# peer-liveness leases (ISSUE 20 tentpole b).  Every successful
+# transfer renews the serving peer's lease for conf.PEER_LEASE_MS; a
+# transport failure AFTER the lease lapsed (or against a peer never
+# heard from) marks the peer SUSPECT — counted once per transition as
+# `lease_expiries` — and the coded fetch path fails that peer's shard
+# attempts fast, racing parity shards from live peers instead of
+# waiting out socket timeouts.  A suspect peer re-probes after the
+# same interval, so a restarted process rejoins without operator
+# action.  conf.PEER_LEASE_MS == 0 disables tracking entirely.
+# ---------------------------------------------------------------------------
+
+_LIVE_LOCK = threading.Lock()
+_LEASES = {}        # peer key -> monotonic lease expiry
+_SUSPECT = {}       # peer key -> monotonic suspected-at time
+_LIVE_COUNTERS = {"lease_expiries": 0, "renewals": 0, "fast_fails": 0}
+
+
+def _lease_s():
+    from dpark_tpu import conf
+    return float(getattr(conf, "PEER_LEASE_MS", 0) or 0) / 1000.0
+
+
+def peer_key(uri):
+    """Lease registry key: host:port for tcp:// uris (two controllers
+    on one host are distinct peers), the uri itself otherwise."""
+    if uri.startswith("tcp://"):
+        return uri[len("tcp://"):]
+    return uri
+
+
+def note_peer_ok(uri, now=None):
+    """A transfer from `uri` succeeded: renew its lease, clear any
+    suspicion."""
+    lease = _lease_s()
+    if not lease:
+        return
+    now = time.monotonic() if now is None else now
+    key = peer_key(uri)
+    with _LIVE_LOCK:
+        _LEASES[key] = now + lease
+        _SUSPECT.pop(key, None)
+        _LIVE_COUNTERS["renewals"] += 1
+
+
+def note_peer_fail(uri, now=None):
+    """A TRANSPORT failure against `uri` (application-level
+    ServerError is the peer answering fine — never reported here).
+    Marks the peer suspect only once its lease has lapsed; failures
+    within a live lease are ordinary transients the retry path owns."""
+    lease = _lease_s()
+    if not lease:
+        return
+    now = time.monotonic() if now is None else now
+    key = peer_key(uri)
+    with _LIVE_LOCK:
+        if key in _SUSPECT:
+            return
+        expiry = _LEASES.get(key)
+        if expiry is None or now > expiry:
+            _SUSPECT[key] = now
+            _LIVE_COUNTERS["lease_expiries"] += 1
+            logger.warning("peer %s lease expired; marking suspect "
+                           "(hedging to parity/replicas)", key)
+
+
+def peer_alive(uri, now=None):
+    """False while `uri` is suspect inside its re-probe window.  The
+    coded fetch path consults this to fail a dead peer's shard
+    attempts fast; callers must treat False as a HINT (race parity
+    first), never as permission to skip lineage recovery."""
+    lease = _lease_s()
+    if not lease:
+        return True
+    now = time.monotonic() if now is None else now
+    key = peer_key(uri)
+    with _LIVE_LOCK:
+        t = _SUSPECT.get(key)
+        if t is None:
+            return True
+        if now - t > lease:
+            # re-probe window: give the peer one fresh chance
+            _SUSPECT.pop(key, None)
+            return True
+        _LIVE_COUNTERS["fast_fails"] += 1
+        return False
+
+
+def liveness_stats():
+    """Counters + current suspect set for /metrics and
+    recovery_summary(); None when leases are disabled."""
+    if not _lease_s():
+        return None
+    with _LIVE_LOCK:
+        out = dict(_LIVE_COUNTERS)
+        out["suspect"] = sorted(_SUSPECT)
+        out["leased_peers"] = len(_LEASES)
+    return out
+
+
+def reset_liveness():
+    with _LIVE_LOCK:
+        _LEASES.clear()
+        _SUSPECT.clear()
+        for k in _LIVE_COUNTERS:
+            _LIVE_COUNTERS[k] = 0
+
+
+def _timeout_s(timeout):
+    """Resolve the conf-driven fetch deadline (ISSUE 20 satellite:
+    DPARK_DCN_TIMEOUT_MS replaces the old hardcoded 30s)."""
+    if timeout is not None:
+        return timeout
+    from dpark_tpu import conf
+    return float(getattr(conf, "DCN_TIMEOUT_MS", 30000)) / 1000.0
+
+
+def fetch(uri, req, timeout=None, attempts=None):
     """One request against a tcp:// bucket server; returns payload
     bytes.  Raises on any transport or server error (callers translate
-    to FetchFailed for lineage recovery)."""
-    with _connect(uri, timeout) as sock:
-        return _request(sock, req)
+    to FetchFailed for lineage recovery).  Transport failures retry up
+    to conf.DCN_RETRIES total attempts on a fresh connection with the
+    shared exponential-full-jitter backoff; ServerError never retries.
+    Outcomes feed the peer-liveness leases."""
+    from dpark_tpu import conf
+    timeout = _timeout_s(timeout)
+    attempts = max(1, int(getattr(conf, "DCN_RETRIES", 1) or 1)
+                   if attempts is None else attempts)
+    delays = backoff_delays(attempts)
+    last_err = None
+    for _ in range(attempts):
+        try:
+            with _connect(uri, timeout) as sock:
+                payload = _request(sock, req)
+            note_peer_ok(uri)
+            return payload
+        except ServerError:
+            note_peer_ok(uri)    # the peer is alive; it just said no
+            raise
+        except (ConnectionError, OSError) as e:
+            last_err = e
+            note_peer_fail(uri)
+            d = next(delays, None)
+            if d is None:
+                break
+            time.sleep(d)
+    raise last_err
 
 
-def fetch_many(uri, reqs, timeout=30):
+def fetch_many(uri, reqs, timeout=None):
     """Several requests over ONE connection (the server handler loops);
     yields payloads in request order — e.g. all chunks of a broadcast
     without per-chunk connect/teardown."""
-    with _connect(uri, timeout) as sock:
-        return [_request(sock, req) for req in reqs]
+    timeout = _timeout_s(timeout)
+    try:
+        with _connect(uri, timeout) as sock:
+            out = [_request(sock, req) for req in reqs]
+    except ServerError:
+        note_peer_ok(uri)
+        raise
+    except (ConnectionError, OSError):
+        note_peer_fail(uri)
+        raise
+    note_peer_ok(uri)
+    return out
 
 
 class FetchPool:
@@ -491,8 +642,8 @@ class FetchPool:
     P2P broadcast fetch re-plans its source per chunk, which would
     otherwise mean one TCP handshake per chunk."""
 
-    def __init__(self, timeout=30):
-        self.timeout = timeout
+    def __init__(self, timeout=None):
+        self.timeout = _timeout_s(timeout)
         self._socks = {}
 
     def fetch(self, uri, req):
@@ -500,15 +651,24 @@ class FetchPool:
         if sock is None:
             sock = self._socks[uri] = _connect(uri, self.timeout)
         try:
-            return _request(sock, req)
+            payload = _request(sock, req)
         except ServerError:
-            raise        # application error: the connection is fine
-                         # and a resend would just fail again
+            note_peer_ok(uri)   # application error: the connection is
+            raise               # fine and a resend would just fail again
         except (ConnectionError, OSError):
             # one reconnect: the cached socket may be stale
             self.close_uri(uri)
-            sock = self._socks[uri] = _connect(uri, self.timeout)
-            return _request(sock, req)
+            try:
+                sock = self._socks[uri] = _connect(uri, self.timeout)
+                payload = _request(sock, req)
+            except ServerError:
+                note_peer_ok(uri)
+                raise
+            except (ConnectionError, OSError):
+                note_peer_fail(uri)
+                raise
+        note_peer_ok(uri)
+        return payload
 
     def close_uri(self, uri):
         sock = self._socks.pop(uri, None)

@@ -46,6 +46,10 @@ Per-site parameters:
                 corrupt  flip a byte of the site's payload bytes
                          (crc framing downstream must catch it)
                 delay    sleep ms= milliseconds, then proceed
+                kill     os._exit(137) — the process dies as if
+                         `kill -9`-ed at the site: no atexit, no
+                         finally blocks, no journal flush.  The crash
+                         leg of the chaos certification (ISSUE 20).
     ms=M      delay duration for kind=delay (default 50)
 
 A bare ``site`` (no params) fires once, on the first hit.
@@ -56,6 +60,7 @@ threads concurrently.
 """
 
 import errno
+import os
 import threading
 import time
 
@@ -66,7 +71,7 @@ SITES = ("shuffle.fetch", "shuffle.spill_write", "shuffle.spill_read",
          "executor.dispatch", "executor.compile", "dcn.connect",
          "dcn.transfer", "checkpoint.write")
 
-KINDS = ("raise", "enospc", "oom", "corrupt", "delay")
+KINDS = ("raise", "enospc", "oom", "corrupt", "delay", "kill")
 
 
 class FaultInjected(OSError):
@@ -180,6 +185,11 @@ class FaultPlane:
         if spec.kind == "delay":
             time.sleep(spec.ms / 1000.0)
             return payload
+        if spec.kind == "kill":
+            # hard process death, bypassing atexit/finally — the only
+            # honest way to certify crash recovery is to never give the
+            # dying process a chance to tidy up
+            os._exit(137)
         if spec.kind == "corrupt":
             if payload is None:
                 # the site carries no byte payload: corruption

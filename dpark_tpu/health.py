@@ -644,6 +644,40 @@ def grade(site_digests, rates, tenants=None, counters=None,
                      "retries": int(counters.get("retries", 0) or 0),
                      "fetch_failed": int(counters.get("fetch_failed",
                                                       0) or 0)}}
+    # crash-consistent control plane (ISSUE 20): journal + peer-lease
+    # evidence.  A refused journal file is red — completed work exists
+    # on disk that this process cannot replay (schema newer than it
+    # understands).  Lease expiries / suspect peers / skipped frames
+    # are yellow: recovery WORKED, but a peer died or a frame tore and
+    # an operator should know.
+    jstats = lease = None
+    try:
+        from dpark_tpu import journal as _journal
+        jstats = _journal.stats()
+    except Exception:
+        jstats = None
+    try:
+        from dpark_tpu import dcn as _dcn
+        lease = _dcn.liveness_stats()
+    except Exception:
+        lease = None
+    if jstats is not None or lease is not None:
+        jc = (jstats or {}).get("counters") or {}
+        lc = (lease or {}).get("counters") or {}
+        if int(jc.get("refused_files", 0) or 0):
+            g = "red"
+        elif int(jc.get("skipped_frames", 0) or 0) \
+                or int(lc.get("lease_expiries", 0) or 0) \
+                or (lease or {}).get("suspect"):
+            g = "yellow"
+        else:
+            g = "green"
+        out["recovery"] = {
+            "grade": g,
+            "evidence": {
+                "journal": jstats, "liveness": lease,
+                "resumed_stages": int(counters.get("resumed_stages",
+                                                   0) or 0)}}
     # per-tenant SLO (only when a service with declared SLOs is live)
     if tenants:
         by = float(getattr(conf, "SERVICE_SLO_BURN_YELLOW", 1.0))
@@ -772,6 +806,22 @@ def api_health(scheduler=None):
         rc = resultcache.stats()
         if rc is not None:
             out["result_cache"] = rc
+    except Exception:
+        pass
+    try:
+        # crash-journal counters (ISSUE 20) for the UI topline
+        from dpark_tpu import journal
+        js = journal.stats()
+        if js is not None:
+            out["journal"] = js
+    except Exception:
+        pass
+    try:
+        # peer-lease liveness (ISSUE 20): suspect set + expiry counts
+        from dpark_tpu import dcn
+        lv = dcn.liveness_stats()
+        if lv is not None:
+            out["liveness"] = lv
     except Exception:
         pass
     if s is not None:

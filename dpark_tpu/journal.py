@@ -1,0 +1,452 @@
+"""Crash-consistent job journal (ISSUE 20 tentpole): a crc-framed
+write-ahead log of job submission, stage completion, and the
+shuffle-output registry, so a controller killed mid-job (kill -9, OOM,
+power) can be restarted and RESUME accepted jobs from the last
+completed stage instead of recomputing the whole DAG.
+
+Design:
+
+- One append-only journal file per process under DPARK_JOURNAL_DIR
+  (``j-<nonce>.jnl``), each line a crc-framed canonical-JSON record
+  (utils.frame_jsonl — the adapt-store/trace-spool format): a single
+  O_APPEND write per record, so a torn tail from a crash skips at load
+  instead of poisoning it.  The first record is a ``meta`` frame
+  carrying the schema version; a file written by a NEWER schema is
+  refused whole (never half-interpreted).
+- Stage identity across restarts is a content fingerprint — a sha1
+  over a deterministic lineage walk (rdd types, split counts, call-site
+  scope names, shuffle-boundary partitioner widths) — because
+  process-local stage/shuffle ids restart from 1 in a fresh process.
+- Bucket paths embed the WRITER's shuffle id
+  (``<root>/shuffle/<sid>/<map>/<reduce>``), and a restarted process
+  mints new ids; replay records the writer's old sid and aliases
+  ``<root>/shuffle/<new_sid>`` to the old directory with a relative
+  symlink, so the unchanged fetch path resolves surviving buckets.
+- Replay seeds ``stage.output_locs`` for maps whose outputs still
+  exist (file:// roots verified on disk; hbm:// and tcp:// outputs are
+  unverifiable after a crash and treated as gone) — the scheduler's
+  existing submit_missing_tasks then re-runs ONLY the holes,
+  recomputing lost partitions by lineage exactly as dpark does.
+
+Replay assumes the resubmitted job is the same computation over the
+same inputs — the contract lineage recompute itself already assumes
+(sources must be deterministic).  The plane is off by default
+(``DPARK_JOURNAL=on`` to arm) and follows the plane contract: one
+``is None`` check per seam when off, bit-identical results either way.
+"""
+
+import hashlib
+import json
+import os
+import threading
+import uuid
+
+from dpark_tpu import conf, locks, trace
+from dpark_tpu.utils.log import get_logger
+
+logger = get_logger("journal")
+
+# bump when the record layout changes incompatibly; a journal file
+# whose meta frame carries a LARGER schema is refused at load (ISSUE 20
+# satellite: never resurrect a record this code can't interpret)
+SCHEMA = 1
+
+_COUNTER_KEYS = ("records", "journal_replays", "recovered_stages",
+                 "seeded_partitions", "skipped_frames", "refused_files",
+                 "flushes")
+
+
+def _frame(rec):
+    from dpark_tpu.utils import frame_jsonl
+    return frame_jsonl(rec)
+
+
+class _Plane:
+    """One process's view of the journal directory: its own append-only
+    file plus the loaded index of every file already there."""
+
+    def __init__(self, journal_dir):
+        self.dir = journal_dir
+        self.lock = locks.named_lock("journal.plane")
+        self.counters = {k: 0 for k in _COUNTER_KEYS}
+        self._fd = None
+        self._path = os.path.join(
+            journal_dir, "j-%s.jnl" % uuid.uuid4().hex[:12])
+        self._loaded = False
+        self._stages = {}        # stage_fp -> last stage record
+        self._jobs_done = set()  # job fingerprints with a job_done
+
+    # -- load (replay side) ---------------------------------------------
+    def _ensure_loaded(self):
+        with self.lock:
+            if self._loaded:
+                return
+            self._loaded = True
+            try:
+                names = sorted(
+                    n for n in os.listdir(self.dir)
+                    if n.endswith(".jnl"))
+            except OSError:
+                return
+            from dpark_tpu.utils import unframe_jsonl
+            for name in names:
+                path = os.path.join(self.dir, name)
+                try:
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                except OSError:
+                    continue
+                recs, skipped = unframe_jsonl(raw)
+                self.counters["skipped_frames"] += skipped
+                if recs and recs[0].get("kind") == "meta" \
+                        and int(recs[0].get("schema", 0)) > SCHEMA:
+                    # a newer process wrote this file: refuse it whole
+                    # rather than guess at records this schema can't
+                    # interpret
+                    self.counters["refused_files"] += 1
+                    logger.warning(
+                        "refusing journal %s (schema %s > supported "
+                        "%d)", name, recs[0].get("schema"), SCHEMA)
+                    continue
+                for rec in recs:
+                    kind = rec.get("kind")
+                    if kind == "stage" and rec.get("stage"):
+                        # duplicates are idempotent: last record wins
+                        # (a stage resubmitted after a fetch failure
+                        # re-journals with its fresh locations)
+                        self._stages[rec["stage"]] = rec
+                    elif kind == "job_done" and rec.get("job"):
+                        self._jobs_done.add(rec["job"])
+                    # meta/job/unknown kinds: index-free (forward
+                    # compatible within one schema)
+
+    def lookup_stage(self, stage_fp):
+        self._ensure_loaded()
+        with self.lock:
+            return self._stages.get(stage_fp)
+
+    # -- append (write-ahead side) --------------------------------------
+    def append(self, rec):
+        line = _frame(rec)
+        with self.lock:
+            if self._fd is None:
+                os.makedirs(self.dir, exist_ok=True)
+                self._fd = os.open(
+                    self._path,
+                    os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+                os.write(self._fd, _frame(
+                    {"kind": "meta", "schema": SCHEMA,
+                     "pid": os.getpid()}))
+                self.counters["records"] += 1
+            os.write(self._fd, line)
+            self.counters["records"] += 1
+            # keep this process's own index current so a SECOND job in
+            # the same process (or the same job resubmitted) replays
+            # without re-reading the directory
+            if rec.get("kind") == "stage" and rec.get("stage"):
+                if self._loaded:
+                    self._stages[rec["stage"]] = rec
+            elif rec.get("kind") == "job_done" and rec.get("job"):
+                if self._loaded:
+                    self._jobs_done.add(rec["job"])
+
+    def flush(self):
+        """Durability barrier (the drain endpoint calls this before
+        exit): fsync the append fd.  Individual appends rely on the
+        page cache — sufficient for process death (kill -9), which is
+        the failure this plane certifies against."""
+        with self.lock:
+            self.counters["flushes"] += 1
+            if self._fd is not None:
+                try:
+                    os.fsync(self._fd)
+                except OSError:
+                    pass
+
+    def stats(self):
+        with self.lock:
+            out = dict(self.counters)
+            out["loaded_stages"] = len(self._stages)
+        out["mode"] = "on"
+        out["dir"] = self.dir
+        return out
+
+
+_PLANE = None
+
+
+def configure(mode=None, journal_dir=None):
+    """(Re)arm the plane from conf or explicit args; "off" disarms."""
+    global _PLANE
+    mode = (mode if mode is not None
+            else getattr(conf, "DPARK_JOURNAL", "off") or "off")
+    mode = str(mode).strip().lower()
+    if mode in ("", "off", "0", "none", "false"):
+        _PLANE = None
+        return None
+    d = journal_dir or getattr(conf, "DPARK_JOURNAL_DIR", "") \
+        or os.path.join(conf.DPARK_WORK_DIR.split(",")[0].strip(),
+                        "journal")
+    _PLANE = _Plane(d)
+    return _PLANE
+
+
+def active():
+    return _PLANE is not None
+
+
+def stats():
+    """Counters for /metrics, /api/health, and recovery_summary();
+    None when the plane is off."""
+    p = _PLANE
+    return p.stats() if p is not None else None
+
+
+def flush():
+    p = _PLANE
+    if p is not None:
+        p.flush()
+
+
+# ---------------------------------------------------------------------------
+# content fingerprints: stage identity that survives a restart
+# ---------------------------------------------------------------------------
+
+def _walk(rdd, out, visited):
+    rid = getattr(rdd, "id", None)
+    if rid in visited:
+        out.append("cycle:%s" % rid)
+        return
+    visited.add(rid)
+    out.append("%s|%d|%s" % (type(rdd).__name__, len(rdd.splits),
+                             getattr(rdd, "scope_name", "") or ""))
+    path = getattr(rdd, "path", None)
+    if isinstance(path, str):
+        out.append("path=" + path)
+    from dpark_tpu.dependency import ShuffleDependency
+    for dep in rdd.dependencies:
+        if isinstance(dep, ShuffleDependency):
+            out.append("xch|%s|%d"
+                       % (type(dep.partitioner).__name__,
+                          dep.partitioner.num_partitions))
+        else:
+            out.append("dep|%s" % type(dep).__name__)
+        _walk(dep.rdd, out, visited)
+    out.append("end")
+
+
+def _digest(parts):
+    return hashlib.sha1(
+        "\n".join(parts).encode("utf-8")).hexdigest()[:20]
+
+
+def stage_fingerprint(stage):
+    """Deterministic identity of a shuffle-map stage: the full lineage
+    walk of its rdd plus its own write-side partitioner width.  Two
+    processes building the same DAG from the same script compute the
+    same fingerprint; process-local stage/shuffle ids never appear."""
+    out = []
+    _walk(stage.rdd, out, set())
+    if stage.shuffle_dep is not None:
+        out.append("write|%s|%d"
+                   % (type(stage.shuffle_dep.partitioner).__name__,
+                      stage.shuffle_dep.partitioner.num_partitions))
+    return _digest(out)
+
+
+def job_fingerprint(final_rdd, partitions):
+    out = ["job", ",".join(str(p) for p in partitions)]
+    _walk(final_rdd, out, set())
+    return _digest(out)
+
+
+# ---------------------------------------------------------------------------
+# write-ahead records (called from the scheduler's job loop)
+# ---------------------------------------------------------------------------
+
+def append_job(jfp, scope):
+    p = _PLANE
+    if p is None:
+        return
+    try:
+        p.append({"kind": "job", "job": jfp, "scope": scope})
+    except Exception:
+        logger.warning("journal job append failed", exc_info=True)
+
+
+def append_stage(jfp, stage):
+    """Record one COMPLETED shuffle-map stage: fingerprint, the
+    writer's shuffle id (replay aliases it), the effective shuffle
+    code, and every map output uri."""
+    p = _PLANE
+    if p is None or stage.shuffle_dep is None:
+        return
+    from dpark_tpu import coding
+    sid = stage.shuffle_dep.shuffle_id
+    code = coding.shuffle_code(sid)
+    try:
+        p.append({"kind": "stage", "job": jfp,
+                  "stage": stage_fingerprint(stage), "sid": sid,
+                  "nparts": stage.num_partitions,
+                  "nreduce":
+                      stage.shuffle_dep.partitioner.num_partitions,
+                  "code": code.describe() if code else "off",
+                  "locs": list(stage.output_locs)})
+    except Exception:
+        logger.warning("journal stage append failed", exc_info=True)
+
+
+def append_job_done(jfp):
+    p = _PLANE
+    if p is None:
+        return
+    try:
+        p.append({"kind": "job_done", "job": jfp})
+    except Exception:
+        logger.warning("journal job_done append failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# replay seeding (called once per job, before the first stage submits)
+# ---------------------------------------------------------------------------
+
+def _surviving_locs(rec):
+    """Validate a stage record's locations against the filesystem:
+    file:// roots must still hold the old-sid bucket dir with a full
+    complement of reduce files; hbm:// (device memory) and tcp:// (a
+    peer that may have died with us) cannot be verified after a crash
+    and are treated as gone — lineage recomputes them."""
+    old_sid = int(rec["sid"])
+    nreduce = int(rec.get("nreduce", 1))
+    out = []
+    for m, uri in enumerate(rec["locs"]):
+        ok = False
+        if isinstance(uri, str) and uri.startswith("file://"):
+            d = os.path.join(uri[len("file://"):], "shuffle",
+                             str(old_sid), str(m))
+            try:
+                ok = len(os.listdir(d)) >= nreduce
+            except OSError:
+                ok = False
+        out.append(uri if ok else None)
+    return out
+
+
+def _alias_sid(root, old_sid, new_sid):
+    """Point ``<root>/shuffle/<new_sid>`` at the surviving old-sid
+    bucket tree (relative symlink, same parent dir).  Returns False
+    when the alias can't be made — the caller treats those outputs as
+    gone and lineage recomputes."""
+    if old_sid == new_sid:
+        return True
+    base = os.path.join(root, "shuffle")
+    link = os.path.join(base, str(new_sid))
+    try:
+        if os.path.lexists(link):
+            return os.path.realpath(link) == os.path.realpath(
+                os.path.join(base, str(old_sid)))
+        os.makedirs(base, exist_ok=True)
+        os.symlink(str(old_sid), link)
+        return True
+    except OSError:
+        return False
+
+
+def seed_stages(scheduler, final_stage, record, jfp):
+    """Walk the job's stage graph; for every unavailable shuffle-map
+    stage with a journaled completion, seed the output locations that
+    survived on disk.  Fully-seeded stages register their map outputs
+    and never resubmit (0 recomputes); partially-surviving stages
+    resubmit only the holes.  Returns the number of fully resumed
+    stages (also stamped on the record and traced)."""
+    p = _PLANE
+    if p is None:
+        return 0
+    from dpark_tpu import coding
+    from dpark_tpu.env import env
+    stages, seen = [], set()
+
+    def collect(st):
+        if st.id in seen:
+            return
+        seen.add(st.id)
+        for parent in st.parents:
+            collect(parent)
+        if st.is_shuffle_map and not st.is_available:
+            stages.append(st)
+
+    collect(final_stage)
+    resumed, seeded_parts = 0, 0
+    for st in stages:
+        rec = p.lookup_stage(stage_fingerprint(st))
+        if rec is None:
+            continue
+        try:
+            if int(rec.get("nparts", -1)) != st.num_partitions \
+                    or int(rec.get("nreduce", -1)) != \
+                    st.shuffle_dep.partitioner.num_partitions \
+                    or len(rec.get("locs") or ()) != st.num_partitions:
+                continue
+            locs = _surviving_locs(rec)
+        except Exception:
+            continue
+        new_sid = st.shuffle_dep.shuffle_id
+        old_sid = int(rec["sid"])
+        roots = {uri[len("file://"):] for uri in locs if uri}
+        bad_roots = {r for r in roots
+                     if not _alias_sid(r, old_sid, new_sid)}
+        locs = [None if (uri and uri[len("file://"):] in bad_roots)
+                else uri for uri in locs]
+        if not any(uri for uri in locs):
+            continue
+        # the on-disk containers were written under the OLD run's code
+        # choice; pin the new sid to the same spec so the fetch path
+        # reads what is actually there (self-describing frames make a
+        # mismatch safe but slow — this makes it exact)
+        spec = rec.get("code")
+        if spec is not None:
+            try:
+                coding.set_shuffle_code(new_sid, spec)
+            except Exception:
+                pass
+        for m, uri in enumerate(locs):
+            if uri is not None:
+                st.add_output_loc(m, uri)
+                seeded_parts += 1
+        if st.is_available:
+            env.map_output_tracker.register_outputs(
+                new_sid, list(st.output_locs))
+            resumed += 1
+            logger.info(
+                "journal replay: stage %s resumed from sid %d "
+                "(%d maps, 0 recomputes)", st, old_sid,
+                st.num_partitions)
+        else:
+            holes = sum(1 for u in st.output_locs if u is None)
+            logger.info(
+                "journal replay: stage %s partially resumed from sid "
+                "%d (%d of %d maps recompute by lineage)", st,
+                old_sid, holes, st.num_partitions)
+    if seeded_parts:
+        with p.lock:
+            p.counters["journal_replays"] += 1
+            p.counters["recovered_stages"] += resumed
+            p.counters["seeded_partitions"] += seeded_parts
+        record["resumed_stages"] = resumed
+        record["seeded_partitions"] = seeded_parts
+        trace.event("journal.replay", "sched", job=record.get("id"),
+                    resumed_stages=resumed,
+                    seeded_partitions=seeded_parts)
+    return resumed
+
+
+def _init_from_conf():
+    try:
+        if getattr(conf, "DPARK_JOURNAL", "off") not in (
+                "", "off", "0", "none", "false"):
+            configure()
+    except Exception:
+        logger.warning("journal init failed", exc_info=True)
+
+
+_init_from_conf()

@@ -341,6 +341,17 @@ class DAGScheduler:
             with trace.ctx(job=record["id"], stage=stage.id):
                 self._dispatch(stage, [t], report, record)
 
+        # crash-consistent journal (ISSUE 20): write-ahead the job,
+        # then seed any journaled stage completions whose outputs
+        # survived a controller death — the submit below skips them
+        from dpark_tpu import journal
+        if journal._PLANE is not None:
+            record["_jfp"] = journal.job_fingerprint(final_rdd,
+                                                     output_parts)
+            journal.append_job(record["_jfp"], final_rdd.scope_name)
+            journal.seed_stages(self, final_stage, record,
+                                record["_jfp"])
+
         submit_stage(final_stage)
         record["stages"] = len(stage_of)
 
@@ -359,6 +370,9 @@ class DAGScheduler:
                 record["state"] = "done" if all(finished) else "aborted"
             record["seconds"] = round(_time.time() - job_t0, 3)
             record.pop("_t_submit", None)
+            jfp = record.pop("_jfp", None)
+            if jfp is not None and record["state"] == "done":
+                journal.append_job_done(jfp)
             self._finalize_decodes(record)
             self._finalize_exchanges(record)
             self._finalize_adapt(record)
@@ -877,6 +891,16 @@ class DAGScheduler:
                     out.append(reason)
         return out
 
+    def _journal_stage(self, record, stage):
+        """Write-ahead one COMPLETED shuffle-map stage (journal plane,
+        ISSUE 20): fingerprint + writer shuffle id + output locations,
+        so a restarted controller resumes past this stage instead of
+        recomputing it."""
+        jfp = record.get("_jfp")
+        if jfp is not None:
+            from dpark_tpu import journal
+            journal.append_stage(jfp, stage)
+
     def recovery_summary(self):
         """Aggregate recovery accounting across the job history plus
         the chaos plane's per-site injection counters — the bench
@@ -885,7 +909,8 @@ class DAGScheduler:
         actually ran."""
         from dpark_tpu import coding, faults
         out = {"resubmits": 0, "recomputes": 0, "retries": 0,
-               "fetch_failed": 0, "speculated": 0, "replans": 0}
+               "fetch_failed": 0, "speculated": 0, "replans": 0,
+               "resumed_stages": 0}
         for rec in self.history:
             for k in list(out):
                 out[k] += rec.get(k, 0)
@@ -916,6 +941,15 @@ class DAGScheduler:
                     out["decodes"][kind] = \
                         out["decodes"].get(kind, 0) + v
                 out["worker_processes"] = workers["processes"]
+        # crash-consistency view (ISSUE 20): journal replay counters
+        # and the peer-liveness lease registry, when armed
+        from dpark_tpu import dcn, journal
+        js = journal.stats()
+        if js is not None:
+            out["journal"] = js
+        lv = dcn.liveness_stats()
+        if lv is not None:
+            out["liveness"] = lv
         return out
 
     @staticmethod
@@ -924,7 +958,8 @@ class DAGScheduler:
                 "tasks": {"ok": 0, "fail": 0},
                 "counters": {"retries": 0, "resubmits": 0,
                              "recomputes": 0, "fetch_failed": 0,
-                             "speculated": 0, "replans": 0},
+                             "speculated": 0, "replans": 0,
+                             "resumed_stages": 0},
                 "adapt_decisions": {"applied": 0, "logged": 0},
                 "phases": {}}
 
@@ -1241,6 +1276,7 @@ class DAGScheduler:
                         env.map_output_tracker.register_outputs(
                             stage.shuffle_dep.shuffle_id, stage.output_locs)
                         self._finish_stage_info(record, stage.id)
+                        self._journal_stage(record, stage)
                         running.discard(stage)
                         # mid-job re-plan probe (ISSUE 19): if this
                         # map stage's bucket histogram shows one

@@ -166,6 +166,9 @@ class JobServer:
         self._adm_cv = threading.Condition()
         self._active_jobs = 0
         self._waiting_jobs = 0
+        # graceful degradation (ISSUE 20): draining refuses NEW jobs
+        # while in-flight ones run to their wave boundaries
+        self._draining = False
         self._lock = locks.named_lock("service.server")
         # per-tenant bulk-stream bytes (ISSUE 12; see note_bulk)
         self._bulk_bytes = {}
@@ -343,6 +346,12 @@ class JobServer:
         depth = getattr(self._tls, "adm_depth", 0)
         if depth == 0:
             with self._adm_cv:
+                if self._draining:
+                    # nested submissions (depth > 0) still pass: an
+                    # admitted job must be able to FINISH its own
+                    # sortByKey samples etc. while the server drains
+                    raise RuntimeError(
+                        "service draining: admission stopped")
                 if self.queue_max \
                         and self._waiting_jobs >= self.queue_max:
                     raise RuntimeError(
@@ -377,6 +386,43 @@ class JobServer:
                 with self._adm_cv:
                     self._active_jobs -= 1
                     self._adm_cv.notify()
+
+    # -- graceful degradation (ISSUE 20) ---------------------------------
+    def drain(self, timeout=30.0):
+        """Stop admitting jobs, wait (bounded) for in-flight jobs to
+        finish their wave-boundary work, then flush the crash journal
+        so a subsequent exit loses nothing.  Idempotent; returns a
+        summary the caller (or the remote `drain` endpoint) can log.
+        Never raises — drain is the LAST thing a dying server does."""
+        deadline = time.time() + max(0.0, float(timeout or 0.0))
+        with self._adm_cv:
+            already = self._draining
+            self._draining = True
+            while self._active_jobs > 0 and time.time() < deadline:
+                self._adm_cv.wait(timeout=min(
+                    1.0, max(0.01, deadline - time.time())))
+            active = self._active_jobs
+            waiting = self._waiting_jobs
+        flushed = False
+        try:
+            from dpark_tpu import journal
+            journal.flush()
+            flushed = journal.active()
+        except Exception as e:
+            logger.warning("journal flush on drain failed: %s", e)
+        summary = {"draining": True, "was_draining": already,
+                   "drained": active == 0, "active_jobs": active,
+                   "waiting_jobs": waiting,
+                   "journal_flushed": flushed}
+        logger.info("service drain: %s", summary)
+        return summary
+
+    def undrain(self):
+        """Re-open admission after a drain (tests / operator rollback
+        of a cancelled shutdown)."""
+        with self._adm_cv:
+            self._draining = False
+            self._adm_cv.notify_all()
 
     # -- dispatcher ------------------------------------------------------
     def enqueue(self, sched, record, stage, tasks, report):
@@ -494,6 +540,7 @@ class JobServer:
                "jobs_running": active, "jobs_queued": waiting,
                "work_items_queued": queued_items,
                "max_jobs": self.max_jobs, "bulk": bulk,
+               "draining": self._draining,
                "tenants": self.tenant_slo_stats()}
         ex = getattr(self.scheduler, "executor", None)
         if ex is not None:
@@ -633,6 +680,9 @@ def serve(addr="127.0.0.1:0", master=None, server=None):
           per-peer windows, and per-tenant stream bytes land in
           service_stats()["bulk"]
       ("stats",)                                 -> pickled stats dict
+      ("drain"[, timeout_s])                     -> pickled drain summary:
+          stop admission, wait (bounded) for in-flight jobs, flush the
+          crash journal (ISSUE 20 graceful degradation)
     """
     import os
     from dpark_tpu import dcn
@@ -674,6 +724,9 @@ def serve(addr="127.0.0.1:0", master=None, server=None):
                                    on_sent=note_sent)
         if kind == "stats":
             return compress(pickle.dumps(srv.service_stats(), -1))
+        if kind == "drain":
+            timeout = float(req[1]) if len(req) > 1 else 30.0
+            return compress(pickle.dumps(srv.drain(timeout), -1))
         raise ValueError("unknown service request %r" % (kind,))
 
     host, _, port = str(addr).partition(":")
@@ -730,6 +783,15 @@ class ServiceClient:
         from dpark_tpu import dcn
         from dpark_tpu.utils import decompress
         resp = dcn.fetch(self.uri, ("stats",), timeout=self.timeout)
+        return pickle.loads(decompress(resp))
+
+    def drain(self, timeout_s=30.0):
+        """Ask the server to stop admission, finish in-flight jobs and
+        flush its crash journal; returns the server's drain summary."""
+        from dpark_tpu import dcn
+        from dpark_tpu.utils import decompress
+        resp = dcn.fetch(self.uri, ("drain", float(timeout_s)),
+                         timeout=self.timeout)
         return pickle.loads(decompress(resp))
 
 

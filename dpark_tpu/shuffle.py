@@ -234,6 +234,15 @@ class _Uncoded(Exception):
     written without parity.  The caller retries the plain protocol."""
 
 
+class PeerSuspect(ConnectionError):
+    """A shard/bucket attempt was failed FAST because the serving
+    peer's liveness lease expired (ISSUE 20): the coded race decodes
+    from parity held by live peers instead of waiting out a socket
+    timeout against a corpse.  A ConnectionError subclass so every
+    existing transport-failure path (retry, FetchFailed, lineage)
+    handles it unchanged."""
+
+
 # per-exchange observation accumulator (ISSUE 19): which peers served
 # each shuffle THIS process fetched from, with per-peer fetch/decode
 # counts and the summed fetch wall ms.  The scheduler drains it at job
@@ -327,6 +336,13 @@ def _fetch_coded(ordered, shuffle_id, map_id, reduce_id, code, hm):
             # chaos site: one hit per shard ATTEMPT — under injection
             # the decode-instead-of-recompute path is what's exercised
             faults.hit("shuffle.fetch")
+            if uri.startswith("tcp://"):
+                from dpark_tpu import dcn
+                if not dcn.peer_alive(uri):
+                    # lease-dead peer (ISSUE 20): fail this shard fast
+                    # so parity from LIVE peers wins the k-of-n race
+                    # instead of waiting out a socket timeout
+                    raise PeerSuspect("peer lease expired: %s" % uri)
             raw = read_bucket_shard(uri, shuffle_id, map_id,
                                     reduce_id, idx)
             fr = coding.unpack_shard(raw)
@@ -347,6 +363,7 @@ def _fetch_coded(ordered, shuffle_id, map_id, reduce_id, code, hm):
     orig_len = 0
     had_error = False
     frame_code = None
+    masked_peers = set()    # lease-dead peers whose shards parity covered
     while len(got) < k and outstanding:
         try:
             idx, err, fr, uri = results.get(
@@ -404,6 +421,8 @@ def _fetch_coded(ordered, shuffle_id, map_id, reduce_id, code, hm):
             misses += 1
             continue
         had_error = True
+        if isinstance(err, PeerSuspect):
+            masked_peers.add(peer_label(uri))
         hm.task_failed_on(uri_host(uri))
         logger.warning("shard fetch failed %s #%d: %s", uri, idx, err)
         if tries[idx] < attempts_cap:
@@ -447,6 +466,11 @@ def _fetch_coded(ordered, shuffle_id, map_id, reduce_id, code, hm):
         peer = peer_label(ordered[0]) if ordered else "local"
         coding.note(kind, shuffle_id, peer=peer)
         _xch_note(shuffle_id, peer, kind)
+        # peer-death masked by parity (ISSUE 20 acceptance): the
+        # lease layer failed a dead peer's shards fast and the decode
+        # still closed from live shards — zero lineage recompute
+        for dead in masked_peers:
+            coding.note("peer_masked", shuffle_id, peer=dead)
     return pickle.loads(decompress(blob))
 
 

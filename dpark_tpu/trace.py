@@ -82,6 +82,14 @@ Span taxonomy (name / cat):
                                        the boot-warm deserializations
                                        (warm passes run under the
                                        __boot__ pseudo-tenant ctx)
+    journal.replay           "sched"   crash-journal replay (ISSUE
+                                       20): one instant event per job
+                                       whose completed stages were
+                                       seeded from the journal, with
+                                       resumed_stages and
+                                       seeded_partitions in args —
+                                       the chaos certification greps
+                                       for this
 
 Records are flat dicts: name, cat, ts (epoch seconds), dur (seconds),
 pid, host, tid, optional job/stage/task ints, optional args.  The

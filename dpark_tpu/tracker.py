@@ -25,6 +25,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 
 from dpark_tpu.utils.log import get_logger
 
@@ -261,21 +262,41 @@ class TrackerClient:
 
     def _conn(self):
         if self._sock is None:
-            self._sock = socket.create_connection(self.addr, timeout=30)
+            # conf-driven deadline (ISSUE 20 satellite): the tracker
+            # shares the dcn fetch deadline instead of a hardcoded 30s
+            from dpark_tpu import conf
+            timeout = float(getattr(conf, "DCN_TIMEOUT_MS",
+                                    30000)) / 1000.0
+            self._sock = socket.create_connection(self.addr,
+                                                  timeout=timeout)
         return self._sock
 
     def call(self, msg):
+        """One tracker round-trip with conf.DCN_RETRIES total attempts
+        on a fresh connection, exponential-full-jitter backoff between
+        them (dcn.backoff_delays — one schedule, every control-plane
+        caller).  Safe to retry blindly: mutations carry a msg_id the
+        server deduplicates, so a reply lost in transit cannot
+        double-apply."""
+        from dpark_tpu import conf, dcn
         frame = _msg_to_frame(msg)
+        attempts = max(2, int(getattr(conf, "DCN_RETRIES", 2) or 2))
+        delays = dcn.backoff_delays(attempts)
+        last_err = None
         with self._lock:
-            try:
-                sock = self._conn()
-                _send_raw(sock, frame)
-                return _recv_msg(sock)
-            except (ConnectionError, OSError):
-                self.close()
-                sock = self._conn()
-                _send_raw(sock, frame)
-                return _recv_msg(sock)
+            for k in range(attempts):
+                try:
+                    sock = self._conn()
+                    _send_raw(sock, frame)
+                    return _recv_msg(sock)
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    self.close()
+                    d = next(delays, None)
+                    if d is None:
+                        break
+                    time.sleep(d)
+            raise last_err
 
     def get(self, key):
         return self.call(GetValueMessage(key))

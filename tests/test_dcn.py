@@ -377,3 +377,101 @@ def test_rank_hosts_orders_by_health():
     assert hm.offer_choice(["bad", "meh", "ok"]) == "ok"
     # blacklisted hosts remain usable as last resorts
     assert hm.rank_hosts(["bad"]) == ["bad"]
+
+
+# ---------------------------------------------------------------------------
+# peer-lease liveness (ISSUE 20): fake-clock lease registry semantics
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def lease_100ms():
+    from dpark_tpu import conf, dcn
+    old = conf.PEER_LEASE_MS
+    conf.PEER_LEASE_MS = 100.0
+    dcn.reset_liveness()
+    yield
+    conf.PEER_LEASE_MS = old
+    dcn.reset_liveness()
+
+
+def test_lease_lifecycle_fake_clock(lease_100ms):
+    from dpark_tpu import dcn
+    uri = "tcp://10.0.0.1:555"
+    t0 = 1000.0
+    dcn.note_peer_ok(uri, now=t0)
+    assert dcn.peer_alive(uri, now=t0 + 0.05)
+    # a failure INSIDE a live lease is an ordinary transient the retry
+    # path owns — never suspicion
+    dcn.note_peer_fail(uri, now=t0 + 0.05)
+    assert dcn.peer_alive(uri, now=t0 + 0.06)
+    assert dcn.liveness_stats()["lease_expiries"] == 0
+    # a failure AFTER the lease lapsed marks suspect, counted ONCE per
+    # transition no matter how many shard attempts pile on
+    dcn.note_peer_fail(uri, now=t0 + 0.2)
+    dcn.note_peer_fail(uri, now=t0 + 0.21)
+    st = dcn.liveness_stats()
+    assert st["lease_expiries"] == 1
+    assert st["suspect"] == ["10.0.0.1:555"]
+    assert not dcn.peer_alive(uri, now=t0 + 0.25)
+    # re-probe: one lease interval later the peer gets a fresh chance
+    assert dcn.peer_alive(uri, now=t0 + 0.35)
+    # a success clears suspicion and renews the lease
+    dcn.note_peer_fail(uri, now=t0 + 0.4)
+    dcn.note_peer_ok(uri, now=t0 + 0.45)
+    assert dcn.peer_alive(uri, now=t0 + 0.46)
+    assert dcn.liveness_stats()["suspect"] == []
+
+
+def test_lease_disabled_is_inert():
+    from dpark_tpu import conf, dcn
+    old = conf.PEER_LEASE_MS
+    conf.PEER_LEASE_MS = 0
+    try:
+        dcn.reset_liveness()
+        dcn.note_peer_fail("tcp://10.0.0.9:1")
+        assert dcn.peer_alive("tcp://10.0.0.9:1")
+        assert dcn.liveness_stats() is None
+    finally:
+        conf.PEER_LEASE_MS = old
+        dcn.reset_liveness()
+
+
+def test_server_error_renews_lease_never_suspects(tmp_path):
+    """An application-level refusal proves the peer is ALIVE: fetch
+    renews its lease instead of reporting a transport failure."""
+    import os as _os
+    from dpark_tpu import conf, dcn
+    wd = str(tmp_path / "wd")
+    _os.makedirs(wd)
+    srv = dcn.BucketServer(wd, host="127.0.0.1").start()
+    old = conf.PEER_LEASE_MS
+    conf.PEER_LEASE_MS = 5000.0
+    dcn.reset_liveness()
+    try:
+        uri = "tcp://%s:%d" % srv.bind_address
+        with pytest.raises(dcn.ServerError):
+            dcn.fetch(uri, ("no-such-kind",))
+        st = dcn.liveness_stats()
+        assert st["renewals"] >= 1
+        assert st["suspect"] == []
+        assert dcn.peer_alive(uri)
+    finally:
+        conf.PEER_LEASE_MS = old
+        dcn.reset_liveness()
+        srv.stop()
+
+
+def test_conf_timeout_and_retry_knobs(monkeypatch):
+    """ISSUE 20 satellite: the dcn fetch deadline and retry budget are
+    conf-driven (DPARK_DCN_TIMEOUT_MS / DPARK_DCN_RETRIES), no longer
+    hardcoded."""
+    from dpark_tpu import conf, dcn
+    monkeypatch.setattr(conf, "DCN_TIMEOUT_MS", 1234.0)
+    assert dcn._timeout_s(None) == pytest.approx(1.234)
+    assert dcn._timeout_s(7) == 7
+    # an unreachable peer exhausts exactly DCN_RETRIES attempts
+    monkeypatch.setattr(conf, "DCN_RETRIES", 2)
+    monkeypatch.setattr(conf, "DCN_CONNECT_ATTEMPTS", 1)
+    monkeypatch.setattr(conf, "DCN_CONNECT_BACKOFF", 0.001)
+    with pytest.raises(OSError):
+        dcn.fetch("tcp://127.0.0.1:1", ("ping",), timeout=0.2)

@@ -659,3 +659,68 @@ def test_recovery_summary_shape(ctx):
         assert field in summary, summary
     assert summary["fetch_failed"] >= 1
     assert summary["faults"]["shuffle.fetch"]["fired"] == 1
+
+
+# ---------------------------------------------------------------------------
+# kill kind + spec-parse edge cases (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+def test_kill_kind_hard_exits_subprocess():
+    """kind=kill is os._exit(137) at the site — no atexit, no finally
+    — proven in a subprocess (this process must survive the test)."""
+    plane = faults.configure("shuffle.fetch:nth=2,kind=kill")
+    assert plane.specs["shuffle.fetch"].kind == "kill"
+    faults.configure(None)
+    import subprocess
+    import sys
+    code = ("from dpark_tpu import faults\n"
+            "faults.configure('shuffle.fetch:nth=1,kind=kill')\n"
+            "faults.hit('shuffle.fetch')\n"
+            "print('survived')\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 137, (proc.returncode, proc.stderr)
+    assert "survived" not in proc.stdout
+
+
+def test_spec_parse_edge_cases():
+    # empty / separator-only specs install nothing
+    assert faults.parse_spec("") == {}
+    assert faults.parse_spec(None) == {}
+    assert faults.parse_spec(";;") == {}
+    # trailing comma and whitespace are tolerated
+    specs = faults.parse_spec(" shuffle.fetch : nth=2 , kind=delay ,")
+    assert specs["shuffle.fetch"].nth == 2
+    assert specs["shuffle.fetch"].kind == "delay"
+    # duplicate site: last spec wins (one spec per site)
+    specs = faults.parse_spec("shuffle.fetch:nth=1;shuffle.fetch:nth=9")
+    assert specs["shuffle.fetch"].nth == 9
+    # malformed params fail loudly — a typo'd chaos run must never
+    # silently inject nothing
+    with pytest.raises(ValueError):
+        faults.parse_spec("shuffle.fetch:nth")         # no '='
+    with pytest.raises(ValueError):
+        faults.parse_spec("shuffle.fetch:nth=x")       # non-numeric
+    with pytest.raises(ValueError):
+        faults.parse_spec("shuffle.fetch:kind=kaboom")  # unknown kind
+    with pytest.raises(ValueError):
+        faults.parse_spec("no.such.site:nth=1")        # unknown site
+
+
+def test_stats_counters_are_thread_safe():
+    """Concurrent hits from fetcher threads must never lose counts
+    (the hit bookkeeping runs under the plane lock)."""
+    import threading
+    faults.configure("shuffle.fetch:p=0,seed=1")   # counts, never fires
+
+    def worker():
+        for _ in range(1000):
+            faults.hit("shuffle.fetch")
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    st = faults.stats()["shuffle.fetch"]
+    assert st["hits"] == 8000 and st["fired"] == 0

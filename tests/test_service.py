@@ -499,3 +499,45 @@ def test_remote_two_clients_share_one_server():
         assert {"remote:tenant-a", "remote:tenant-b"} <= clients
     finally:
         framed.stop()
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: the drain endpoint (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def _drain_probe_job(ctx):
+    return sorted(ctx.parallelize([(i % 3, 1) for i in range(30)], 2)
+                  .reduceByKey(_add, 2).collect())
+
+
+def test_remote_drain_stops_admission_and_flushes(tmp_path):
+    """ServiceClient.drain: the server stops admission, finishes
+    in-flight work, flushes the crash journal, and refuses new jobs
+    until undrained."""
+    from dpark_tpu import journal
+    journal.configure(mode="on", journal_dir=str(tmp_path / "jnl"))
+    try:
+        framed = service.serve("127.0.0.1:0", master="local")
+        try:
+            addr = "%s:%d" % framed.bind_address
+            c = service.ServiceClient(addr, client="drainer")
+            assert c.run(_drain_probe_job) == [(0, 10), (1, 10),
+                                               (2, 10)]
+            summary = c.drain(timeout_s=10)
+            assert summary["drained"] is True
+            assert summary["journal_flushed"] is True
+            srv = service.get_server()
+            assert srv.service_stats()["draining"] is True
+            with pytest.raises(Exception) as e:
+                c.run(_drain_probe_job)
+            assert "draining" in str(e.value)
+            # drain is idempotent
+            again = c.drain(timeout_s=1)
+            assert again["was_draining"] is True
+            srv.undrain()
+            assert c.run(_drain_probe_job) == [(0, 10), (1, 10),
+                                               (2, 10)]
+        finally:
+            framed.stop()
+    finally:
+        journal.configure(mode="off")

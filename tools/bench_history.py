@@ -27,6 +27,7 @@ Tracked ratios (whatever the run emitted):
     health_plane_overhead     sink on/off wall ratio (<= 1.03)
     ledger_plane_overhead     ledger on/off wall ratio (<= 1.03)
     lockcheck_overhead        sanitizer on/off wall ratio (<= 1.03)
+    journal_recovery          journal on/off wall ratio (<= 1.02)
 
 The trajectory is plain JSON lines (one entry per run) so ``git
 diff`` reads it; corrupt lines skip at load.  The diff is
@@ -60,6 +61,7 @@ HEADLINES = {
     "health_plane_overhead": ("health_plane_overhead", False),
     "ledger_plane_overhead": ("ledger_plane_overhead", False),
     "lockcheck_overhead": ("lockcheck_overhead", False),
+    "journal_recovery": ("journal_recovery", False),
 }
 
 
